@@ -1,0 +1,23 @@
+"""spark_rapids_tpu — TPU-native accelerator framework with the capabilities
+of the RAPIDS Accelerator for Apache Spark.
+
+Reference: petro-rudenko/spark-rapids (mount empty at build time; built from
+the capability inventory in SURVEY.md). The compute path is JAX/XLA/Pallas
+over TPU; the planner mirrors the reference's override architecture
+(GpuOverrides -> TpuOverrides), with per-operator CPU fallback, a
+``spark.rapids.*`` config surface, columnar Arrow interchange at the host
+boundary, mesh-collective shuffle, and spill/OOM-retry memory management.
+"""
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# Spark SQL semantics require real int64/float64 lanes; JAX truncates to
+# 32-bit by default. Must happen before any jnp array is created.
+_jax.config.update("jax_enable_x64", True)
+
+from .config import RapidsConf
+from .datatypes import Schema
+
+__all__ = ["RapidsConf", "Schema", "__version__"]

@@ -1,0 +1,8 @@
+from .column import TpuColumnVector
+from .batch import TpuBatch, bucket_rows, bucket_bytes, row_mask
+from .arrow_bridge import (arrow_to_device, device_to_arrow, arrow_schema,
+                           engine_schema)
+
+__all__ = ["TpuColumnVector", "TpuBatch", "bucket_rows", "bucket_bytes",
+           "row_mask", "arrow_to_device", "device_to_arrow", "arrow_schema",
+           "engine_schema"]

@@ -1,0 +1,114 @@
+"""Columnar batches on device.
+
+The engine's unit of execution, analogous to the reference's Spark
+`ColumnarBatch` of `GpuColumnVector`s (SURVEY.md §2.2-A L3). A batch is a
+pytree so whole operator pipelines jit over it; `capacity` is static
+(bucketed) while `row_count` is a traced device scalar, so batches of
+different actual sizes share one compiled program.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..datatypes import Schema
+from .column import TpuColumnVector
+
+__all__ = ["TpuBatch", "bucket_rows", "bucket_bytes", "row_mask"]
+
+_MIN_CAPACITY = 128
+
+
+def bucket_rows(n: int, minimum: int = _MIN_CAPACITY) -> int:
+    """Static capacity bucket: next power of two >= n (>= minimum).
+
+    Bounds XLA recompilation to O(log max_rows) program variants per
+    pipeline — the TPU-side answer to cudf's exact-size allocations.
+    """
+    cap = minimum
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+def bucket_bytes(n: int, minimum: int = 1 << 10) -> int:
+    cap = minimum
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+def row_mask(capacity: int, row_count) -> jax.Array:
+    """Bool mask of live (non-padding) rows."""
+    return jnp.arange(capacity, dtype=jnp.int32) < row_count
+
+
+class TpuBatch:
+    __slots__ = ("columns", "schema", "row_count", "_num_rows_cache")
+
+    def __init__(self, columns: List[TpuColumnVector], schema: Schema,
+                 row_count):
+        self.columns = list(columns)
+        self.schema = schema
+        if isinstance(row_count, (int, np.integer)):
+            self._num_rows_cache = int(row_count)
+            row_count = jnp.int32(row_count)
+        else:
+            self._num_rows_cache = None
+        self.row_count = row_count
+
+    @property
+    def capacity(self) -> int:
+        if not self.columns:
+            return 0
+        return self.columns[0].capacity
+
+    @property
+    def num_rows(self) -> int:
+        """Actual row count; syncs device->host once and caches."""
+        if self._num_rows_cache is None:
+            self._num_rows_cache = int(jax.device_get(self.row_count))
+        return self._num_rows_cache
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def column(self, i: int) -> TpuColumnVector:
+        return self.columns[i]
+
+    def live_mask(self) -> jax.Array:
+        return row_mask(self.capacity, self.row_count)
+
+    def device_size_bytes(self) -> int:
+        return sum(c.device_size_bytes() for c in self.columns)
+
+    def with_columns(self, columns, schema=None, row_count=None):
+        return TpuBatch(columns,
+                        self.schema if schema is None else schema,
+                        self.row_count if row_count is None else row_count)
+
+    def block_until_ready(self):
+        for c in self.columns:
+            for a in c.arrays():
+                a.block_until_ready()
+        return self
+
+    def __repr__(self):
+        return (f"TpuBatch(rows~cap={self.capacity}, "
+                f"cols={len(self.columns)}, schema={self.schema})")
+
+
+def _flatten_batch(b: TpuBatch):
+    return (b.columns, b.row_count), b.schema
+
+
+def _unflatten_batch(schema, children):
+    columns, row_count = children
+    return TpuBatch(columns, schema, row_count)
+
+
+jax.tree_util.register_pytree_node(TpuBatch, _flatten_batch, _unflatten_batch)

@@ -1,0 +1,137 @@
+"""Device-resident column vectors.
+
+TPU analog of the reference's `GpuColumnVector.java` (SURVEY.md §2.2-A;
+reference mount empty — built from capability description): a SQL column whose
+buffers live in device HBM as `jax.Array`s instead of cudf device memory.
+
+Layout (Arrow-compatible, static-shape discipline):
+  - fixed-width types: ``data``  — shape ``(capacity,)`` of the type's lane
+    dtype; rows past the batch row_count are padding garbage.
+  - strings/binary:    ``offsets`` — int32 ``(capacity+1,)`` monotone;
+                       ``chars``   — uint8 ``(char_capacity,)`` padded.
+  - validity:          bool ``(capacity,)`` — SQL-null mask (True = valid).
+    Distinct from row padding, which is governed by the batch row_count.
+
+Capacities are bucketed to powers of two (see `batch.bucket_rows`) so XLA
+recompilation is bounded — the TPU replacement for cudf's exact-size device
+allocations.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..datatypes import (DataType, StringType, BinaryType, DecimalType,
+                         NullType)
+
+__all__ = ["TpuColumnVector"]
+
+
+class TpuColumnVector:
+    __slots__ = ("dtype", "data", "validity", "offsets", "chars")
+
+    def __init__(self, dtype: DataType, data=None, validity=None,
+                 offsets=None, chars=None):
+        self.dtype = dtype
+        self.data = data
+        self.validity = validity
+        self.offsets = offsets
+        self.chars = chars
+
+    # -- constructors -----------------------------------------------------
+    @classmethod
+    def from_numpy(cls, dtype: DataType, values: np.ndarray,
+                   validity: Optional[np.ndarray], capacity: int):
+        """Upload a host fixed-width column, padding to `capacity`."""
+        n = len(values)
+        lane = dtype.np_dtype
+        assert lane is not None, "use from_string_parts for var-width"
+        buf = np.zeros(capacity, dtype=lane)
+        buf[:n] = values.astype(lane, copy=False)
+        if validity is None:
+            vbuf = np.zeros(capacity, dtype=np.bool_)
+            vbuf[:n] = True
+        else:
+            vbuf = np.zeros(capacity, dtype=np.bool_)
+            vbuf[:n] = validity
+        return cls(dtype, data=jnp.asarray(buf), validity=jnp.asarray(vbuf))
+
+    @classmethod
+    def from_string_parts(cls, dtype: DataType, offsets: np.ndarray,
+                          chars: np.ndarray, validity: Optional[np.ndarray],
+                          capacity: int, char_capacity: int):
+        n = len(offsets) - 1
+        obuf = np.zeros(capacity + 1, dtype=np.int32)
+        obuf[: n + 1] = offsets
+        obuf[n + 1:] = offsets[-1]  # keep monotone through padding
+        cbuf = np.zeros(char_capacity, dtype=np.uint8)
+        cbuf[: len(chars)] = chars
+        vbuf = np.zeros(capacity, dtype=np.bool_)
+        if validity is None:
+            vbuf[:n] = True
+        else:
+            vbuf[:n] = validity
+        return cls(dtype, validity=jnp.asarray(vbuf),
+                   offsets=jnp.asarray(obuf), chars=jnp.asarray(cbuf))
+
+    @classmethod
+    def nulls(cls, dtype: DataType, capacity: int):
+        v = jnp.zeros((capacity,), dtype=jnp.bool_)
+        if dtype.is_variable_width:
+            return cls(dtype, validity=v,
+                       offsets=jnp.zeros((capacity + 1,), jnp.int32),
+                       chars=jnp.zeros((0,), jnp.uint8))
+        return cls(dtype, data=jnp.zeros((capacity,), dtype.np_dtype),
+                   validity=v)
+
+    # -- properties -------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        if self.data is not None:
+            return self.data.shape[0]
+        return self.offsets.shape[0] - 1
+
+    @property
+    def is_string_like(self) -> bool:
+        return isinstance(self.dtype, (StringType, BinaryType))
+
+    def arrays(self):
+        """The jax.Arrays backing this column, for jit flattening."""
+        out = []
+        for a in (self.data, self.validity, self.offsets, self.chars):
+            if a is not None:
+                out.append(a)
+        return out
+
+    def device_size_bytes(self) -> int:
+        return sum(a.size * a.dtype.itemsize for a in self.arrays())
+
+    def with_arrays(self, data=None, validity=None, offsets=None, chars=None):
+        return TpuColumnVector(
+            self.dtype,
+            data=self.data if data is None else data,
+            validity=self.validity if validity is None else validity,
+            offsets=self.offsets if offsets is None else offsets,
+            chars=self.chars if chars is None else chars)
+
+    def __repr__(self):
+        return (f"TpuColumnVector({self.dtype.simple_string()}, "
+                f"cap={self.capacity})")
+
+
+def _flatten_col(c: TpuColumnVector):
+    children = (c.data, c.validity, c.offsets, c.chars)
+    return children, c.dtype
+
+
+def _unflatten_col(dtype, children):
+    data, validity, offsets, chars = children
+    return TpuColumnVector(dtype, data=data, validity=validity,
+                           offsets=offsets, chars=chars)
+
+
+jax.tree_util.register_pytree_node(TpuColumnVector, _flatten_col,
+                                   _unflatten_col)

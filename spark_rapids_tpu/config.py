@@ -1,0 +1,275 @@
+"""RapidsConf equivalent: typed config registry with the ``spark.rapids.*``
+namespace preserved.
+
+Mirrors the reference's `RapidsConf.scala` (SURVEY.md §2.2-A, §5.6 — reference
+mount empty; built from capability description): a single registry of typed
+entries, each with a doc string, default, and user/internal visibility; per-op
+kill switches (``spark.rapids.sql.exec.<Name>`` / ``.expression.<Name>``);
+docs generated from the registry (never handwritten).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["ConfEntry", "RapidsConf", "register", "ENTRIES"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfEntry:
+    key: str
+    default: Any
+    doc: str
+    conv: Callable[[str], Any]
+    internal: bool = False
+    startup_only: bool = False
+
+
+ENTRIES: Dict[str, ConfEntry] = {}
+
+
+def _to_bool(v):
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("true", "1", "yes")
+
+
+def _to_int(v):
+    return int(v)
+
+
+def _to_float(v):
+    return float(v)
+
+
+def _to_str(v):
+    return str(v)
+
+
+def _bytes_conv(v):
+    """Parse '512m', '2g', '1024' style byte sizes (Spark conf convention)."""
+    if isinstance(v, (int, float)):
+        return int(v)
+    s = str(v).strip().lower()
+    mult = 1
+    for suffix, m in (("kb", 1 << 10), ("mb", 1 << 20), ("gb", 1 << 30),
+                      ("tb", 1 << 40), ("k", 1 << 10), ("m", 1 << 20),
+                      ("g", 1 << 30), ("t", 1 << 40), ("b", 1)):
+        if s.endswith(suffix):
+            s = s[: -len(suffix)]
+            mult = m
+            break
+    return int(float(s) * mult)
+
+
+def register(key, default, doc, conv=None, internal=False, startup_only=False):
+    if conv is None:
+        conv = {bool: _to_bool, int: _to_int, float: _to_float,
+                str: _to_str}.get(type(default), _to_str)
+    e = ConfEntry(key, default, doc, conv, internal, startup_only)
+    ENTRIES[key] = e
+    return e
+
+
+# --- Core enablement ------------------------------------------------------
+SQL_ENABLED = register(
+    "spark.rapids.sql.enabled", True,
+    "Master kill switch: when false every operator stays on CPU.")
+EXPLAIN = register(
+    "spark.rapids.sql.explain", "NONE",
+    "Explain why parts of a plan did or did not run on TPU: "
+    "NONE, ALL, NOT_ON_GPU.")
+INCOMPATIBLE_OPS = register(
+    "spark.rapids.sql.incompatibleOps.enabled", True,
+    "Allow ops whose behavior can differ slightly from Spark "
+    "(e.g. float aggregation ordering).")
+VARIABLE_FLOAT_AGG = register(
+    "spark.rapids.sql.variableFloatAgg.enabled", True,
+    "Allow float/double aggregations whose result can vary with "
+    "parallel reduction order.")
+ANSI_ENABLED = register(
+    "spark.sql.ansi.enabled", False,
+    "ANSI mode: overflow/invalid-cast raise instead of null/wrap.")
+CASE_SENSITIVE = register(
+    "spark.sql.caseSensitive", False,
+    "Case sensitivity for column resolution (Spark default false).")
+SESSION_TZ = register(
+    "spark.sql.session.timeZone", "UTC",
+    "Session time zone; the TPU path supports UTC only (like early "
+    "spark-rapids), other zones fall back per-expression.")
+
+# --- Batching / memory ----------------------------------------------------
+BATCH_SIZE_BYTES = register(
+    "spark.rapids.sql.batchSizeBytes", 1 << 30,
+    "Target output batch size in bytes for coalescing (reference default "
+    "2GiB ceiling / 1GiB typical).", conv=_bytes_conv)
+BATCH_SIZE_ROWS = register(
+    "spark.rapids.sql.batchSizeRows", 1 << 20,
+    "Target max rows per device batch; capacities are bucketed to "
+    "powers of two up to this for bounded XLA recompilation.",
+    conv=_to_int)
+CONCURRENT_TPU_TASKS = register(
+    "spark.rapids.sql.concurrentGpuTasks", 2,
+    "Max concurrent tasks that may hold the device semaphore "
+    "(name kept from the reference conf surface).")
+ALLOC_FRACTION = register(
+    "spark.rapids.memory.gpu.allocFraction", 0.85,
+    "Fraction of device HBM the buffer pool may use.")
+POOL_MODE = register(
+    "spark.rapids.memory.gpu.pool", "ARENA",
+    "Device pool mode: NONE or ARENA (preallocated HBM arena).")
+HOST_SPILL_LIMIT = register(
+    "spark.rapids.memory.host.spillStorageSize", 8 << 30,
+    "Bytes of host memory usable for spilled device buffers before "
+    "falling through to disk.", conv=_bytes_conv)
+SPILL_DIR = register(
+    "spark.rapids.memory.spillDir", "/tmp/rapids_tpu_spill",
+    "Directory for disk-tier spill files.")
+OOM_RETRY_ENABLED = register(
+    "spark.rapids.sql.oomRetry.enabled", True,
+    "Enable the task-level retry/split-and-retry framework on device OOM.")
+OOM_MAX_SPLITS = register(
+    "spark.rapids.sql.oomRetry.maxSplits", 8,
+    "Max times an input batch may be split in half under OOM retry.")
+
+# --- Shuffle --------------------------------------------------------------
+SHUFFLE_MODE = register(
+    "spark.rapids.shuffle.mode", "MULTITHREADED",
+    "Shuffle transport: HOST (single-thread Arrow files), MULTITHREADED "
+    "(parallel codec threads), ICI (SPMD all-to-all collectives over the "
+    "device mesh when the whole mesh participates).")
+SHUFFLE_COMPRESSION = register(
+    "spark.rapids.shuffle.compression.codec", "lz4",
+    "Codec for host shuffle partitions: none, lz4, zstd, snappy.")
+SHUFFLE_THREADS = register(
+    "spark.rapids.shuffle.multiThreaded.writer.threads", 4,
+    "Serialization/compression threads for MULTITHREADED shuffle.")
+SHUFFLE_PARTITIONS = register(
+    "spark.sql.shuffle.partitions", 16,
+    "Default partition count for exchanges (Spark conf name).")
+ICI_MAX_PAYLOAD = register(
+    "spark.rapids.shuffle.ici.maxPartitionBytes", 256 << 20,
+    "Per-shard payload bucket ceiling for the ICI all-to-all exchange.",
+    conv=_bytes_conv)
+
+# --- IO -------------------------------------------------------------------
+PARQUET_ENABLED = register(
+    "spark.rapids.sql.format.parquet.enabled", True,
+    "Enable TPU-accelerated Parquet input/output.")
+PARQUET_READER_TYPE = register(
+    "spark.rapids.sql.format.parquet.reader.type", "MULTITHREADED",
+    "PERFILE, MULTITHREADED (parallel footer+data fetch), or COALESCING "
+    "(merge small files into one decode).")
+PARQUET_MULTITHREADED_THREADS = register(
+    "spark.rapids.sql.format.parquet.multiThreadedRead.numThreads", 8,
+    "Reader thread pool size for MULTITHREADED parquet.")
+CSV_ENABLED = register(
+    "spark.rapids.sql.format.csv.enabled", True,
+    "Enable accelerated CSV reads.")
+JSON_ENABLED = register(
+    "spark.rapids.sql.format.json.enabled", True,
+    "Enable accelerated JSON reads.")
+ORC_ENABLED = register(
+    "spark.rapids.sql.format.orc.enabled", True,
+    "Enable accelerated ORC reads/writes.")
+MAX_PARTITION_BYTES = register(
+    "spark.sql.files.maxPartitionBytes", 128 << 20,
+    "Split files into partitions of at most this many bytes.",
+    conv=_bytes_conv)
+
+# --- UDF ------------------------------------------------------------------
+UDF_COMPILER_ENABLED = register(
+    "spark.rapids.sql.udfCompiler.enabled", True,
+    "Translate simple Python UDF bytecode into engine expressions so they "
+    "run on TPU (reference: JVM bytecode udf-compiler).")
+
+# --- Metrics / debug ------------------------------------------------------
+METRICS_LEVEL = register(
+    "spark.rapids.sql.metrics.level", "MODERATE",
+    "ESSENTIAL, MODERATE, or DEBUG operator metric collection.")
+MEM_DEBUG = register(
+    "spark.rapids.memory.gpu.debug", "NONE",
+    "NONE or STDOUT: log every device buffer alloc/free.")
+LEAK_DEBUG = register(
+    "spark.rapids.refcount.debug", False,
+    "Track buffer refcount leaks and report at shutdown with alloc sites.")
+TEST_RETRY_OOM_INJECT = register(
+    "spark.rapids.sql.test.injectRetryOOM", 0,
+    "Testing: force a synthetic device OOM after N allocations "
+    "(0 = disabled).", internal=True)
+STUB_DISTRIBUTED = register(
+    "spark.rapids.sql.test.mockTransport", False,
+    "Testing: use the in-process mock shuffle transport.", internal=True)
+
+
+class RapidsConf:
+    """Immutable snapshot of settings, read once per query/executor like the
+    reference's RapidsConf."""
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self._settings = dict(settings or {})
+
+    def get(self, entry_or_key):
+        if isinstance(entry_or_key, ConfEntry):
+            e = entry_or_key
+        else:
+            e = ENTRIES.get(entry_or_key)
+            if e is None:
+                return self._settings.get(entry_or_key)
+        if e.key in self._settings:
+            return e.conv(self._settings[e.key])
+        return e.default
+
+    def is_op_enabled(self, kind: str, name: str) -> bool:
+        """Per-op kill switch: spark.rapids.sql.exec.<Name> /
+        .expression.<Name> / .input.<Name> — default on; any falsy value
+        disables the op on TPU."""
+        v = self._settings.get(f"spark.rapids.sql.{kind}.{name}")
+        if v is None:
+            return True
+        return _to_bool(v)
+
+    def with_settings(self, extra: Dict[str, Any]) -> "RapidsConf":
+        s = dict(self._settings)
+        s.update(extra)
+        return RapidsConf(s)
+
+    def set(self, key, value):
+        self._settings[key] = value
+
+    def unset(self, key):
+        self._settings.pop(key, None)
+
+    def items(self):
+        return dict(self._settings)
+
+    # Convenience accessors used on hot paths
+    @property
+    def batch_size_rows(self):
+        return self.get(BATCH_SIZE_ROWS)
+
+    @property
+    def batch_size_bytes(self):
+        return self.get(BATCH_SIZE_BYTES)
+
+    @property
+    def sql_enabled(self):
+        return self.get(SQL_ENABLED)
+
+    @property
+    def ansi(self):
+        return self.get(ANSI_ENABLED)
+
+
+def generate_docs() -> str:
+    """docs/configs.md generated from the registry, as the reference does."""
+    lines = ["# Configuration", "",
+             "Generated from `spark_rapids_tpu/config.py` — do not edit.", "",
+             "| Key | Default | Description |", "|---|---|---|"]
+    for key in sorted(ENTRIES):
+        e = ENTRIES[key]
+        if e.internal:
+            continue
+        lines.append(f"| `{e.key}` | `{e.default}` | {e.doc} |")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,27 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (the multi-chip sharding paths are
+validated without TPU hardware, mirroring the reference's mock-transport
+testing strategy — SURVEY.md §4.3). Must set XLA flags before jax imports.
+"""
+import os
+
+# The axon sitecustomize pins JAX_PLATFORMS=axon (real TPU); tests must run
+# on the virtual CPU mesh, so assign (not setdefault) before jax init.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,89 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import datatypes as dt
+from spark_rapids_tpu.columnar import (arrow_to_device, device_to_arrow,
+                                       bucket_rows)
+
+
+def roundtrip(rb: pa.RecordBatch) -> pa.RecordBatch:
+    return device_to_arrow(arrow_to_device(rb))
+
+
+def test_bucket_rows():
+    assert bucket_rows(0) == 128
+    assert bucket_rows(128) == 128
+    assert bucket_rows(129) == 256
+    assert bucket_rows(1000) == 1024
+
+
+@pytest.mark.parametrize("atype,values", [
+    (pa.int32(), [1, 2, None, -7, 2**31 - 1]),
+    (pa.int64(), [None, 0, -(2**63), 2**63 - 1]),
+    (pa.int8(), [1, None, -128, 127]),
+    (pa.int16(), [300, None, -32768]),
+    (pa.float32(), [1.5, None, float("nan"), float("inf")]),
+    (pa.float64(), [None, -0.0, 1e300, float("-inf")]),
+    (pa.bool_(), [True, None, False, True]),
+])
+def test_fixed_width_roundtrip(atype, values):
+    rb = pa.record_batch({"a": pa.array(values, type=atype)})
+    out = roundtrip(rb)
+    assert out.column(0).equals(rb.column(0)) or (
+        # NaN != NaN under Arrow equals; compare via numpy
+        np.array_equal(out.column(0).to_numpy(zero_copy_only=False),
+                       rb.column(0).to_numpy(zero_copy_only=False),
+                       equal_nan=True))
+
+
+def test_string_roundtrip():
+    vals = ["hello", "", None, "wörld", "a" * 1000, None, "x"]
+    rb = pa.record_batch({"s": pa.array(vals, type=pa.string())})
+    out = roundtrip(rb)
+    assert out.column(0).to_pylist() == vals
+
+
+def test_binary_roundtrip():
+    vals = [b"\x00\x01", None, b"", b"abc"]
+    rb = pa.record_batch({"b": pa.array(vals, type=pa.binary())})
+    assert roundtrip(rb).column(0).to_pylist() == vals
+
+
+def test_date_timestamp_roundtrip():
+    import datetime
+    d = [datetime.date(2020, 1, 1), None, datetime.date(1969, 12, 31)]
+    ts = [datetime.datetime(2021, 6, 1, 12, 30, 15, 123456), None, None]
+    rb = pa.record_batch({
+        "d": pa.array(d, type=pa.date32()),
+        "t": pa.array(ts, type=pa.timestamp("us", tz="UTC")),
+    })
+    out = roundtrip(rb)
+    assert out.column(0).to_pylist() == d
+    got = out.column(1).to_pylist()
+    assert got[1] is None and got[2] is None
+    assert got[0].replace(tzinfo=None) == ts[0]
+
+
+def test_decimal_roundtrip():
+    import decimal
+    vals = [decimal.Decimal("123.45"), None, decimal.Decimal("-0.01"),
+            decimal.Decimal("99999999999999.99")]
+    rb = pa.record_batch({"d": pa.array(vals, type=pa.decimal128(16, 2))})
+    assert roundtrip(rb).column(0).to_pylist() == vals
+
+
+def test_sliced_input():
+    arr = pa.array(["aa", "bb", "cc", "dd", None, "ff"]).slice(2, 3)
+    rb = pa.record_batch({"s": arr})
+    assert roundtrip(rb).column(0).to_pylist() == ["cc", "dd", None]
+
+
+def test_schema_mapping():
+    rb = pa.record_batch({"i": pa.array([1], pa.int32()),
+                          "s": pa.array(["x"])})
+    b = arrow_to_device(rb)
+    assert b.schema.names == ["i", "s"]
+    assert b.schema.types == [dt.INT32, dt.STRING]
+    assert b.num_rows == 1
+    assert b.capacity == 128
