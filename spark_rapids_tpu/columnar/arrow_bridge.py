@@ -46,8 +46,13 @@ def _fixed_values(arr: pa.Array, t: dt.DataType) -> np.ndarray:
     if pa.types.is_date32(atype):
         arr = arr.view(pa.int32())
     elif pa.types.is_timestamp(atype):
+        if atype.unit != "us":  # ns (pandas default) / ms / s inputs
+            arr = arr.cast(pa.timestamp("us", tz=atype.tz))
         arr = arr.view(pa.int64())
     elif pa.types.is_decimal(atype):
+        if not pa.types.is_decimal128(atype):
+            arr = arr.cast(pa.decimal128(atype.precision, atype.scale))
+            atype = arr.type
         # decimal128 little-endian: low 8 bytes == value when it fits int64
         assert atype.precision <= dt.DecimalType.MAX_INT64_PRECISION, \
             "decimal128 > 18 digits not yet on device"

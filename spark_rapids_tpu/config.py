@@ -204,8 +204,10 @@ STUB_DISTRIBUTED = register(
 
 
 class RapidsConf:
-    """Immutable snapshot of settings, read once per query/executor like the
-    reference's RapidsConf."""
+    """Settings snapshot, read once per query/executor like the reference's
+    RapidsConf. Treat instances handed to a query as frozen: derive changed
+    configurations with ``with_settings``; ``set``/``unset`` exist for the
+    session-level mutable conf only (SparkConf analog)."""
 
     def __init__(self, settings: Optional[Dict[str, Any]] = None):
         self._settings = dict(settings or {})

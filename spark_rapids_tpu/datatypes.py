@@ -300,6 +300,10 @@ def common_type(a: DataType, b: DataType) -> DataType:
     """Spark's implicit-cast numeric widening (simplified TypeCoercion)."""
     if a == b:
         return a
+    if isinstance(a, NullType):
+        return b
+    if isinstance(b, NullType):
+        return a
     if isinstance(a, DecimalType) or isinstance(b, DecimalType):
         if isinstance(a, DecimalType) and isinstance(b, DecimalType):
             scale = max(a.scale, b.scale)
@@ -312,10 +316,6 @@ def common_type(a: DataType, b: DataType) -> DataType:
             p = widths[type(other)]
             return common_type(dec, DecimalType(min(p, 38), 0))
         return FLOAT64
-    if isinstance(a, NullType):
-        return b
-    if isinstance(b, NullType):
-        return a
     try:
         ia = _NUMERIC_ORDER.index(type(a))
         ib = _NUMERIC_ORDER.index(type(b))

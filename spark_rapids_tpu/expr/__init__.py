@@ -1,0 +1,24 @@
+from .base import (Expression, BoundReference, UnresolvedColumn, Literal,
+                   Alias, EvalCtx, ExprError, bind_expr, infer_literal_type)
+from .arithmetic import (Add, Subtract, Multiply, Divide, IntegralDivide,
+                         Remainder, Pmod, UnaryMinus, Abs)
+from .predicates import (EqualTo, EqualNullSafe, LessThan, LessThanOrEqual,
+                         GreaterThan, GreaterThanOrEqual, And, Or, Not,
+                         IsNull, IsNotNull, IsNaN, In)
+from .conditional import If, CaseWhen, Coalesce, Least, Greatest, NullIf
+from .cast import Cast
+from .math import (Sqrt, Cbrt, Exp, Expm1, Log, Log10, Log2, Log1p, Sin,
+                   Cos, Tan, Asin, Acos, Atan, Sinh, Cosh, Tanh, Signum,
+                   ToDegrees, ToRadians, Floor, Ceil, Rint, Pow, Atan2,
+                   Hypot, Round, BRound)
+from .datetime import (Year, Month, DayOfMonth, Quarter, DayOfWeek, WeekDay,
+                       DayOfYear, LastDay, Hour, Minute, Second, DateAdd,
+                       DateSub, DateDiff, AddMonths, MonthsBetween,
+                       TruncDate, UnixTimestamp, FromUnixTime, UnixMicros,
+                       MicrosToTimestamp)
+from .strings import (Length, Upper, Lower, Substring, ConcatStrings,
+                      StartsWith, EndsWith, Contains, Like, StringTrim,
+                      StringTrimLeft, StringTrimRight, StringReplace,
+                      RegExpLike, RegExpReplace, RegExpExtract,
+                      StringLocate, StringLpad, StringRpad, StringRepeat,
+                      Reverse)

@@ -1,0 +1,364 @@
+"""Arithmetic expressions (reference: sql-plugin arithmetic.scala /
+decimalExpressions.scala family — SURVEY.md §2.2-C; built from capability
+description, mount empty).
+
+Spark semantics implemented on both paths:
+- non-ANSI: integer overflow wraps two's-complement (Java), div/mod by zero
+  -> null; ANSI: those raise.
+- remainder/pmod follow Java sign rules.
+- decimal arithmetic on the int64 unscaled lane with result scale per
+  Spark's DecimalPrecision rules (simplified: add/sub keep max scale,
+  multiply adds scales, divide rescales to Spark's computed scale).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import datatypes as dt
+from ..columnar.column import TpuColumnVector
+from .base import (Expression, EvalCtx, ExprError, np_valid_and_values,
+                   np_result_to_arrow)
+
+__all__ = ["Add", "Subtract", "Multiply", "Divide", "IntegralDivide",
+           "Remainder", "Pmod", "UnaryMinus", "Abs", "result_decimal_type"]
+
+
+def _wrap_int(values: np.ndarray, lane) -> np.ndarray:
+    """Two's-complement wrap to the lane width (Java overflow)."""
+    info = np.iinfo(lane)
+    span = 1 << (info.bits)
+    v = values.astype(object) if values.dtype == object else values
+    return ((values.astype(np.int64) - info.min) % span + info.min) \
+        .astype(lane) if lane != np.int64 else values.astype(np.int64)
+
+
+def result_decimal_type(op: str, a: dt.DecimalType,
+                        b: dt.DecimalType) -> dt.DecimalType:
+    """Spark DecimalPrecision result types (capped at 38)."""
+    p1, s1, p2, s2 = a.precision, a.scale, b.precision, b.scale
+    if op in ("add", "sub"):
+        scale = max(s1, s2)
+        prec = max(p1 - s1, p2 - s2) + scale + 1
+    elif op == "mul":
+        scale = s1 + s2
+        prec = p1 + p2 + 1
+    elif op == "div":
+        scale = max(6, s1 + p2 + 1)
+        prec = p1 - s1 + s2 + scale
+    elif op == "mod":
+        scale = max(s1, s2)
+        prec = min(p1 - s1, p2 - s2) + scale
+    else:
+        raise ValueError(op)
+    return dt.DecimalType(min(prec, 38), min(scale, 38))
+
+
+class BinaryArithmetic(Expression):
+    symbol = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def validate(self):
+        left, right = self.children
+        if left.dtype != right.dtype and not (
+                isinstance(left.dtype, dt.DecimalType)
+                and isinstance(right.dtype, dt.DecimalType)):
+            raise TypeError(
+                f"{type(self).__name__} children differ: "
+                f"{left.dtype} vs {right.dtype} (insert casts first)")
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    @property
+    def dtype(self):
+        return self.left.dtype
+
+    # TPU path ------------------------------------------------------------
+    def eval_tpu(self, batch, ctx):
+        l = self.left.eval_tpu(batch, ctx)
+        r = self.right.eval_tpu(batch, ctx)
+        data, extra_valid = self._compute_tpu(l.data, r.data, ctx)
+        valid = l.validity & r.validity
+        if extra_valid is not None:
+            valid = valid & extra_valid
+        return TpuColumnVector(self.dtype, data=data, validity=valid)
+
+    # CPU path ------------------------------------------------------------
+    def eval_cpu(self, rb, ctx):
+        lt = self.left.dtype
+        lv, lvalid = np_valid_and_values(self.left.eval_cpu(rb, ctx), lt)
+        rv, rvalid = np_valid_and_values(self.right.eval_cpu(rb, ctx),
+                                         self.right.dtype)
+        valid = lvalid & rvalid
+        with np.errstate(all="ignore"):
+            values, extra_valid = self._compute_cpu(lv, rv, valid, ctx)
+        if extra_valid is not None:
+            valid = valid & extra_valid
+        return np_result_to_arrow(values, valid, self.dtype)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} {self.symbol} {self.children[1]!r})"
+
+
+class Add(BinaryArithmetic):
+    symbol = "+"
+
+    def _compute_tpu(self, l, r, ctx):
+        return l + r, None
+
+    def _compute_cpu(self, l, r, valid, ctx):
+        if dt.is_integral(self.dtype):
+            lane = self.dtype.np_dtype
+            wide = l.astype(np.int64) + r.astype(np.int64)
+            if ctx.ansi:
+                _check_int_overflow(wide, lane, valid, "add")
+            return wide.astype(lane), None
+        return l + r, None
+
+
+class Subtract(BinaryArithmetic):
+    symbol = "-"
+
+    def _compute_tpu(self, l, r, ctx):
+        return l - r, None
+
+    def _compute_cpu(self, l, r, valid, ctx):
+        if dt.is_integral(self.dtype):
+            lane = self.dtype.np_dtype
+            wide = l.astype(np.int64) - r.astype(np.int64)
+            if ctx.ansi:
+                _check_int_overflow(wide, lane, valid, "subtract")
+            return wide.astype(lane), None
+        return l - r, None
+
+
+class Multiply(BinaryArithmetic):
+    symbol = "*"
+
+    @property
+    def dtype(self):
+        lt = self.left.dtype
+        if isinstance(lt, dt.DecimalType):
+            return result_decimal_type("mul", lt, self.right.dtype)
+        return lt
+
+    def _compute_tpu(self, l, r, ctx):
+        # decimal: unscaled multiply keeps scale s1+s2 == result scale
+        return l * r, None
+
+    def _compute_cpu(self, l, r, valid, ctx):
+        if isinstance(self.dtype, dt.DecimalType) or dt.is_integral(self.dtype):
+            return (l.astype(np.int64) * r.astype(np.int64)).astype(
+                self.dtype.np_dtype), None
+        return l * r, None
+
+
+class Divide(BinaryArithmetic):
+    """Spark `/`: operands are double or decimal (analyzer casts ints)."""
+    symbol = "/"
+
+    @property
+    def dtype(self):
+        lt = self.left.dtype
+        if isinstance(lt, dt.DecimalType):
+            return result_decimal_type("div", lt, self.right.dtype)
+        return lt
+
+    @property
+    def _result(self):
+        return self.dtype
+
+    def _compute_tpu(self, l, r, ctx):
+        if isinstance(self._result, dt.DecimalType):
+            lt = self.left.dtype
+            rt = self.right.dtype
+            # unscaled result = l * 10^(rs + resscale - ls) / r, rounded
+            shift = self._result.scale + rt.scale - lt.scale
+            num = l * jnp.int64(10 ** shift)
+            safe_r = jnp.where(r == 0, 1, r)
+            q = _div_half_up_j(num, safe_r)
+            return q, r != 0
+        safe = jnp.where(r == 0.0, 1.0, r)
+        out = l / safe
+        return jnp.where(r == 0.0, jnp.nan, out), r != 0.0
+
+    def _compute_cpu(self, l, r, valid, ctx):
+        nz = r != 0
+        if ctx.ansi and bool((~nz & valid).any()):
+            raise ExprError("division by zero")
+        if isinstance(self._result, dt.DecimalType):
+            lt, rt = self.left.dtype, self.right.dtype
+            shift = self._result.scale + rt.scale - lt.scale
+            num = l.astype(object) * (10 ** shift)
+            den = np.where(nz, r, 1).astype(object)
+            q = _div_half_up_obj(num, den)
+            return np.where(nz, q, 0).astype(np.int64), nz
+        out = np.divide(l, np.where(nz, r, 1.0))
+        return out, nz
+
+
+class IntegralDivide(BinaryArithmetic):
+    """Spark `div`: long division of integral/decimal operands -> long."""
+    symbol = "div"
+
+    @property
+    def dtype(self):
+        return dt.INT64
+
+    def _compute_tpu(self, l, r, ctx):
+        li = l.astype(jnp.int64)  # widen first: abs(INT8_MIN) overflows int8
+        safe = jnp.where(r == 0, 1, r).astype(jnp.int64)
+        q = jnp.sign(li) * jnp.sign(safe) * (jnp.abs(li) // jnp.abs(safe))
+        return q.astype(jnp.int64), r != 0
+
+    def _compute_cpu(self, l, r, valid, ctx):
+        nz = r != 0
+        if ctx.ansi and bool((~nz & valid).any()):
+            raise ExprError("division by zero")
+        safe = np.where(nz, r, 1)
+        # Java truncates toward zero; numpy // floors.
+        q = (np.sign(l) * np.sign(safe) *
+             (np.abs(l.astype(np.int64)) // np.abs(safe.astype(np.int64))))
+        return q.astype(np.int64), nz
+
+
+class Remainder(BinaryArithmetic):
+    """% with Java sign semantics (result sign follows dividend)."""
+    symbol = "%"
+
+    def _compute_tpu(self, l, r, ctx):
+        if dt.is_floating(self.dtype):
+            safe = jnp.where(r == 0, 1.0, r)
+            m = jnp.fmod(l, safe)  # fmod keeps dividend sign: Java semantics
+            return m, r != 0
+        li = l.astype(jnp.int64)
+        safe = jnp.where(r == 0, 1, r).astype(jnp.int64)
+        m = li - safe * (jnp.sign(li) * jnp.sign(safe)
+                         * (jnp.abs(li) // jnp.abs(safe)))
+        return m.astype(l.dtype), r != 0
+
+    def _compute_cpu(self, l, r, valid, ctx):
+        nz = r != 0
+        if ctx.ansi and bool((~nz & valid).any()):
+            raise ExprError("division by zero")
+        if dt.is_floating(self.dtype):
+            return np.fmod(l, np.where(nz, r, 1.0)), nz
+        safe = np.where(nz, r, 1).astype(np.int64)
+        li = l.astype(np.int64)
+        q = np.sign(li) * np.sign(safe) * (np.abs(li) // np.abs(safe))
+        return (li - safe * q).astype(self.dtype.np_dtype), nz
+
+
+class Pmod(BinaryArithmetic):
+    """Positive modulus."""
+    symbol = "pmod"
+
+    def _compute_tpu(self, l, r, ctx):
+        safe = jnp.where(r == 0, 1, r)
+        if dt.is_floating(self.dtype):
+            m = jnp.fmod(l, safe)
+            m = jnp.where(m < 0, m + jnp.abs(safe), m)
+            return m, r != 0
+        li = l.astype(jnp.int64)
+        si = safe.astype(jnp.int64)
+        m = li - si * (jnp.sign(li) * jnp.sign(si)
+                       * (jnp.abs(li) // jnp.abs(si)))
+        m = jnp.where(m < 0, m + jnp.abs(si), m)
+        return m.astype(l.dtype), r != 0
+
+    def _compute_cpu(self, l, r, valid, ctx):
+        nz = r != 0
+        safe = np.where(nz, r, 1)
+        if dt.is_floating(self.dtype):
+            m = np.fmod(l, safe)
+            m = np.where(m < 0, m + np.abs(safe), m)
+            return m, nz
+        li = l.astype(np.int64)
+        s = safe.astype(np.int64)
+        q = np.sign(li) * np.sign(s) * (np.abs(li) // np.abs(s))
+        m = li - s * q
+        m = np.where(m < 0, m + np.abs(s), m)
+        return m.astype(self.dtype.np_dtype), nz
+
+
+class UnaryMinus(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        return TpuColumnVector(self.dtype, data=-c.data, validity=c.validity)
+
+    def eval_cpu(self, rb, ctx):
+        t = self.dtype
+        v, valid = np_valid_and_values(self.children[0].eval_cpu(rb, ctx), t)
+        return np_result_to_arrow(-v, valid, t)
+
+
+class Abs(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        return TpuColumnVector(self.dtype, data=jnp.abs(c.data),
+                               validity=c.validity)
+
+    def eval_cpu(self, rb, ctx):
+        t = self.dtype
+        v, valid = np_valid_and_values(self.children[0].eval_cpu(rb, ctx), t)
+        return np_result_to_arrow(np.abs(v), valid, t)
+
+
+# --- helpers -------------------------------------------------------------
+
+def _check_int_overflow(wide: np.ndarray, lane, valid, opname):
+    info = np.iinfo(lane)
+    bad = ((wide > info.max) | (wide < info.min)) & valid
+    if bool(bad.any()):
+        raise ExprError(f"integer overflow in {opname} (ANSI mode)")
+
+
+def _div_half_up_j(num, den):
+    """ROUND_HALF_UP integer division on device (Spark decimal rounding)."""
+    q = num // den
+    rem = num - q * den
+    # round away from zero when |rem|*2 >= |den|
+    adj = jnp.where((jnp.abs(rem) * 2 >= jnp.abs(den)) & (rem != 0),
+                    jnp.sign(num) * jnp.sign(den), 0)
+    # floor-div quotient: fix toward-zero first
+    tz = jnp.where((rem != 0) & ((num < 0) != (den < 0)), q + 1, q)
+    rem_tz = num - tz * den
+    adj = jnp.where(jnp.abs(rem_tz) * 2 >= jnp.abs(den),
+                    jnp.where((num < 0) != (den < 0), -1, 1), 0)
+    adj = jnp.where(rem_tz == 0, 0, adj)
+    return (tz + adj).astype(jnp.int64)
+
+
+def _div_half_up_obj(num, den):
+    out = np.empty(len(num), dtype=object)
+    for i in range(len(num)):
+        n, d = int(num[i]), int(den[i])
+        q, r = divmod(abs(n), abs(d))
+        if 2 * r >= abs(d):
+            q += 1
+        sign = -1 if (n < 0) != (d < 0) else 1
+        out[i] = sign * q
+    return out

@@ -1,0 +1,337 @@
+"""Expression framework.
+
+TPU analog of the reference's Catalyst-side expression surface (SURVEY.md
+§2.2-C; reference mount empty — built from the capability inventory). Every
+expression implements BOTH:
+
+- ``eval_tpu(batch, ctx)``  — traced under jax.jit over a TpuBatch; produces
+  a TpuColumnVector (data lane + validity lane). Whole operator pipelines
+  compose these and jit once per capacity bucket (the engine's analog of
+  whole-stage codegen).
+- ``eval_cpu(rb, ctx)``     — host reference implementation with Spark
+  semantics over a pyarrow RecordBatch. This is the fallback path AND the
+  oracle for the dual-run equivalence harness (SURVEY.md §4.1).
+
+Expressions are constructed type-resolved (like post-analysis Catalyst):
+the DataFrame layer inserts implicit casts; these classes require already-
+coercied children.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import datatypes as dt
+from ..columnar.batch import TpuBatch
+from ..columnar.column import TpuColumnVector
+
+__all__ = ["EvalCtx", "Expression", "BoundReference", "Literal", "Alias",
+           "bind_expr", "np_valid_and_values", "np_result_to_arrow"]
+
+
+@dataclasses.dataclass(frozen=True)
+class EvalCtx:
+    """Per-query evaluation context (immutable, like a RapidsConf snapshot)."""
+    ansi: bool = False
+    timezone: str = "UTC"
+    capacity: int = 0  # static batch capacity, set by the executor
+
+
+class ExprError(Exception):
+    """Raised for ANSI-mode runtime errors (overflow, bad cast, div by 0)."""
+
+
+class Expression:
+    """Base expression; children in ``children`` tuple."""
+
+    children: Tuple["Expression", ...] = ()
+
+    # --- static metadata --------------------------------------------------
+    @property
+    def dtype(self) -> dt.DataType:
+        raise NotImplementedError
+
+    @property
+    def nullable(self) -> bool:
+        return any(c.nullable for c in self.children) if self.children \
+            else True
+
+    def pretty_name(self) -> str:
+        n = type(self).__name__
+        return n[3:] if n.startswith("Tpu") else n
+
+    # --- evaluation -------------------------------------------------------
+    def eval_tpu(self, batch: TpuBatch, ctx: EvalCtx) -> TpuColumnVector:
+        raise NotImplementedError(type(self).__name__)
+
+    def eval_cpu(self, rb: pa.RecordBatch, ctx: EvalCtx) -> pa.Array:
+        raise NotImplementedError(type(self).__name__)
+
+    def validate(self) -> None:
+        """Type checks, run after binding (children types are known)."""
+
+    # --- planner hooks ----------------------------------------------------
+    def tpu_supported(self) -> Optional[str]:
+        """None if this node can run on TPU, else a human reason (the
+        willNotWorkOnGpu message). Children are checked separately."""
+        return None
+
+    def with_children(self, children: Sequence["Expression"]) -> "Expression":
+        if not children and not self.children:
+            return self
+        c = type(self).__new__(type(self))
+        c.__dict__.update(self.__dict__)
+        c.children = tuple(children)
+        return c
+
+    def transform(self, fn):
+        """Bottom-up rewrite."""
+        new_children = [c.transform(fn) for c in self.children]
+        node = self.with_children(new_children) if new_children else self
+        return fn(node)
+
+    def __repr__(self):
+        if self.children:
+            return (f"{self.pretty_name()}("
+                    + ", ".join(repr(c) for c in self.children) + ")")
+        return self.pretty_name()
+
+
+class BoundReference(Expression):
+    """Column reference resolved to an ordinal (post-bind)."""
+
+    def __init__(self, ordinal: int, dtype_: dt.DataType, nullable_: bool = True,
+                 name: str = ""):
+        self.ordinal = ordinal
+        self._dtype = dtype_
+        self._nullable = nullable_
+        self.name = name or f"c{ordinal}"
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self._nullable
+
+    def eval_tpu(self, batch, ctx):
+        return batch.columns[self.ordinal]
+
+    def eval_cpu(self, rb, ctx):
+        a = rb.column(self.ordinal)
+        return a.combine_chunks() if isinstance(a, pa.ChunkedArray) else a
+
+    def __repr__(self):
+        return f"{self.name}#{self.ordinal}"
+
+
+class UnresolvedColumn(Expression):
+    """Named column, resolved by bind_expr against a schema."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    @property
+    def dtype(self):
+        raise TypeError(f"unresolved column {self.name!r} has no type; "
+                        "bind the expression first")
+
+    def __repr__(self):
+        return f"'{self.name}"
+
+
+def _np_to_scalar_lane(value, t: dt.DataType):
+    if value is None:
+        return None
+    if isinstance(t, dt.DecimalType):
+        import decimal
+        q = decimal.Decimal(value).scaleb(t.scale)
+        return int(q)
+    if isinstance(t, dt.DateType):
+        import datetime
+        if isinstance(value, datetime.date):
+            return (value - datetime.date(1970, 1, 1)).days
+        return int(value)
+    if isinstance(t, dt.TimestampType):
+        import datetime
+        if isinstance(value, datetime.datetime):
+            if value.tzinfo is None:
+                value = value.replace(tzinfo=datetime.timezone.utc)
+            return int(value.timestamp() * 1_000_000)
+        return int(value)
+    return value
+
+
+class Literal(Expression):
+    def __init__(self, value: Any, dtype_: Optional[dt.DataType] = None):
+        if dtype_ is None:
+            dtype_ = infer_literal_type(value)
+        self._dtype = dtype_
+        self.value = value
+        self.lane_value = _np_to_scalar_lane(value, dtype_)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self.value is None
+
+    def eval_tpu(self, batch, ctx):
+        cap = batch.capacity
+        t = self._dtype
+        if self.value is None:
+            return TpuColumnVector.nulls(t, cap)
+        valid = jnp.ones((cap,), jnp.bool_)
+        if isinstance(t, (dt.StringType, dt.BinaryType)):
+            raw = self.value.encode() if isinstance(self.value, str) \
+                else bytes(self.value)
+            b = np.frombuffer(raw, np.uint8)
+            tiled = jnp.asarray(np.tile(b, cap)) if len(b) else \
+                jnp.zeros((0,), jnp.uint8)
+            offsets = (jnp.arange(cap + 1, dtype=jnp.int32) * len(b))
+            return TpuColumnVector(t, validity=valid, offsets=offsets,
+                                   chars=tiled)
+        lane = t.np_dtype
+        data = jnp.full((cap,), self.lane_value, dtype=lane)
+        return TpuColumnVector(t, data=data, validity=valid)
+
+    def eval_cpu(self, rb, ctx):
+        n = rb.num_rows
+        at = dt.to_arrow(self._dtype)
+        if self.value is None:
+            return pa.nulls(n, at)
+        return pa.array([self.value] * n, type=at)
+
+    def __repr__(self):
+        return f"lit({self.value!r})"
+
+
+class Alias(Expression):
+    def __init__(self, child: Expression, name: str):
+        self.children = (child,)
+        self.name = name
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def nullable(self):
+        return self.child.nullable
+
+    def eval_tpu(self, batch, ctx):
+        return self.child.eval_tpu(batch, ctx)
+
+    def eval_cpu(self, rb, ctx):
+        return self.child.eval_cpu(rb, ctx)
+
+    def __repr__(self):
+        return f"{self.children[0]!r} AS {self.name}"
+
+
+def infer_literal_type(value) -> dt.DataType:
+    import datetime
+    import decimal
+    if value is None:
+        return dt.NULL
+    if isinstance(value, bool):
+        return dt.BOOL
+    if isinstance(value, int):
+        return dt.INT32 if -(2**31) <= value < 2**31 else dt.INT64
+    if isinstance(value, float):
+        return dt.FLOAT64
+    if isinstance(value, str):
+        return dt.STRING
+    if isinstance(value, bytes):
+        return dt.BINARY
+    if isinstance(value, decimal.Decimal):
+        sign, digits, exp = value.as_tuple()
+        scale = max(0, -exp)
+        precision = max(len(digits), scale)
+        return dt.DecimalType(max(precision, 1), scale)
+    if isinstance(value, datetime.datetime):
+        return dt.TIMESTAMP
+    if isinstance(value, datetime.date):
+        return dt.DATE
+    raise TypeError(f"cannot infer literal type for {value!r}")
+
+
+def bind_expr(expr: Expression, schema: dt.Schema,
+              case_sensitive: bool = False) -> Expression:
+    """Resolve UnresolvedColumn nodes to BoundReference ordinals."""
+
+    def resolve(node):
+        if isinstance(node, UnresolvedColumn):
+            name = node.name
+            if case_sensitive:
+                idx = schema.index_of(name)
+            else:
+                matches = [i for i, f in enumerate(schema.fields)
+                           if f.name.lower() == name.lower()]
+                if not matches:
+                    raise KeyError(
+                        f"column {name!r} not found in {schema.names}")
+                idx = matches[0]
+            f = schema[idx]
+            return BoundReference(idx, f.dtype, f.nullable, f.name)
+        return node
+
+    bound = expr.transform(resolve)
+
+    def check(node):
+        node.validate()
+        return node
+
+    bound.transform(check)
+    return bound
+
+
+# --- numpy <-> arrow helpers shared by CPU implementations ---------------
+
+def np_valid_and_values(arr: pa.Array, t: dt.DataType):
+    """(values ndarray zero-filled, valid bool ndarray) for fixed-width."""
+    from ..columnar.arrow_bridge import _fixed_values, _valid_mask
+    valid = _valid_mask(arr)
+    if valid is None:
+        valid = np.ones(len(arr), np.bool_)
+    return _fixed_values(arr, t), valid
+
+
+def np_result_to_arrow(values: np.ndarray, valid: Optional[np.ndarray],
+                       t: dt.DataType) -> pa.Array:
+    from ..columnar.column import TpuColumnVector  # noqa
+    atype = dt.to_arrow(t)
+    mask = None
+    if valid is not None and not valid.all():
+        mask = ~valid
+    if isinstance(t, dt.DecimalType):
+        n = len(values)
+        lo = values.astype(np.int64)
+        hi = (lo >> 63).astype(np.int64)
+        pairs = np.empty((n, 2), np.int64)
+        pairs[:, 0] = lo
+        pairs[:, 1] = hi
+        null_buf = None
+        if mask is not None:
+            null_buf = pa.array(valid).buffers()[1]
+        return pa.Array.from_buffers(
+            atype, n, [null_buf, pa.py_buffer(np.ascontiguousarray(pairs))],
+            null_count=-1)
+    if isinstance(t, dt.DateType):
+        return pa.array(values.astype(np.int32), pa.int32(),
+                        mask=mask).view(pa.date32())
+    if isinstance(t, dt.TimestampType):
+        return pa.array(values.astype(np.int64), pa.int64(),
+                        mask=mask).view(atype)
+    return pa.array(values.astype(t.np_dtype, copy=False), atype, mask=mask)

@@ -1,0 +1,430 @@
+"""Cast expression — the Spark cast matrix (reference: GpuCast.scala,
+~2.5k LoC of edge cases — SURVEY.md §2.2-C; built from capability
+description, mount empty).
+
+Implemented matrix (both paths, dual-run tested):
+  numeric <-> numeric (wrap-around to integral like Java, ANSI raises)
+  numeric <-> bool
+  numeric <-> decimal (scale adjust, overflow -> null / ANSI raise)
+  float -> integral (Spark truncates toward zero; NaN/Inf -> overflow rules)
+  date <-> timestamp (UTC)
+  numeric/date/timestamp/bool -> string
+  string -> int/long/float/double/bool/date (host kernel; device falls back)
+Unsupported pairs report via tpu_supported() so the planner falls back.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import datatypes as dt
+from ..columnar.column import TpuColumnVector
+from .base import (Expression, ExprError, np_valid_and_values,
+                   np_result_to_arrow)
+
+__all__ = ["Cast"]
+
+_SECONDS_PER_DAY = 86400
+
+
+def _int_bounds(t: dt.DataType):
+    info = np.iinfo(t.np_dtype)
+    return info.min, info.max
+
+
+class Cast(Expression):
+    def __init__(self, child: Expression, to: dt.DataType,
+                 ansi: bool = False):
+        self.children = (child,)
+        self._to = to
+        self.ansi = ansi
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def dtype(self):
+        return self._to
+
+    def tpu_supported(self):
+        f, t = self.child.dtype, self._to
+        if isinstance(f, (dt.StringType, dt.BinaryType)) and not \
+                isinstance(t, (dt.StringType, dt.BinaryType)):
+            return f"cast {f} -> {t} runs on host (string parsing)"
+        if isinstance(t, (dt.StringType,)) and isinstance(
+                f, (dt.FloatType, dt.DoubleType)):
+            return "float->string formatting runs on host (Java repr)"
+        if isinstance(t, dt.StringType) and isinstance(f, dt.TimestampType):
+            return "timestamp->string formatting runs on host"
+        return None
+
+    # ------------------------------------------------------------------
+    def eval_tpu(self, batch, ctx):
+        f, t = self.child.dtype, self._to
+        c = self.child.eval_tpu(batch, ctx)
+        if f == t:
+            return c
+        if isinstance(t, dt.StringType):
+            return self._to_string_tpu(c, f, batch, ctx)
+        data, valid_extra = self._num_cast_tpu(c.data, f, t, ctx)
+        valid = c.validity if valid_extra is None else \
+            c.validity & valid_extra
+        return TpuColumnVector(t, data=data, validity=valid)
+
+    def _num_cast_tpu(self, x, f, t, ctx):
+        if isinstance(f, dt.BooleanType):
+            if isinstance(t, dt.DecimalType):
+                return x.astype(jnp.int64) * (10 ** t.scale), None
+            return x.astype(t.np_dtype), None
+        if isinstance(t, dt.BooleanType):
+            if isinstance(f, dt.DecimalType):
+                return x != 0, None
+            return x != 0, None
+        if isinstance(f, dt.DecimalType):
+            if isinstance(t, dt.DecimalType):
+                return _rescale_tpu(x, f.scale, t.scale, t), None
+            if dt.is_integral(t):
+                v = _div_trunc_j(x, 10 ** f.scale)
+                lo, hi = _int_bounds(t)
+                ok = (v >= lo) & (v <= hi)
+                return v.astype(t.np_dtype), ok
+            if dt.is_floating(t):
+                return (x.astype(jnp.float64)
+                        / (10.0 ** f.scale)).astype(t.np_dtype), None
+        if isinstance(t, dt.DecimalType):
+            if dt.is_integral(f):
+                v = x.astype(jnp.int64) * (10 ** t.scale)
+                lim = 10 ** t.precision
+                ok = (v > -lim) & (v < lim)
+                return v, ok
+            if dt.is_floating(f):
+                scaled = x.astype(jnp.float64) * (10.0 ** t.scale)
+                v = jnp.round(scaled).astype(jnp.int64)
+                lim = 10 ** t.precision
+                ok = jnp.isfinite(x) & (scaled > -lim) & (scaled < lim)
+                return v, ok
+        if isinstance(f, dt.DateType):
+            if isinstance(t, dt.TimestampType):
+                return x.astype(jnp.int64) * (_SECONDS_PER_DAY * 1_000_000), \
+                    None
+        if isinstance(f, dt.TimestampType):
+            if isinstance(t, dt.DateType):
+                us_per_day = _SECONDS_PER_DAY * 1_000_000
+                return jnp.floor_divide(x, us_per_day).astype(jnp.int32), None
+            if dt.is_integral(t) or dt.is_floating(t):
+                secs = x.astype(jnp.float64) / 1e6 if dt.is_floating(t) \
+                    else jnp.floor_divide(x, 1_000_000)
+                return secs.astype(t.np_dtype), None
+        if dt.is_integral(f) and isinstance(t, dt.TimestampType):
+            return x.astype(jnp.int64) * 1_000_000, None
+        if dt.is_floating(f) and dt.is_integral(t):
+            # Java (long)/(int) cast: truncate toward zero, saturate at
+            # bounds. float lanes cannot represent 2^31-1 / 2^63-1 exactly,
+            # so saturation must be where-based, not clip+astype.
+            lo, hi = _int_bounds(t)
+            bits = np.iinfo(t.np_dtype).bits
+            ok = ~jnp.isnan(x)
+            w = x.astype(jnp.float64)
+            trunc = jnp.trunc(w)
+            too_big = trunc >= float(1 << (bits - 1))
+            too_small = trunc <= float(-(1 << (bits - 1)) - 1)
+            mid = jnp.where(too_big | too_small | ~ok, 0.0, trunc)
+            out = jnp.where(too_big, hi,
+                            jnp.where(too_small, lo,
+                                      mid.astype(jnp.int64)))
+            return out.astype(t.np_dtype), ok
+        if dt.is_integral(f) and dt.is_integral(t):
+            # Java narrowing: wrap two's-complement
+            bits = np.iinfo(t.np_dtype).bits
+            if bits == 64:
+                return x.astype(jnp.int64), None
+            v = x.astype(jnp.int64)
+            span = jnp.int64(1) << bits
+            half = jnp.int64(1) << (bits - 1)
+            w = ((v + half) % span + span) % span - half
+            return w.astype(t.np_dtype), None
+        # remaining numeric widenings / float conversions
+        return x.astype(t.np_dtype), None
+
+    def _to_string_tpu(self, c, f, batch, ctx):
+        # Integral/bool/date -> string entirely on device (digit generation)
+        from ..ops.numeric_format import (int_to_string_tpu,
+                                          bool_to_string_tpu,
+                                          date_to_string_tpu)
+        if dt.is_integral(f):
+            return int_to_string_tpu(c)
+        if isinstance(f, dt.BooleanType):
+            return bool_to_string_tpu(c)
+        if isinstance(f, dt.DateType):
+            return date_to_string_tpu(c)
+        if isinstance(f, dt.DecimalType):
+            from ..ops.numeric_format import decimal_to_string_tpu
+            return decimal_to_string_tpu(c, f.scale)
+        raise NotImplementedError(f"cast {f} -> string on device")
+
+    # ------------------------------------------------------------------
+    def eval_cpu(self, rb, ctx):
+        f, t = self.child.dtype, self._to
+        a = self.child.eval_cpu(rb, ctx)
+        if f == t:
+            return a
+        if isinstance(f, (dt.StringType,)):
+            return self._from_string_cpu(a, t, ctx)
+        if isinstance(t, dt.StringType):
+            return self._to_string_cpu(a, f, ctx)
+        v, valid = np_valid_and_values(a, f)
+        out, extra = self._num_cast_cpu(v, f, t, ctx, valid)
+        if extra is not None:
+            if ctx.ansi and bool((~extra & valid).any()):
+                raise ExprError(f"cast overflow {f}->{t} (ANSI)")
+            valid = valid & extra
+        return np_result_to_arrow(out, valid, t)
+
+    def _num_cast_cpu(self, x, f, t, ctx, valid):
+        with np.errstate(all="ignore"):
+            if isinstance(f, dt.BooleanType):
+                if isinstance(t, dt.DecimalType):
+                    return x.astype(np.int64) * (10 ** t.scale), None
+                return x.astype(t.np_dtype), None
+            if isinstance(t, dt.BooleanType):
+                return x != 0, None
+            if isinstance(f, dt.DecimalType):
+                if isinstance(t, dt.DecimalType):
+                    return _rescale_np(x, f.scale, t.scale, t)
+                if dt.is_integral(t):
+                    v = _div_trunc_np(x.astype(np.int64), 10 ** f.scale)
+                    lo, hi = _int_bounds(t)
+                    return v.astype(t.np_dtype), (v >= lo) & (v <= hi)
+                if dt.is_floating(t):
+                    return (x.astype(np.float64) / 10.0 ** f.scale
+                            ).astype(t.np_dtype), None
+            if isinstance(t, dt.DecimalType):
+                if dt.is_integral(f):
+                    v = x.astype(np.int64) * (10 ** t.scale)
+                    lim = 10 ** t.precision
+                    return v, (v > -lim) & (v < lim)
+                if dt.is_floating(f):
+                    scaled = x.astype(np.float64) * (10.0 ** t.scale)
+                    with np.errstate(invalid="ignore"):
+                        v = np.where(np.isfinite(scaled),
+                                     np.round(scaled), 0).astype(np.int64)
+                    lim = 10 ** t.precision
+                    ok = np.isfinite(x) & (scaled > -lim) & (scaled < lim)
+                    return v, ok
+            if isinstance(f, dt.DateType) and isinstance(t, dt.TimestampType):
+                return x.astype(np.int64) * (_SECONDS_PER_DAY * 1_000_000), \
+                    None
+            if isinstance(f, dt.TimestampType):
+                if isinstance(t, dt.DateType):
+                    us = _SECONDS_PER_DAY * 1_000_000
+                    return np.floor_divide(x, us).astype(np.int32), None
+                if dt.is_integral(t):
+                    return np.floor_divide(x, 1_000_000).astype(t.np_dtype), \
+                        None
+                if dt.is_floating(t):
+                    return (x / 1e6).astype(t.np_dtype), None
+            if dt.is_integral(f) and isinstance(t, dt.TimestampType):
+                return x.astype(np.int64) * 1_000_000, None
+            if dt.is_floating(f) and dt.is_integral(t):
+                lo, hi = _int_bounds(t)
+                bits = np.iinfo(t.np_dtype).bits
+                ok = ~np.isnan(x)
+                w = x.astype(np.float64)
+                trunc = np.where(np.isnan(w), 0.0, np.trunc(w))
+                too_big = trunc >= float(1 << (bits - 1))
+                too_small = trunc <= float(-(1 << (bits - 1)) - 1)
+                mid = np.where(too_big | too_small, 0.0, trunc)
+                out = np.where(too_big, hi,
+                               np.where(too_small, lo,
+                                        mid.astype(np.int64)))
+                return out.astype(t.np_dtype), ok
+            if dt.is_integral(f) and dt.is_integral(t):
+                bits = np.iinfo(t.np_dtype).bits
+                if bits == 64:
+                    return x.astype(np.int64), None
+                v = x.astype(np.int64)
+                span = 1 << bits
+                half = 1 << (bits - 1)
+                w = ((v + half) % span + span) % span - half
+                return w.astype(t.np_dtype), None
+            return x.astype(t.np_dtype), None
+
+    def _to_string_cpu(self, a, f, ctx):
+        if isinstance(f, (dt.FloatType, dt.DoubleType)):
+            # Java Float/Double.toString formatting
+            vals = a.to_pylist()
+            out = [None if v is None else _java_float_str(v) for v in vals]
+            return pa.array(out, pa.string())
+        if isinstance(f, dt.BooleanType):
+            return pc.if_else(pc.fill_null(a, False),
+                              pa.scalar("true"), pa.scalar("false")) \
+                if a.null_count == 0 else pa.array(
+                    [None if v is None else ("true" if v else "false")
+                     for v in a.to_pylist()], pa.string())
+        if isinstance(f, dt.TimestampType):
+            out = []
+            import datetime
+            for v in a.to_pylist():
+                if v is None:
+                    out.append(None)
+                else:
+                    s = v.strftime("%Y-%m-%d %H:%M:%S")
+                    if v.microsecond:
+                        frac = f"{v.microsecond:06d}".rstrip("0")
+                        s += "." + frac
+                    out.append(s)
+            return pa.array(out, pa.string())
+        if isinstance(f, dt.DecimalType):
+            return pa.array([None if v is None else str(v)
+                             for v in a.to_pylist()], pa.string())
+        return pc.cast(a, pa.string())
+
+    def _from_string_cpu(self, a, t, ctx):
+        vals = a.to_pylist()
+        out = []
+        for v in vals:
+            if v is None:
+                out.append(None)
+                continue
+            out.append(_parse_string(v, t))
+        if ctx.ansi:
+            for v, o in zip(vals, out):
+                if v is not None and o is None:
+                    raise ExprError(f"invalid input for cast to {t}: {v!r}")
+        return pa.array(out, dt.to_arrow(t))
+
+
+def _java_float_str(v: float) -> str:
+    import math
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "Infinity" if v > 0 else "-Infinity"
+    if v == int(v) and abs(v) < 1e16:
+        if v == 0 and math.copysign(1, v) < 0:
+            return "-0.0"
+        return f"{int(v)}.0"
+    r = repr(v)
+    if "e" in r or "E" in r:
+        # Java uses E notation with explicit sign handling
+        m, e = r.split("e")
+        e = int(e)
+        return f"{m}E{e}" if e < 0 else f"{m}E{e}"
+    return r
+
+
+def _parse_string(s: str, t: dt.DataType):
+    s = s.strip()
+    try:
+        if isinstance(t, dt.BooleanType):
+            ls = s.lower()
+            if ls in ("t", "true", "y", "yes", "1"):
+                return True
+            if ls in ("f", "false", "n", "no", "0"):
+                return False
+            return None
+        if dt.is_integral(t):
+            # Spark allows trailing .000 for int casts? (it truncates
+            # decimals in 3.x): accept optional decimal part
+            import re
+            m = re.fullmatch(r"[+-]?\d+", s)
+            if m is None:
+                m2 = re.fullmatch(r"([+-]?\d+)\.\d*", s)
+                if m2 is None:
+                    return None
+                v = int(m2.group(1))
+            else:
+                v = int(s)
+            lo, hi = _int_bounds(t)
+            if v < lo or v > hi:
+                return None
+            return v
+        if dt.is_floating(t):
+            ls = s.lower()
+            if ls in ("nan",):
+                return float("nan")
+            if ls in ("inf", "+inf", "infinity", "+infinity"):
+                return float("inf")
+            if ls in ("-inf", "-infinity"):
+                return float("-inf")
+            return float(s)
+        if isinstance(t, dt.DecimalType):
+            import decimal
+            try:
+                d = decimal.Decimal(s)
+            except decimal.InvalidOperation:
+                return None
+            q = d.quantize(decimal.Decimal(1).scaleb(-t.scale),
+                           rounding=decimal.ROUND_HALF_UP)
+            if len(q.as_tuple().digits) - t.scale > t.precision - t.scale:
+                return None
+            return q
+        if isinstance(t, dt.DateType):
+            import datetime
+            import re
+            m = re.fullmatch(r"(\d{4})-(\d{1,2})-(\d{1,2})([T ].*)?", s)
+            if not m:
+                return None
+            try:
+                return datetime.date(int(m.group(1)), int(m.group(2)),
+                                     int(m.group(3)))
+            except ValueError:
+                return None
+        if isinstance(t, dt.TimestampType):
+            import datetime
+            for fmt in ("%Y-%m-%d %H:%M:%S.%f", "%Y-%m-%d %H:%M:%S",
+                        "%Y-%m-%dT%H:%M:%S.%f", "%Y-%m-%dT%H:%M:%S",
+                        "%Y-%m-%d"):
+                try:
+                    return datetime.datetime.strptime(s, fmt).replace(
+                        tzinfo=datetime.timezone.utc)
+                except ValueError:
+                    continue
+            return None
+    except (ValueError, OverflowError):
+        return None
+    return None
+
+
+# --- decimal helpers -----------------------------------------------------
+
+def _div_trunc_j(x, d):
+    q = jnp.sign(x) * (jnp.abs(x) // d)
+    return q.astype(jnp.int64)
+
+
+def _div_trunc_np(x, d):
+    return (np.sign(x) * (np.abs(x) // d)).astype(np.int64)
+
+
+def _rescale_tpu(x, from_scale, to_scale, t: dt.DecimalType):
+    if to_scale == from_scale:
+        return x
+    if to_scale > from_scale:
+        return x * (10 ** (to_scale - from_scale))
+    d = 10 ** (from_scale - to_scale)
+    q = jnp.sign(x) * (jnp.abs(x) // d)
+    rem = jnp.abs(x) - jnp.abs(x) // d * d
+    up = (rem * 2 >= d)
+    return (q + jnp.where(up, jnp.sign(x), 0)).astype(jnp.int64)
+
+
+def _rescale_np(x, from_scale, to_scale, t: dt.DecimalType):
+    lim = 10 ** t.precision
+    if to_scale == from_scale:
+        v = x
+    elif to_scale > from_scale:
+        v = x.astype(object) * (10 ** (to_scale - from_scale))
+    else:
+        d = 10 ** (from_scale - to_scale)
+        ax = np.abs(x.astype(np.int64))
+        q = ax // d
+        rem = ax - q * d
+        q = q + (rem * 2 >= d)
+        v = np.sign(x) * q
+    v = np.asarray(v, dtype=np.int64)
+    ok = (v > -lim) & (v < lim)
+    return v, ok

@@ -1,0 +1,266 @@
+"""Conditional expressions (reference: conditionalExpressions.scala,
+nullExpressions.scala — SURVEY.md §2.2-C; built from capability description).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import datatypes as dt
+from ..columnar.column import TpuColumnVector
+from .base import Expression
+
+__all__ = ["If", "CaseWhen", "Coalesce", "Least", "Greatest", "NullIf"]
+
+
+def _select_tpu(pred: TpuColumnVector, a: TpuColumnVector,
+                b: TpuColumnVector, t: dt.DataType) -> TpuColumnVector:
+    """Row-wise select with SQL semantics (null pred -> else branch)."""
+    take_a = pred.data & pred.validity
+    if t.is_variable_width:
+        from ..ops.strings import string_lengths
+        # select on strings: build per-row (start,len) pointing into a
+        # concatenated char buffer [a.chars | b.chars]
+        lens = jnp.where(take_a, string_lengths(a), string_lengths(b))
+        starts = jnp.where(take_a, a.offsets[:-1],
+                           b.offsets[:-1] + a.chars.shape[0])
+        tmp = TpuColumnVector(
+            t, validity=jnp.where(take_a, a.validity, b.validity),
+            offsets=a.offsets,  # unused by _copy_ragged
+            chars=jnp.concatenate([a.chars, b.chars]))
+        return _copy_ragged(tmp, starts, lens,
+                            int(a.chars.shape[0] + b.chars.shape[0]))
+    data = jnp.where(take_a, a.data, b.data)
+    valid = jnp.where(take_a, a.validity, b.validity)
+    return TpuColumnVector(t, data=data, validity=valid)
+
+
+def _copy_ragged(col, starts, lens, char_capacity):
+    """Build a standard (cumulative offsets, chars) column from per-row
+    (start, len) views into col.chars."""
+    import jax
+    from ..ops.strings import _WINDOW
+    new_offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum(lens, dtype=jnp.int32)])
+    n = lens.shape[0]
+
+    def loop_body(state):
+        chunk, out = state
+        pos = chunk * _WINDOW + jnp.arange(_WINDOW, dtype=jnp.int32)[None, :]
+        in_range = pos < lens[:, None]
+        src_idx = jnp.clip(starts[:, None] + pos, 0,
+                           max(col.chars.shape[0] - 1, 0))
+        vals = col.chars[src_idx] if col.chars.shape[0] else \
+            jnp.zeros((n, _WINDOW), jnp.uint8)
+        dst_idx = jnp.where(in_range, new_offsets[:-1][:, None] + pos,
+                            char_capacity)
+        out = out.at[dst_idx.reshape(-1)].set(vals.reshape(-1), mode="drop")
+        return chunk + 1, out
+
+    max_chunks = jnp.int32(-(-jnp.max(lens, initial=0) // _WINDOW))
+    out = jnp.zeros((char_capacity,), jnp.uint8)
+    _, out = jax.lax.while_loop(lambda st: st[0] < max_chunks, loop_body,
+                                (jnp.int32(0), out))
+    return TpuColumnVector(col.dtype, validity=col.validity,
+                           offsets=new_offsets, chars=out)
+
+
+class If(Expression):
+    def __init__(self, pred, then, els):
+        self.children = (pred, then, els)
+
+    def validate(self):
+        pred, then, els = self.children
+        assert pred.dtype == dt.BOOL
+        assert then.dtype == els.dtype, (then.dtype, els.dtype)
+
+    @property
+    def dtype(self):
+        return self.children[1].dtype
+
+    def eval_tpu(self, batch, ctx):
+        p = self.children[0].eval_tpu(batch, ctx)
+        a = self.children[1].eval_tpu(batch, ctx)
+        b = self.children[2].eval_tpu(batch, ctx)
+        return _select_tpu(p, a, b, self.dtype)
+
+    def eval_cpu(self, rb, ctx):
+        p = self.children[0].eval_cpu(rb, ctx)
+        a = self.children[1].eval_cpu(rb, ctx)
+        b = self.children[2].eval_cpu(rb, ctx)
+        return pc.if_else(pc.fill_null(p, False), a, b)
+
+
+class CaseWhen(Expression):
+    """CASE WHEN c1 THEN v1 [WHEN c2 THEN v2 ...] [ELSE e] END."""
+
+    def __init__(self, branches, else_value=None):
+        # branches: list of (cond_expr, value_expr)
+        kids = []
+        for c, v in branches:
+            assert c.dtype == dt.BOOL
+            kids.extend([c, v])
+        self.n_branches = len(branches)
+        if else_value is not None:
+            kids.append(else_value)
+        self.has_else = else_value is not None
+        self.children = tuple(kids)
+
+    @property
+    def dtype(self):
+        return self.children[1].dtype
+
+    def _branches(self):
+        return [(self.children[2 * i], self.children[2 * i + 1])
+                for i in range(self.n_branches)]
+
+    def _else(self):
+        return self.children[-1] if self.has_else else None
+
+    def eval_tpu(self, batch, ctx):
+        from .base import Literal
+        els = self._else()
+        if els is None:
+            els = Literal(None, self.dtype)
+        acc = els.eval_tpu(batch, ctx)
+        for cond, val in reversed(self._branches()):
+            p = cond.eval_tpu(batch, ctx)
+            v = val.eval_tpu(batch, ctx)
+            acc = _select_tpu(p, v, acc, self.dtype)
+        return acc
+
+    def eval_cpu(self, rb, ctx):
+        els = self._else()
+        if els is None:
+            acc = pa.nulls(rb.num_rows, dt.to_arrow(self.dtype))
+        else:
+            acc = els.eval_cpu(rb, ctx)
+        for cond, val in reversed(self._branches()):
+            p = cond.eval_cpu(rb, ctx)
+            v = val.eval_cpu(rb, ctx)
+            acc = pc.if_else(pc.fill_null(p, False), v, acc)
+        return acc
+
+
+class Coalesce(Expression):
+    def __init__(self, *exprs):
+        assert exprs
+        self.children = tuple(exprs)
+
+    def validate(self):
+        t = self.children[0].dtype
+        for e in self.children:
+            assert e.dtype == t, "coalesce children must share a type"
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def eval_tpu(self, batch, ctx):
+        acc = self.children[-1].eval_tpu(batch, ctx)
+        for e in reversed(self.children[:-1]):
+            c = e.eval_tpu(batch, ctx)
+            pred = TpuColumnVector(
+                dt.BOOL, data=c.validity,
+                validity=jnp.ones_like(c.validity))
+            acc = _select_tpu(pred, c, acc, self.dtype)
+        return acc
+
+    def eval_cpu(self, rb, ctx):
+        return pc.coalesce(*[e.eval_cpu(rb, ctx) for e in self.children])
+
+
+class _MinMaxN(Expression):
+    """least/greatest: ignores nulls, null only if all null. NaN is
+    greatest (Spark float ordering)."""
+    is_greatest = False
+
+    def __init__(self, *exprs):
+        self.children = tuple(exprs)
+
+    def validate(self):
+        t = self.children[0].dtype
+        for e in self.children:
+            assert e.dtype == t
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def eval_tpu(self, batch, ctx):
+        t = self.dtype
+        cols = [e.eval_tpu(batch, ctx) for e in self.children]
+        acc_d, acc_v = cols[0].data, cols[0].validity
+        for c in cols[1:]:
+            if dt.is_floating(t):
+                a_key = jnp.where(jnp.isnan(acc_d), jnp.inf, acc_d)
+                c_key = jnp.where(jnp.isnan(c.data), jnp.inf, c.data)
+                take_c = c_key > a_key if self.is_greatest else c_key < a_key
+            else:
+                take_c = c.data > acc_d if self.is_greatest \
+                    else c.data < acc_d
+            both = acc_v & c.validity
+            d = jnp.where(both & take_c, c.data,
+                          jnp.where(acc_v, acc_d, c.data))
+            v = acc_v | c.validity
+            acc_d, acc_v = d, v
+        return TpuColumnVector(t, data=acc_d, validity=acc_v)
+
+    def eval_cpu(self, rb, ctx):
+        arrays = [e.eval_cpu(rb, ctx) for e in self.children]
+        fn = pc.max_element_wise if self.is_greatest else pc.min_element_wise
+        if dt.is_floating(self.dtype):
+            # Spark: NaN is the greatest value; arrow's min/max skip NaN
+            # handling — do it manually via numpy
+            from .base import np_valid_and_values, np_result_to_arrow
+            vs = [np_valid_and_values(a, self.dtype) for a in arrays]
+            key = np.inf if self.is_greatest else -np.inf
+            acc_v, acc_valid = vs[0]
+            for v, valid in vs[1:]:
+                a_key = np.where(np.isnan(acc_v), np.inf, acc_v)
+                c_key = np.where(np.isnan(v), np.inf, v)
+                take_c = (c_key > a_key) if self.is_greatest \
+                    else (c_key < a_key)
+                both = acc_valid & valid
+                acc_v = np.where(both & take_c, v,
+                                 np.where(acc_valid, acc_v, v))
+                acc_valid = acc_valid | valid
+            return np_result_to_arrow(acc_v, acc_valid, self.dtype)
+        return fn(*arrays, skip_nulls=True)
+
+
+class Least(_MinMaxN):
+    is_greatest = False
+
+
+class Greatest(_MinMaxN):
+    is_greatest = True
+
+
+class NullIf(Expression):
+    def __init__(self, left, right):
+        self.children = (left, right)
+
+    def validate(self):
+        assert self.children[0].dtype == self.children[1].dtype
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def eval_tpu(self, batch, ctx):
+        from .predicates import EqualTo
+        eq = EqualTo(self.children[0], self.children[1]).eval_tpu(batch, ctx)
+        c = self.children[0].eval_tpu(batch, ctx)
+        hit = eq.data & eq.validity
+        return c.with_arrays(validity=c.validity & ~hit)
+
+    def eval_cpu(self, rb, ctx):
+        from .predicates import EqualTo
+        eq = EqualTo(self.children[0], self.children[1]).eval_cpu(rb, ctx)
+        c = self.children[0].eval_cpu(rb, ctx)
+        hit = pc.fill_null(eq, False)
+        return pc.if_else(hit, pa.nulls(len(c), dt.to_arrow(self.dtype)), c)

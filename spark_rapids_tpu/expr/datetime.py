@@ -1,0 +1,542 @@
+"""Date/time expressions (reference: datetimeExpressions.scala — SURVEY.md
+§2.2-C; built from capability description). UTC-only like early
+spark-rapids; other session time zones fall back per-expression.
+
+Device kernels use Hinnant civil-from-days integer arithmetic (see
+ops.numeric_format._civil_from_days) — no calendars, no branches.
+"""
+from __future__ import annotations
+
+import datetime as _datetime
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import datatypes as dt
+from ..columnar.column import TpuColumnVector
+from ..ops.numeric_format import _civil_from_days
+from .base import Expression, np_valid_and_values, np_result_to_arrow
+
+__all__ = ["Year", "Month", "DayOfMonth", "Quarter", "DayOfWeek",
+           "WeekDay", "DayOfYear", "LastDay", "Hour", "Minute", "Second",
+           "DateAdd", "DateSub", "DateDiff", "AddMonths", "MonthsBetween",
+           "TruncDate", "UnixTimestamp", "FromUnixTime", "UnixMicros",
+           "MicrosToTimestamp"]
+
+_US_PER_DAY = 86400 * 1_000_000
+
+
+def _civil_np(z):
+    z = z.astype(np.int64) + 719468
+    era = np.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = np.where(mp < 10, mp + 3, mp - 9)
+    y = np.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def _days_from_civil_j(y, m, d):
+    y = y - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _days_from_civil_np(y, m, d):
+    y = y - (m <= 2)
+    era = np.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = np.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+class _DatePart(Expression):
+    """int32 field extracted from a date column."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def validate(self):
+        assert isinstance(self.children[0].dtype, dt.DateType), \
+            f"{self.pretty_name()} needs a date input"
+
+    @property
+    def dtype(self):
+        return dt.INT32
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        y, m, d = _civil_from_days(c.data)
+        out = self._part_j(c.data, y, m, d)
+        return TpuColumnVector(dt.INT32, data=out.astype(jnp.int32),
+                               validity=c.validity)
+
+    def eval_cpu(self, rb, ctx):
+        v, valid = np_valid_and_values(self.children[0].eval_cpu(rb, ctx),
+                                       dt.DATE)
+        y, m, d = _civil_np(v.astype(np.int64))
+        out = self._part_np(v.astype(np.int64), y, m, d)
+        return np_result_to_arrow(out.astype(np.int32), valid, dt.INT32)
+
+
+class Year(_DatePart):
+    def _part_j(self, days, y, m, d):
+        return y
+
+    def _part_np(self, days, y, m, d):
+        return y
+
+
+class Month(_DatePart):
+    def _part_j(self, days, y, m, d):
+        return m
+
+    def _part_np(self, days, y, m, d):
+        return m
+
+
+class DayOfMonth(_DatePart):
+    def _part_j(self, days, y, m, d):
+        return d
+
+    def _part_np(self, days, y, m, d):
+        return d
+
+
+class Quarter(_DatePart):
+    def _part_j(self, days, y, m, d):
+        return (m - 1) // 3 + 1
+
+    def _part_np(self, days, y, m, d):
+        return (m - 1) // 3 + 1
+
+
+class DayOfWeek(_DatePart):
+    """Spark: 1 = Sunday ... 7 = Saturday. Epoch day 0 was a Thursday."""
+
+    def _part_j(self, days, y, m, d):
+        return (days + 4) % 7 + 1
+
+    def _part_np(self, days, y, m, d):
+        return (days + 4) % 7 + 1
+
+
+class WeekDay(_DatePart):
+    """weekday(): 0 = Monday ... 6 = Sunday."""
+
+    def _part_j(self, days, y, m, d):
+        return (days + 3) % 7
+
+    def _part_np(self, days, y, m, d):
+        return (days + 3) % 7
+
+
+class DayOfYear(_DatePart):
+    def _part_j(self, days, y, m, d):
+        jan1 = _days_from_civil_j(y, jnp.full_like(m, 1),
+                                  jnp.full_like(d, 1))
+        return days - jan1 + 1
+
+    def _part_np(self, days, y, m, d):
+        jan1 = _days_from_civil_np(y, np.full_like(m, 1), np.full_like(d, 1))
+        return days - jan1 + 1
+
+
+class LastDay(Expression):
+    """Last day of the month, as a date."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return dt.DATE
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        y, m, d = _civil_from_days(c.data)
+        ny = jnp.where(m == 12, y + 1, y)
+        nm = jnp.where(m == 12, 1, m + 1)
+        first_next = _days_from_civil_j(ny, nm, jnp.full_like(d, 1))
+        return TpuColumnVector(dt.DATE,
+                               data=(first_next - 1).astype(jnp.int32),
+                               validity=c.validity)
+
+    def eval_cpu(self, rb, ctx):
+        v, valid = np_valid_and_values(self.children[0].eval_cpu(rb, ctx),
+                                       dt.DATE)
+        y, m, d = _civil_np(v.astype(np.int64))
+        ny = np.where(m == 12, y + 1, y)
+        nm = np.where(m == 12, 1, m + 1)
+        first_next = _days_from_civil_np(ny, nm, np.full_like(d, 1))
+        return np_result_to_arrow((first_next - 1).astype(np.int32), valid,
+                                  dt.DATE)
+
+
+class _TimePart(Expression):
+    div = 1
+    mod = 24
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def validate(self):
+        assert isinstance(self.children[0].dtype, dt.TimestampType)
+
+    @property
+    def dtype(self):
+        return dt.INT32
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        secs = jnp.floor_divide(c.data, 1_000_000)
+        out = jnp.floor_divide(secs, self.div) % self.mod
+        return TpuColumnVector(dt.INT32, data=out.astype(jnp.int32),
+                               validity=c.validity)
+
+    def eval_cpu(self, rb, ctx):
+        v, valid = np_valid_and_values(self.children[0].eval_cpu(rb, ctx),
+                                       dt.TIMESTAMP)
+        secs = np.floor_divide(v, 1_000_000)
+        out = np.floor_divide(secs, self.div) % self.mod
+        return np_result_to_arrow(out.astype(np.int32), valid, dt.INT32)
+
+
+class Hour(_TimePart):
+    div = 3600
+    mod = 24
+
+
+class Minute(_TimePart):
+    div = 60
+    mod = 60
+
+
+class Second(_TimePart):
+    div = 1
+    mod = 60
+
+
+class DateAdd(Expression):
+    def __init__(self, date, days):
+        self.children = (date, days)
+
+    @property
+    def dtype(self):
+        return dt.DATE
+
+    def eval_tpu(self, batch, ctx):
+        d = self.children[0].eval_tpu(batch, ctx)
+        n = self.children[1].eval_tpu(batch, ctx)
+        return TpuColumnVector(
+            dt.DATE, data=(d.data + n.data.astype(jnp.int32)),
+            validity=d.validity & n.validity)
+
+    def eval_cpu(self, rb, ctx):
+        dv, dval = np_valid_and_values(self.children[0].eval_cpu(rb, ctx),
+                                       dt.DATE)
+        nv, nval = np_valid_and_values(self.children[1].eval_cpu(rb, ctx),
+                                       self.children[1].dtype)
+        return np_result_to_arrow((dv + nv).astype(np.int32), dval & nval,
+                                  dt.DATE)
+
+
+class DateSub(DateAdd):
+    def eval_tpu(self, batch, ctx):
+        d = self.children[0].eval_tpu(batch, ctx)
+        n = self.children[1].eval_tpu(batch, ctx)
+        return TpuColumnVector(
+            dt.DATE, data=(d.data - n.data.astype(jnp.int32)),
+            validity=d.validity & n.validity)
+
+    def eval_cpu(self, rb, ctx):
+        dv, dval = np_valid_and_values(self.children[0].eval_cpu(rb, ctx),
+                                       dt.DATE)
+        nv, nval = np_valid_and_values(self.children[1].eval_cpu(rb, ctx),
+                                       self.children[1].dtype)
+        return np_result_to_arrow((dv - nv).astype(np.int32), dval & nval,
+                                  dt.DATE)
+
+
+class DateDiff(Expression):
+    def __init__(self, end, start):
+        self.children = (end, start)
+
+    @property
+    def dtype(self):
+        return dt.INT32
+
+    def eval_tpu(self, batch, ctx):
+        e = self.children[0].eval_tpu(batch, ctx)
+        s = self.children[1].eval_tpu(batch, ctx)
+        return TpuColumnVector(dt.INT32, data=e.data - s.data,
+                               validity=e.validity & s.validity)
+
+    def eval_cpu(self, rb, ctx):
+        ev, evalid = np_valid_and_values(self.children[0].eval_cpu(rb, ctx),
+                                         dt.DATE)
+        sv, svalid = np_valid_and_values(self.children[1].eval_cpu(rb, ctx),
+                                         dt.DATE)
+        return np_result_to_arrow((ev - sv).astype(np.int32),
+                                  evalid & svalid, dt.INT32)
+
+
+def _add_months(y, m, d, n, is_np):
+    B = np if is_np else jnp
+    tot = y * 12 + (m - 1) + n
+    ny = B.where(tot >= 0, tot, tot - 11) // 12
+    nm = tot - ny * 12 + 1
+    # clamp day to last day of target month
+    nny = B.where(nm == 12, ny + 1, ny)
+    nnm = B.where(nm == 12, 1, nm + 1)
+    if is_np:
+        last = _days_from_civil_np(nny, nnm, np.full_like(d, 1)) - 1
+        _, _, last_d = _civil_np(last)
+        nd = np.minimum(d, last_d)
+        return _days_from_civil_np(ny, nm, nd)
+    last = _days_from_civil_j(nny, nnm, jnp.full_like(d, 1)) - 1
+    _, _, last_d = _civil_from_days(last)
+    nd = jnp.minimum(d, last_d)
+    return _days_from_civil_j(ny, nm, nd)
+
+
+class AddMonths(Expression):
+    def __init__(self, date, months):
+        self.children = (date, months)
+
+    @property
+    def dtype(self):
+        return dt.DATE
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        n = self.children[1].eval_tpu(batch, ctx)
+        y, m, d = _civil_from_days(c.data)
+        out = _add_months(y, m, d, n.data.astype(jnp.int64), False)
+        return TpuColumnVector(dt.DATE, data=out.astype(jnp.int32),
+                               validity=c.validity & n.validity)
+
+    def eval_cpu(self, rb, ctx):
+        dv, dval = np_valid_and_values(self.children[0].eval_cpu(rb, ctx),
+                                       dt.DATE)
+        nv, nval = np_valid_and_values(self.children[1].eval_cpu(rb, ctx),
+                                       self.children[1].dtype)
+        y, m, d = _civil_np(dv.astype(np.int64))
+        out = _add_months(y, m, d, nv.astype(np.int64), True)
+        return np_result_to_arrow(out.astype(np.int32), dval & nval, dt.DATE)
+
+
+class MonthsBetween(Expression):
+    """months_between(end, start): whole-month diff + fractional 31-day
+    part; if both are last-of-month the fraction is 0."""
+
+    def __init__(self, end, start, round_off=True):
+        self.children = (end, start)
+        self.round_off = round_off
+
+    @property
+    def dtype(self):
+        return dt.FLOAT64
+
+    def _compute(self, ev, sv, B):
+        civil = _civil_np if B is np else _civil_from_days
+        days_from = _days_from_civil_np if B is np else _days_from_civil_j
+        ey, em, ed = civil(ev.astype(B.int64))
+        sy, sm, sd = civil(sv.astype(B.int64))
+
+        def last_day(y, m, d):
+            ny = B.where(m == 12, y + 1, y)
+            nm = B.where(m == 12, 1, m + 1)
+            ld = days_from(ny, nm, B.full_like(d, 1)) - 1
+            _, _, ldd = civil(ld)
+            return ldd
+
+        e_last = last_day(ey, em, ed)
+        s_last = last_day(sy, sm, sd)
+        both_last = (ed == e_last) & (sd == s_last)
+        months = (ey - sy) * 12 + (em - sm)
+        frac = (ed - sd) / 31.0
+        out = B.where(both_last, months.astype(B.float64),
+                      months + frac)
+        if self.round_off:
+            out = B.round(out * 1e8) / 1e8
+        return out
+
+    def eval_tpu(self, batch, ctx):
+        e = self.children[0].eval_tpu(batch, ctx)
+        s = self.children[1].eval_tpu(batch, ctx)
+        out = self._compute(e.data, s.data, jnp)
+        return TpuColumnVector(dt.FLOAT64, data=out,
+                               validity=e.validity & s.validity)
+
+    def eval_cpu(self, rb, ctx):
+        ev, evalid = np_valid_and_values(self.children[0].eval_cpu(rb, ctx),
+                                         dt.DATE)
+        sv, svalid = np_valid_and_values(self.children[1].eval_cpu(rb, ctx),
+                                         dt.DATE)
+        out = self._compute(ev, sv, np)
+        return np_result_to_arrow(out, evalid & svalid, dt.FLOAT64)
+
+
+class TruncDate(Expression):
+    """trunc(date, fmt) for fmt in YEAR/YYYY/YY, MONTH/MON/MM, QUARTER,
+    WEEK."""
+
+    def __init__(self, child, fmt: str):
+        self.children = (child,)
+        self.fmt = fmt.upper()
+
+    @property
+    def dtype(self):
+        return dt.DATE
+
+    def _trunc(self, days, B):
+        civil = _civil_np if B is np else _civil_from_days
+        days_from = _days_from_civil_np if B is np else _days_from_civil_j
+        y, m, d = civil(days.astype(B.int64))
+        one = B.full_like(d, 1)
+        if self.fmt in ("YEAR", "YYYY", "YY"):
+            return days_from(y, one, one)
+        if self.fmt in ("MONTH", "MON", "MM"):
+            return days_from(y, m, one)
+        if self.fmt == "QUARTER":
+            qm = ((m - 1) // 3) * 3 + 1
+            return days_from(y, qm, one)
+        if self.fmt == "WEEK":
+            # Monday of the current week
+            wd = (days + 3) % 7  # 0=Monday
+            return days - wd
+        raise ValueError(f"unsupported trunc format {self.fmt}")
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        out = self._trunc(c.data, jnp)
+        return TpuColumnVector(dt.DATE, data=out.astype(jnp.int32),
+                               validity=c.validity)
+
+    def eval_cpu(self, rb, ctx):
+        v, valid = np_valid_and_values(self.children[0].eval_cpu(rb, ctx),
+                                       dt.DATE)
+        out = self._trunc(v, np)
+        return np_result_to_arrow(out.astype(np.int32), valid, dt.DATE)
+
+
+class UnixTimestamp(Expression):
+    """to_unix_timestamp(ts) -> long seconds."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return dt.INT64
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        t = self.children[0].dtype
+        us = c.data.astype(jnp.int64)
+        if isinstance(t, dt.DateType):
+            us = us * _US_PER_DAY
+        out = jnp.floor_divide(us, 1_000_000)
+        return TpuColumnVector(dt.INT64, data=out, validity=c.validity)
+
+    def eval_cpu(self, rb, ctx):
+        t = self.children[0].dtype
+        v, valid = np_valid_and_values(self.children[0].eval_cpu(rb, ctx), t)
+        us = v.astype(np.int64)
+        if isinstance(t, dt.DateType):
+            us = us * _US_PER_DAY
+        return np_result_to_arrow(np.floor_divide(us, 1_000_000), valid,
+                                  dt.INT64)
+
+
+class UnixMicros(UnixTimestamp):
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        return TpuColumnVector(dt.INT64, data=c.data.astype(jnp.int64),
+                               validity=c.validity)
+
+    def eval_cpu(self, rb, ctx):
+        v, valid = np_valid_and_values(self.children[0].eval_cpu(rb, ctx),
+                                       self.children[0].dtype)
+        return np_result_to_arrow(v.astype(np.int64), valid, dt.INT64)
+
+
+class MicrosToTimestamp(Expression):
+    def __init__(self, child):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return dt.TIMESTAMP
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        return TpuColumnVector(dt.TIMESTAMP, data=c.data.astype(jnp.int64),
+                               validity=c.validity)
+
+    def eval_cpu(self, rb, ctx):
+        v, valid = np_valid_and_values(self.children[0].eval_cpu(rb, ctx),
+                                       self.children[0].dtype)
+        return np_result_to_arrow(v.astype(np.int64), valid, dt.TIMESTAMP)
+
+
+class FromUnixTime(Expression):
+    """from_unixtime(sec) -> string 'yyyy-MM-dd HH:mm:ss' (host formatting;
+    device builds the default format directly)."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return dt.STRING
+
+    def eval_tpu(self, batch, ctx):
+        from ..ops.numeric_format import ragged_from_fixed
+        c = self.children[0].eval_tpu(batch, ctx)
+        secs = c.data.astype(jnp.int64)
+        days = jnp.floor_divide(secs, 86400)
+        sod = secs - days * 86400
+        y, m, d = _civil_from_days(days)
+        hh = sod // 3600
+        mm = (sod // 60) % 60
+        ss = sod % 60
+        n = secs.shape[0]
+
+        def dig(v, p):
+            return ((v // p) % 10 + ord("0")).astype(jnp.uint8)
+
+        def lit(ch):
+            return jnp.full((n,), ord(ch), jnp.uint8)
+
+        cols = [dig(y, 1000), dig(y, 100), dig(y, 10), dig(y, 1), lit("-"),
+                dig(m, 10), dig(m, 1), lit("-"), dig(d, 10), dig(d, 1),
+                lit(" "), dig(hh, 10), dig(hh, 1), lit(":"), dig(mm, 10),
+                dig(mm, 1), lit(":"), dig(ss, 10), dig(ss, 1)]
+        mat = jnp.stack(cols, axis=1)
+        lens = jnp.full((n,), 19, jnp.int32)
+        return ragged_from_fixed(mat, lens, c.validity)
+
+    def eval_cpu(self, rb, ctx):
+        import pyarrow as pa
+        a = self.children[0].eval_cpu(rb, ctx)
+        out = []
+        for v in a.to_pylist():
+            if v is None:
+                out.append(None)
+            else:
+                out.append(_datetime.datetime.fromtimestamp(
+                    int(v), tz=_datetime.timezone.utc
+                ).strftime("%Y-%m-%d %H:%M:%S"))
+        return pa.array(out, pa.string())

@@ -1,0 +1,361 @@
+"""Math expressions (reference: mathExpressions.scala — SURVEY.md §2.2-C;
+built from capability description).
+
+Spark semantics: log/log10/log2/log1p of non-positive input -> null (not
+NaN); sqrt(negative) -> NaN; round() is HALF_UP, bround() HALF_EVEN.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import datatypes as dt
+from ..columnar.column import TpuColumnVector
+from .base import Expression, np_valid_and_values, np_result_to_arrow
+
+__all__ = ["UnaryMathExpression", "Sqrt", "Cbrt", "Exp", "Expm1", "Log",
+           "Log10", "Log2", "Log1p", "Sin", "Cos", "Tan", "Asin", "Acos",
+           "Atan", "Sinh", "Cosh", "Tanh", "Signum", "ToDegrees",
+           "ToRadians", "Floor", "Ceil", "Rint", "Pow", "Atan2", "Hypot",
+           "Round", "BRound"]
+
+
+class UnaryMathExpression(Expression):
+    """double -> double elementwise."""
+    jfn = None
+    nfn = None
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def validate(self):
+        assert dt.is_floating(self.children[0].dtype), \
+            f"{self.pretty_name()} needs double input (insert cast)"
+
+    @property
+    def dtype(self):
+        return dt.FLOAT64
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        x = c.data.astype(jnp.float64)
+        data, extra = self._compute_tpu(x)
+        valid = c.validity if extra is None else c.validity & extra
+        return TpuColumnVector(dt.FLOAT64, data=data, validity=valid)
+
+    def _compute_tpu(self, x):
+        return type(self).jfn(x), None
+
+    def eval_cpu(self, rb, ctx):
+        t = self.children[0].dtype
+        v, valid = np_valid_and_values(self.children[0].eval_cpu(rb, ctx), t)
+        x = v.astype(np.float64)
+        with np.errstate(all="ignore"):
+            out, extra = self._compute_cpu(x)
+        if extra is not None:
+            valid = valid & extra
+        return np_result_to_arrow(out, valid, dt.FLOAT64)
+
+    def _compute_cpu(self, x):
+        return type(self).nfn(x), None
+
+
+def _mk_unary(name, jfn, nfn, doc=""):
+    cls = type(name, (UnaryMathExpression,), {"jfn": staticmethod(jfn),
+                                              "nfn": staticmethod(nfn),
+                                              "__doc__": doc})
+    return cls
+
+
+Sqrt = _mk_unary("Sqrt", jnp.sqrt, np.sqrt)
+Cbrt = _mk_unary("Cbrt", jnp.cbrt, np.cbrt)
+Exp = _mk_unary("Exp", jnp.exp, np.exp)
+Expm1 = _mk_unary("Expm1", jnp.expm1, np.expm1)
+Sin = _mk_unary("Sin", jnp.sin, np.sin)
+Cos = _mk_unary("Cos", jnp.cos, np.cos)
+Tan = _mk_unary("Tan", jnp.tan, np.tan)
+Asin = _mk_unary("Asin", jnp.arcsin, np.arcsin)
+Acos = _mk_unary("Acos", jnp.arccos, np.arccos)
+Atan = _mk_unary("Atan", jnp.arctan, np.arctan)
+Sinh = _mk_unary("Sinh", jnp.sinh, np.sinh)
+Cosh = _mk_unary("Cosh", jnp.cosh, np.cosh)
+Tanh = _mk_unary("Tanh", jnp.tanh, np.tanh)
+Signum = _mk_unary("Signum", jnp.sign, np.sign)
+ToDegrees = _mk_unary("ToDegrees", jnp.degrees, np.degrees)
+ToRadians = _mk_unary("ToRadians", jnp.radians, np.radians)
+Rint = _mk_unary("Rint", jnp.rint, np.rint)
+
+
+class _LogBase(UnaryMathExpression):
+    """Spark logs return null for input <= 0."""
+    def _compute_tpu(self, x):
+        ok = x > 0
+        return type(self).jfn(jnp.where(ok, x, 1.0)), ok
+
+    def _compute_cpu(self, x):
+        ok = x > 0
+        return type(self).nfn(np.where(ok, x, 1.0)), ok
+
+
+Log = type("Log", (_LogBase,), {"jfn": staticmethod(jnp.log),
+                                "nfn": staticmethod(np.log)})
+Log10 = type("Log10", (_LogBase,), {"jfn": staticmethod(jnp.log10),
+                                    "nfn": staticmethod(np.log10)})
+Log2 = type("Log2", (_LogBase,), {"jfn": staticmethod(jnp.log2),
+                                  "nfn": staticmethod(np.log2)})
+
+
+class Log1p(UnaryMathExpression):
+    def _compute_tpu(self, x):
+        ok = x > -1.0
+        return jnp.log1p(jnp.where(ok, x, 0.0)), ok
+
+    def _compute_cpu(self, x):
+        ok = x > -1.0
+        return np.log1p(np.where(ok, x, 0.0)), ok
+
+
+def _f64_to_i64_saturate_j(x):
+    """Java (long) double: truncate, saturate at bounds, NaN -> 0."""
+    nan = jnp.isnan(x)
+    too_big = x >= float(1 << 63)
+    too_small = x <= float(-(1 << 63) - 1)
+    mid = jnp.where(nan | too_big | too_small, 0.0, x)
+    return jnp.where(too_big, np.iinfo(np.int64).max,
+                     jnp.where(too_small, np.iinfo(np.int64).min,
+                               mid.astype(jnp.int64)))
+
+
+def _f64_to_i64_saturate_np(x):
+    nan = np.isnan(x)
+    too_big = x >= float(1 << 63)
+    too_small = x <= float(-(1 << 63) - 1)
+    mid = np.where(nan | too_big | too_small, 0.0, x)
+    return np.where(too_big, np.iinfo(np.int64).max,
+                    np.where(too_small, np.iinfo(np.int64).min,
+                             mid.astype(np.int64)))
+
+
+class _FloorCeil(Expression):
+    """floor/ceil: double -> long (Spark), decimal -> decimal scale 0."""
+    is_ceil = False
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        t = self.children[0].dtype
+        if isinstance(t, dt.DecimalType):
+            return dt.DecimalType(min(t.precision - t.scale + 1, 38), 0)
+        if dt.is_integral(t):
+            return dt.INT64
+        return dt.INT64
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        t = self.children[0].dtype
+        if isinstance(t, dt.DecimalType):
+            d = 10 ** t.scale
+            q = jnp.where(c.data >= 0,
+                          (c.data + (d - 1 if self.is_ceil else 0)) // d,
+                          -((-c.data + (0 if self.is_ceil else d - 1)) // d))
+            return TpuColumnVector(self.dtype, data=q.astype(jnp.int64),
+                                   validity=c.validity)
+        if dt.is_integral(t):
+            return TpuColumnVector(dt.INT64, data=c.data.astype(jnp.int64),
+                                   validity=c.validity)
+        f = jnp.ceil if self.is_ceil else jnp.floor
+        out = _f64_to_i64_saturate_j(f(c.data.astype(jnp.float64)))
+        return TpuColumnVector(dt.INT64, data=out, validity=c.validity)
+
+    def eval_cpu(self, rb, ctx):
+        t = self.children[0].dtype
+        v, valid = np_valid_and_values(self.children[0].eval_cpu(rb, ctx), t)
+        if isinstance(t, dt.DecimalType):
+            d = 10 ** t.scale
+            vi = v.astype(np.int64)
+            if self.is_ceil:
+                q = np.where(vi >= 0, (vi + d - 1) // d, -((-vi) // d))
+            else:
+                q = np.where(vi >= 0, vi // d, -((-vi + d - 1) // d))
+            return np_result_to_arrow(q.astype(np.int64), valid, self.dtype)
+        if dt.is_integral(t):
+            return np_result_to_arrow(v.astype(np.int64), valid, dt.INT64)
+        f = np.ceil if self.is_ceil else np.floor
+        with np.errstate(invalid="ignore"):
+            out = _f64_to_i64_saturate_np(f(v.astype(np.float64)))
+        return np_result_to_arrow(out, valid, dt.INT64)
+
+
+class Floor(_FloorCeil):
+    is_ceil = False
+
+
+class Ceil(_FloorCeil):
+    is_ceil = True
+
+
+class _BinaryDouble(Expression):
+    jfn = None
+    nfn = None
+
+    def __init__(self, left, right):
+        self.children = (left, right)
+
+    def validate(self):
+        for c in self.children:
+            assert dt.is_floating(c.dtype)
+
+    @property
+    def dtype(self):
+        return dt.FLOAT64
+
+    def eval_tpu(self, batch, ctx):
+        l = self.children[0].eval_tpu(batch, ctx)
+        r = self.children[1].eval_tpu(batch, ctx)
+        data = type(self).jfn(l.data.astype(jnp.float64),
+                              r.data.astype(jnp.float64))
+        return TpuColumnVector(dt.FLOAT64, data=data,
+                               validity=l.validity & r.validity)
+
+    def eval_cpu(self, rb, ctx):
+        lv, lval = np_valid_and_values(self.children[0].eval_cpu(rb, ctx),
+                                       self.children[0].dtype)
+        rv, rval = np_valid_and_values(self.children[1].eval_cpu(rb, ctx),
+                                       self.children[1].dtype)
+        with np.errstate(all="ignore"):
+            out = type(self).nfn(lv.astype(np.float64),
+                                 rv.astype(np.float64))
+        return np_result_to_arrow(out, lval & rval, dt.FLOAT64)
+
+
+Pow = type("Pow", (_BinaryDouble,), {"jfn": staticmethod(jnp.power),
+                                     "nfn": staticmethod(np.power)})
+Atan2 = type("Atan2", (_BinaryDouble,), {"jfn": staticmethod(jnp.arctan2),
+                                         "nfn": staticmethod(np.arctan2)})
+Hypot = type("Hypot", (_BinaryDouble,), {"jfn": staticmethod(jnp.hypot),
+                                         "nfn": staticmethod(np.hypot)})
+
+
+class Round(Expression):
+    """round(x, d): HALF_UP. Doubles use the multiply/round trick; decimal
+    and integral are exact integer arithmetic."""
+    half_even = False
+
+    def __init__(self, child, digits=0):
+        self.children = (child,)
+        self.digits = digits
+
+    @property
+    def dtype(self):
+        t = self.children[0].dtype
+        if isinstance(t, dt.DecimalType):
+            ns = min(max(self.digits, 0), t.scale)
+            return dt.DecimalType(max(t.precision - (t.scale - ns), 1), ns)
+        return t
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        t = self.children[0].dtype
+        d = self.digits
+        if isinstance(t, dt.DecimalType):
+            ns = self.dtype.scale
+            drop = t.scale - ns
+            if drop <= 0:
+                return TpuColumnVector(self.dtype, data=c.data,
+                                       validity=c.validity)
+            m = 10 ** drop
+            av = jnp.abs(c.data)
+            q = av // m
+            rem = av - q * m
+            if self.half_even:
+                up = (rem * 2 > m) | ((rem * 2 == m) & (q % 2 == 1))
+            else:
+                up = rem * 2 >= m
+            q = q + up
+            out = jnp.sign(c.data) * q
+            return TpuColumnVector(self.dtype, data=out.astype(jnp.int64),
+                                   validity=c.validity)
+        if dt.is_integral(t):
+            if d >= 0:
+                return c
+            m = 10 ** (-d)
+            av = jnp.abs(c.data.astype(jnp.int64))
+            q = av // m
+            rem = av - q * m
+            if self.half_even:
+                up = (rem * 2 > m) | ((rem * 2 == m) & (q % 2 == 1))
+            else:
+                up = rem * 2 >= m
+            out = jnp.sign(c.data) * (q + up) * m
+            return TpuColumnVector(t, data=out.astype(t.np_dtype),
+                                   validity=c.validity)
+        # doubles: scale, round, unscale (BigDecimal-exact only on CPU where
+        # f64 is real; documented incompat on device)
+        m = 10.0 ** d
+        x = c.data.astype(jnp.float64) * m
+        if self.half_even:
+            r = jnp.rint(x)
+        else:
+            r = jnp.trunc(x + jnp.sign(x) * 0.5)
+        out = r / m
+        out = jnp.where(jnp.isfinite(c.data), out, c.data)
+        return TpuColumnVector(t, data=out.astype(t.np_dtype),
+                               validity=c.validity)
+
+    def eval_cpu(self, rb, ctx):
+        t = self.children[0].dtype
+        v, valid = np_valid_and_values(self.children[0].eval_cpu(rb, ctx), t)
+        d = self.digits
+        if isinstance(t, dt.DecimalType):
+            ns = self.dtype.scale
+            drop = t.scale - ns
+            if drop <= 0:
+                return np_result_to_arrow(v, valid, self.dtype)
+            m = 10 ** drop
+            av = np.abs(v.astype(np.int64))
+            q = av // m
+            rem = av - q * m
+            if self.half_even:
+                up = (rem * 2 > m) | ((rem * 2 == m) & (q % 2 == 1))
+            else:
+                up = rem * 2 >= m
+            out = np.sign(v) * (q + up)
+            return np_result_to_arrow(out.astype(np.int64), valid,
+                                      self.dtype)
+        if dt.is_integral(t):
+            if d >= 0:
+                return np_result_to_arrow(v, valid, t)
+            m = 10 ** (-d)
+            av = np.abs(v.astype(np.int64))
+            q = av // m
+            rem = av - q * m
+            if self.half_even:
+                up = (rem * 2 > m) | ((rem * 2 == m) & (q % 2 == 1))
+            else:
+                up = rem * 2 >= m
+            out = np.sign(v) * (q + up) * m
+            return np_result_to_arrow(out.astype(t.np_dtype), valid, t)
+        # Spark rounds doubles via BigDecimal: emulate with decimal module
+        import decimal
+        out = np.empty(len(v), np.float64)
+        mode = decimal.ROUND_HALF_EVEN if self.half_even else \
+            decimal.ROUND_HALF_UP
+        for i, x in enumerate(v):
+            if not np.isfinite(x):
+                out[i] = x
+                continue
+            out[i] = float(decimal.Decimal(float(x)).quantize(
+                decimal.Decimal(1).scaleb(-d), rounding=mode))
+        return np_result_to_arrow(out.astype(t.np_dtype), valid, t)
+
+    def tpu_supported(self):
+        if dt.is_floating(self.children[0].dtype):
+            return ("round() on doubles uses float scaling on device "
+                    "(BigDecimal-exact on CPU); enable via incompatibleOps")
+        return None
+
+
+class BRound(Round):
+    half_even = True

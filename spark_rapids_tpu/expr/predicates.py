@@ -1,0 +1,489 @@
+"""Predicates, comparisons, and null tests (reference: predicates.scala /
+nullExpressions.scala — SURVEY.md §2.2-C; built from capability description).
+
+Spark semantics:
+- comparisons propagate null (null op x -> null); EqualNullSafe (<=>) never
+  returns null.
+- AND/OR use Kleene three-valued logic.
+- float NaN: in comparisons NaN > everything and NaN == NaN (Spark's total
+  order for floats differs from IEEE!) — implemented on both paths.
+- string comparisons are unsigned-byte lexicographic.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import datatypes as dt
+from ..columnar.column import TpuColumnVector
+from ..ops.strings import string_compare_tpu
+from .base import (Expression, np_valid_and_values, np_result_to_arrow)
+
+__all__ = ["EqualTo", "EqualNullSafe", "LessThan", "LessThanOrEqual",
+           "GreaterThan", "GreaterThanOrEqual", "And", "Or", "Not",
+           "IsNull", "IsNotNull", "IsNaN", "In"]
+
+
+def _is_float(t):
+    return dt.is_floating(t)
+
+
+class BinaryComparison(Expression):
+    symbol = "?"
+    # jnp/np comparator set in subclasses as staticmethods
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def validate(self):
+        left, right = self.children
+        if left.dtype != right.dtype:
+            raise TypeError(f"comparison children differ: {left.dtype} vs "
+                            f"{right.dtype}")
+
+    @property
+    def dtype(self):
+        return dt.BOOL
+
+    def _cmp_key(self):
+        """-1/0/1 ordering comparison handled via subclass op on keys."""
+        raise NotImplementedError
+
+    def eval_tpu(self, batch, ctx):
+        l = self.children[0].eval_tpu(batch, ctx)
+        r = self.children[1].eval_tpu(batch, ctx)
+        t = self.children[0].dtype
+        if isinstance(t, (dt.StringType, dt.BinaryType)):
+            cmp = string_compare_tpu(l, r)  # -1/0/1 int8
+            data = self._from_cmp_tpu(cmp)
+        elif _is_float(t):
+            data = self._float_cmp_tpu(l.data, r.data)
+        else:
+            data = self._op_tpu(l.data, r.data)
+        return TpuColumnVector(dt.BOOL, data=data,
+                               validity=l.validity & r.validity)
+
+    def eval_cpu(self, rb, ctx):
+        t = self.children[0].dtype
+        la = self.children[0].eval_cpu(rb, ctx)
+        ra = self.children[1].eval_cpu(rb, ctx)
+        if isinstance(t, (dt.StringType, dt.BinaryType)):
+            lv = np.array([None if v is None else v for v in la.to_pylist()],
+                          dtype=object)
+            rv = np.array([None if v is None else v for v in ra.to_pylist()],
+                          dtype=object)
+            valid = np.array([a is not None and b is not None
+                              for a, b in zip(lv, rv)])
+            enc = (lambda s: s.encode() if isinstance(s, str) else s)
+            out = np.array([False if not v else
+                            self._py_cmp(enc(a), enc(b))
+                            for a, b, v in zip(lv, rv, valid)])
+            return pa.array(out, pa.bool_(), mask=~valid)
+        lv, lvalid = np_valid_and_values(la, t)
+        rv, rvalid = np_valid_and_values(ra, t)
+        valid = lvalid & rvalid
+        if _is_float(t):
+            out = self._float_cmp_np(lv, rv)
+        else:
+            with np.errstate(invalid="ignore"):
+                out = self._op_np(lv, rv)
+        return pa.array(out, pa.bool_(),
+                        mask=None if valid.all() else ~valid)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} {self.symbol} {self.children[1]!r})"
+
+
+def _total_key_j(x):
+    """Map floats to a totally ordered key where NaN is greatest."""
+    nan = jnp.isnan(x)
+    big = jnp.where(nan, jnp.inf, x)
+    return big, nan
+
+
+def _total_key_np(x):
+    nan = np.isnan(x)
+    return np.where(nan, np.inf, x), nan
+
+
+class EqualTo(BinaryComparison):
+    symbol = "="
+
+    @staticmethod
+    def _op_tpu(l, r):
+        return l == r
+
+    @staticmethod
+    def _op_np(l, r):
+        return l == r
+
+    def _float_cmp_tpu(self, l, r):
+        return (l == r) | (jnp.isnan(l) & jnp.isnan(r))
+
+    def _float_cmp_np(self, l, r):
+        return (l == r) | (np.isnan(l) & np.isnan(r))
+
+    def _from_cmp_tpu(self, cmp):
+        return cmp == 0
+
+    @staticmethod
+    def _py_cmp(a, b):
+        return a == b
+
+
+class LessThan(BinaryComparison):
+    symbol = "<"
+
+    @staticmethod
+    def _op_tpu(l, r):
+        return l < r
+
+    @staticmethod
+    def _op_np(l, r):
+        return l < r
+
+    def _float_cmp_tpu(self, l, r):
+        lk, ln = _total_key_j(l)
+        rk, rn = _total_key_j(r)
+        return jnp.where(ln, False, jnp.where(rn, ~ln, lk < rk))
+
+    def _float_cmp_np(self, l, r):
+        lk, ln = _total_key_np(l)
+        rk, rn = _total_key_np(r)
+        return np.where(ln, False, np.where(rn, ~ln, lk < rk))
+
+    def _from_cmp_tpu(self, cmp):
+        return cmp < 0
+
+    @staticmethod
+    def _py_cmp(a, b):
+        return a < b
+
+
+class LessThanOrEqual(BinaryComparison):
+    symbol = "<="
+
+    @staticmethod
+    def _op_tpu(l, r):
+        return l <= r
+
+    @staticmethod
+    def _op_np(l, r):
+        return l <= r
+
+    def _float_cmp_tpu(self, l, r):
+        eq = (l == r) | (jnp.isnan(l) & jnp.isnan(r))
+        return LessThan._float_cmp_tpu(self, l, r) | eq
+
+    def _float_cmp_np(self, l, r):
+        eq = (l == r) | (np.isnan(l) & np.isnan(r))
+        return LessThan._float_cmp_np(self, l, r) | eq
+
+    def _from_cmp_tpu(self, cmp):
+        return cmp <= 0
+
+    @staticmethod
+    def _py_cmp(a, b):
+        return a <= b
+
+
+class GreaterThan(BinaryComparison):
+    symbol = ">"
+
+    def __init__(self, left, right):
+        super().__init__(left, right)
+
+    @staticmethod
+    def _op_tpu(l, r):
+        return l > r
+
+    @staticmethod
+    def _op_np(l, r):
+        return l > r
+
+    def _float_cmp_tpu(self, l, r):
+        return LessThan._float_cmp_tpu(self, r, l)
+
+    def _float_cmp_np(self, l, r):
+        return LessThan._float_cmp_np(self, r, l)
+
+    def _from_cmp_tpu(self, cmp):
+        return cmp > 0
+
+    @staticmethod
+    def _py_cmp(a, b):
+        return a > b
+
+
+class GreaterThanOrEqual(BinaryComparison):
+    symbol = ">="
+
+    @staticmethod
+    def _op_tpu(l, r):
+        return l >= r
+
+    @staticmethod
+    def _op_np(l, r):
+        return l >= r
+
+    def _float_cmp_tpu(self, l, r):
+        return LessThanOrEqual._float_cmp_tpu(self, r, l)
+
+    def _float_cmp_np(self, l, r):
+        return LessThanOrEqual._float_cmp_np(self, r, l)
+
+    def _from_cmp_tpu(self, cmp):
+        return cmp >= 0
+
+    @staticmethod
+    def _py_cmp(a, b):
+        return a >= b
+
+
+class EqualNullSafe(BinaryComparison):
+    """<=> : null-safe equality, never returns null."""
+    symbol = "<=>"
+
+    @staticmethod
+    def _op_tpu(l, r):
+        return l == r
+
+    @staticmethod
+    def _op_np(l, r):
+        return l == r
+
+    def _float_cmp_tpu(self, l, r):
+        return (l == r) | (jnp.isnan(l) & jnp.isnan(r))
+
+    def _float_cmp_np(self, l, r):
+        return (l == r) | (np.isnan(l) & np.isnan(r))
+
+    def _from_cmp_tpu(self, cmp):
+        return cmp == 0
+
+    @staticmethod
+    def _py_cmp(a, b):
+        return a == b
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval_tpu(self, batch, ctx):
+        raw = super().eval_tpu(batch, ctx)
+        l = self.children[0].eval_tpu(batch, ctx)
+        r = self.children[1].eval_tpu(batch, ctx)
+        both_null = (~l.validity) & (~r.validity)
+        either_null = (~l.validity) | (~r.validity)
+        data = jnp.where(either_null, both_null, raw.data)
+        cap = batch.capacity
+        return TpuColumnVector(dt.BOOL, data=data,
+                               validity=jnp.ones((cap,), jnp.bool_))
+
+    def eval_cpu(self, rb, ctx):
+        raw = super().eval_cpu(rb, ctx)
+        lnull = pc.is_null(self.children[0].eval_cpu(rb, ctx))
+        rnull = pc.is_null(self.children[1].eval_cpu(rb, ctx))
+        both = pc.and_(lnull, rnull)
+        either = pc.or_(lnull, rnull)
+        raw_filled = pc.fill_null(raw, False)
+        return pc.if_else(either, both, raw_filled)
+
+
+class And(Expression):
+    """Kleene AND: false & null = false, true & null = null."""
+
+    def __init__(self, left, right):
+        self.children = (left, right)
+
+    def validate(self):
+        assert all(c.dtype == dt.BOOL for c in self.children)
+
+    @property
+    def dtype(self):
+        return dt.BOOL
+
+    def eval_tpu(self, batch, ctx):
+        l = self.children[0].eval_tpu(batch, ctx)
+        r = self.children[1].eval_tpu(batch, ctx)
+        lv = l.data & l.validity  # treat null as "unknown", data garbage ok
+        rv = r.data & r.validity
+        lfalse = (~l.data) & l.validity
+        rfalse = (~r.data) & r.validity
+        data = lv & rv
+        valid = (l.validity & r.validity) | lfalse | rfalse
+        return TpuColumnVector(dt.BOOL, data=data, validity=valid)
+
+    def eval_cpu(self, rb, ctx):
+        return pc.and_kleene(self.children[0].eval_cpu(rb, ctx),
+                             self.children[1].eval_cpu(rb, ctx))
+
+    def __repr__(self):
+        return f"({self.children[0]!r} AND {self.children[1]!r})"
+
+
+class Or(Expression):
+    """Kleene OR: true | null = true, false | null = null."""
+
+    def __init__(self, left, right):
+        self.children = (left, right)
+
+    def validate(self):
+        assert all(c.dtype == dt.BOOL for c in self.children)
+
+    @property
+    def dtype(self):
+        return dt.BOOL
+
+    def eval_tpu(self, batch, ctx):
+        l = self.children[0].eval_tpu(batch, ctx)
+        r = self.children[1].eval_tpu(batch, ctx)
+        ltrue = l.data & l.validity
+        rtrue = r.data & r.validity
+        data = ltrue | rtrue
+        valid = (l.validity & r.validity) | ltrue | rtrue
+        return TpuColumnVector(dt.BOOL, data=data, validity=valid)
+
+    def eval_cpu(self, rb, ctx):
+        return pc.or_kleene(self.children[0].eval_cpu(rb, ctx),
+                            self.children[1].eval_cpu(rb, ctx))
+
+    def __repr__(self):
+        return f"({self.children[0]!r} OR {self.children[1]!r})"
+
+
+class Not(Expression):
+    def __init__(self, child):
+        self.children = (child,)
+
+    def validate(self):
+        assert self.children[0].dtype == dt.BOOL
+
+    @property
+    def dtype(self):
+        return dt.BOOL
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        return TpuColumnVector(dt.BOOL, data=~c.data, validity=c.validity)
+
+    def eval_cpu(self, rb, ctx):
+        return pc.invert(self.children[0].eval_cpu(rb, ctx))
+
+
+class IsNull(Expression):
+    def __init__(self, child):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return dt.BOOL
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        cap = batch.capacity
+        return TpuColumnVector(dt.BOOL, data=~c.validity,
+                               validity=jnp.ones((cap,), jnp.bool_))
+
+    def eval_cpu(self, rb, ctx):
+        return pc.is_null(self.children[0].eval_cpu(rb, ctx))
+
+
+class IsNotNull(Expression):
+    def __init__(self, child):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return dt.BOOL
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        cap = batch.capacity
+        return TpuColumnVector(dt.BOOL, data=c.validity,
+                               validity=jnp.ones((cap,), jnp.bool_))
+
+    def eval_cpu(self, rb, ctx):
+        return pc.is_valid(self.children[0].eval_cpu(rb, ctx))
+
+
+class IsNaN(Expression):
+    def __init__(self, child):
+        self.children = (child,)
+
+    def validate(self):
+        assert dt.is_floating(self.children[0].dtype)
+
+    @property
+    def dtype(self):
+        return dt.BOOL
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        cap = batch.capacity
+        return TpuColumnVector(dt.BOOL, data=jnp.isnan(c.data) & c.validity,
+                               validity=jnp.ones((cap,), jnp.bool_))
+
+    def eval_cpu(self, rb, ctx):
+        a = self.children[0].eval_cpu(rb, ctx)
+        return pc.fill_null(pc.is_nan(a), False)
+
+
+class In(Expression):
+    """value IN (literals...). Null semantics: if value is null -> null;
+    if no match but list contains null -> null."""
+
+    def __init__(self, value: Expression, items):
+        self.children = (value,)
+        self.items = tuple(items)  # python literal values (may include None)
+
+    @property
+    def dtype(self):
+        return dt.BOOL
+
+    def eval_tpu(self, batch, ctx):
+        from .base import Literal
+        c = self.children[0].eval_tpu(batch, ctx)
+        t = self.children[0].dtype
+        has_null = any(v is None for v in self.items)
+        vals = [v for v in self.items if v is not None]
+        if isinstance(t, (dt.StringType, dt.BinaryType)):
+            m = jnp.zeros((batch.capacity,), jnp.bool_)
+            for v in vals:
+                lit = Literal(v, t).eval_tpu(batch, ctx)
+                m = m | (string_compare_tpu(c, lit) == 0)
+        else:
+            m = jnp.zeros((batch.capacity,), jnp.bool_)
+            for v in vals:
+                lane = Literal(v, t).lane_value
+                m = m | (c.data == lane)
+        valid = c.validity & (m | (not has_null))
+        return TpuColumnVector(dt.BOOL, data=m, validity=valid)
+
+    def eval_cpu(self, rb, ctx):
+        a = self.children[0].eval_cpu(rb, ctx)
+        t = self.children[0].dtype
+        has_null = any(v is None for v in self.items)
+        vals = [v for v in self.items if v is not None]
+        vs = pa.array(vals, dt.to_arrow(t))
+        m = pc.is_in(a, value_set=vs)
+        m = pc.if_else(pc.is_valid(a), m, pa.nulls(len(a), pa.bool_()))
+        if has_null:
+            # non-matching valid rows become null
+            m = pc.if_else(pc.fill_null(m, False), m,
+                           pa.nulls(len(a), pa.bool_()))
+        return m

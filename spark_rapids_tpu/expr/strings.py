@@ -1,0 +1,547 @@
+"""String expressions (reference: stringFunctions.scala + the regex
+transpiler idea — SURVEY.md §2.2-C; built from capability description).
+
+Device coverage: length, upper/lower (ASCII), substring, concat,
+startswith/endswith/contains (literal patterns), trim family, like
+(translated to anchored literal fragments when possible). Regex and
+locale-sensitive ops run on host via per-expression fallback — the same
+partial-coverage-with-kill-switch strategy the reference shipped with.
+"""
+from __future__ import annotations
+
+import re as _re
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import datatypes as dt
+from ..columnar.column import TpuColumnVector
+from ..columnar.batch import bucket_bytes
+from ..ops import strings as sops
+from .base import Expression, Literal, np_result_to_arrow
+
+__all__ = ["Length", "Upper", "Lower", "Substring", "ConcatStrings",
+           "StartsWith", "EndsWith", "Contains", "Like", "StringTrim",
+           "StringTrimLeft", "StringTrimRight", "StringReplace",
+           "RegExpLike", "RegExpReplace", "RegExpExtract", "StringLocate",
+           "StringLpad", "StringRpad", "StringRepeat", "Reverse"]
+
+
+def _utf8_char_count_tpu(col: TpuColumnVector) -> jnp.ndarray:
+    """Character (code point) count: number of non-continuation bytes."""
+    is_cont = (col.chars & 0xC0) == 0x80
+    unit = jnp.where(is_cont, 0, 1).astype(jnp.int32)
+    csum = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                            jnp.cumsum(unit, dtype=jnp.int32)])
+    return csum[col.offsets[1:]] - csum[col.offsets[:-1]]
+
+
+class Length(Expression):
+    """char_length: counts characters, not bytes (Spark semantics)."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return dt.INT32
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        return TpuColumnVector(dt.INT32, data=_utf8_char_count_tpu(c),
+                               validity=c.validity)
+
+    def eval_cpu(self, rb, ctx):
+        return pc.cast(pc.utf8_length(self.children[0].eval_cpu(rb, ctx)),
+                       pa.int32())
+
+
+class _CaseMap(Expression):
+    to_upper = True
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return dt.STRING
+
+    def tpu_supported(self):
+        return None  # ASCII case mapping; non-ASCII governed by incompat conf
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        return sops.upper_ascii_tpu(c) if self.to_upper else \
+            sops.lower_ascii_tpu(c)
+
+    def eval_cpu(self, rb, ctx):
+        a = self.children[0].eval_cpu(rb, ctx)
+        return pc.utf8_upper(a) if self.to_upper else pc.utf8_lower(a)
+
+
+class Upper(_CaseMap):
+    to_upper = True
+
+
+class Lower(_CaseMap):
+    to_upper = False
+
+
+class Substring(Expression):
+    """substring(str, pos, len) — 1-based, negative pos from end.
+    Device kernel is byte-based (exact for ASCII); CPU is char-based."""
+
+    def __init__(self, child, pos: Expression, length: Expression):
+        self.children = (child, pos, length)
+
+    @property
+    def dtype(self):
+        return dt.STRING
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        p = self.children[1].eval_tpu(batch, ctx)
+        ln = self.children[2].eval_tpu(batch, ctx)
+        out = sops.substring_tpu(c, p.data.astype(jnp.int32),
+                                 ln.data.astype(jnp.int32),
+                                 int(c.chars.shape[0]))
+        return out.with_arrays(validity=c.validity & p.validity
+                               & ln.validity)
+
+    def eval_cpu(self, rb, ctx):
+        a = self.children[0].eval_cpu(rb, ctx)
+        ps = self.children[1].eval_cpu(rb, ctx)
+        ls = self.children[2].eval_cpu(rb, ctx)
+        out = []
+        for s, p, l in zip(a.to_pylist(), ps.to_pylist(), ls.to_pylist()):
+            if s is None or p is None or l is None:
+                out.append(None)
+                continue
+            if l <= 0:
+                out.append("")
+                continue
+            if p > 0:
+                start = p - 1
+            elif p < 0:
+                start = max(len(s) + p, 0)
+            else:
+                start = 0
+            out.append(s[start:start + l])
+        return pa.array(out, pa.string())
+
+
+class ConcatStrings(Expression):
+    """concat(s1, s2, ...) — null if any input is null."""
+
+    def __init__(self, *children):
+        self.children = tuple(children)
+
+    @property
+    def dtype(self):
+        return dt.STRING
+
+    def eval_tpu(self, batch, ctx):
+        cols = [c.eval_tpu(batch, ctx) for c in self.children]
+        cap = sum(int(c.chars.shape[0]) for c in cols)
+        return sops.concat_strings_tpu(cols, bucket_bytes(max(cap, 1)))
+
+    def eval_cpu(self, rb, ctx):
+        arrays = [c.eval_cpu(rb, ctx) for c in self.children]
+        return pc.binary_join_element_wise(*arrays, "",
+                                           null_handling="emit_null")
+
+
+class _LiteralPatternMatch(Expression):
+    """startswith/endswith/contains with a literal pattern."""
+    kernel = None
+    cpu_fn = None
+
+    def __init__(self, child, pattern: str):
+        self.children = (child,)
+        self.pattern = pattern
+
+    @property
+    def dtype(self):
+        return dt.BOOL
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        m = type(self).kernel(c, self.pattern.encode())
+        return TpuColumnVector(dt.BOOL, data=m, validity=c.validity)
+
+    def eval_cpu(self, rb, ctx):
+        a = self.children[0].eval_cpu(rb, ctx)
+        return type(self).cpu_fn(a, self.pattern)
+
+
+class StartsWith(_LiteralPatternMatch):
+    kernel = staticmethod(sops.starts_with_tpu)
+    cpu_fn = staticmethod(lambda a, p: pc.starts_with(a, pattern=p))
+
+
+class EndsWith(_LiteralPatternMatch):
+    kernel = staticmethod(sops.ends_with_tpu)
+    cpu_fn = staticmethod(lambda a, p: pc.ends_with(a, pattern=p))
+
+
+class Contains(_LiteralPatternMatch):
+    kernel = staticmethod(sops.contains_tpu)
+    cpu_fn = staticmethod(lambda a, p: pc.match_substring(a, pattern=p))
+
+
+class Like(Expression):
+    """SQL LIKE. %/_ wildcards; escape char support on CPU. On device the
+    pattern is decomposed into anchored literal fragments when it has the
+    simple shapes lit / lit% / %lit / %lit% / lit%lit; otherwise host."""
+
+    def __init__(self, child, pattern: str, escape: str = "\\"):
+        self.children = (child,)
+        self.pattern = pattern
+        self.escape = escape
+
+    @property
+    def dtype(self):
+        return dt.BOOL
+
+    def _simple_shape(self):
+        p = self.pattern
+        if self.escape in p or "_" in p:
+            return None
+        parts = p.split("%")
+        if len(parts) == 1:
+            return ("exact", parts[0])
+        if len(parts) == 2:
+            if parts[0] == "" and parts[1] == "":
+                return ("all",)
+            if parts[1] == "":
+                return ("prefix", parts[0])
+            if parts[0] == "":
+                return ("suffix", parts[1])
+            return ("prefix_suffix", parts[0], parts[1])
+        if len(parts) == 3 and parts[0] == "" and parts[2] == "":
+            return ("contains", parts[1])
+        return None
+
+    def tpu_supported(self):
+        if self._simple_shape() is None:
+            return f"LIKE pattern {self.pattern!r} requires host regex"
+        return None
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        shape = self._simple_shape()
+        assert shape is not None
+        kind = shape[0]
+        if kind == "all":
+            m = jnp.ones((batch.capacity,), jnp.bool_)
+        elif kind == "exact":
+            lit = Literal(shape[1], dt.STRING).eval_tpu(batch, ctx)
+            m = sops.string_compare_tpu(c, lit) == 0
+        elif kind == "prefix":
+            m = sops.starts_with_tpu(c, shape[1].encode())
+        elif kind == "suffix":
+            m = sops.ends_with_tpu(c, shape[1].encode())
+        elif kind == "contains":
+            m = sops.contains_tpu(c, shape[1].encode())
+        else:  # prefix_suffix
+            pre, suf = shape[1].encode(), shape[2].encode()
+            lens = sops.string_lengths(c)
+            m = (sops.starts_with_tpu(c, pre) & sops.ends_with_tpu(c, suf)
+                 & (lens >= len(pre) + len(suf)))
+        return TpuColumnVector(dt.BOOL, data=m, validity=c.validity)
+
+    def _to_regex(self):
+        out = []
+        i = 0
+        p = self.pattern
+        while i < len(p):
+            ch = p[i]
+            if ch == self.escape and i + 1 < len(p):
+                out.append(_re.escape(p[i + 1]))
+                i += 2
+                continue
+            if ch == "%":
+                out.append(".*")
+            elif ch == "_":
+                out.append(".")
+            else:
+                out.append(_re.escape(ch))
+            i += 1
+        return "(?s)^" + "".join(out) + "$"
+
+    def eval_cpu(self, rb, ctx):
+        a = self.children[0].eval_cpu(rb, ctx)
+        rx = _re.compile(self._to_regex())
+        return pa.array([None if v is None else bool(rx.match(v))
+                         for v in a.to_pylist()], pa.bool_())
+
+
+class StringTrim(Expression):
+    """trim() — strips ASCII space (0x20) like Spark's default trim."""
+    left = True
+    right = True
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return dt.STRING
+
+    def eval_tpu(self, batch, ctx):
+        c = self.children[0].eval_tpu(batch, ctx)
+        return _trim_tpu(c, self.left, self.right)
+
+    def eval_cpu(self, rb, ctx):
+        a = self.children[0].eval_cpu(rb, ctx)
+        if self.left and self.right:
+            return pc.utf8_trim(a, characters=" ")
+        if self.left:
+            return pc.utf8_ltrim(a, characters=" ")
+        return pc.utf8_rtrim(a, characters=" ")
+
+
+class StringTrimLeft(StringTrim):
+    left, right = True, False
+
+
+class StringTrimRight(StringTrim):
+    left, right = False, True
+
+
+def _trim_tpu(col: TpuColumnVector, left: bool, right: bool):
+    """Compute trimmed (start, len) per row then compact. Leading/trailing
+    space counts found via windowed scans."""
+    import jax
+    lens = sops.string_lengths(col)
+    n = lens.shape[0]
+    starts = col.offsets[:-1]
+
+    def count_spaces(from_left):
+        def body(state):
+            done, count, i = state
+            pos = jnp.where(from_left, starts + count,
+                            starts + lens - 1 - count)
+            pos = jnp.clip(pos, 0, max(col.chars.shape[0] - 1, 0))
+            ch = col.chars[pos] if col.chars.shape[0] else \
+                jnp.zeros((n,), jnp.uint8)
+            is_sp = (ch == 0x20) & (count < lens) & ~done
+            return done | ~is_sp, count + is_sp.astype(jnp.int32), i + 1
+
+        max_len = jnp.max(lens, initial=0)
+        done0 = jnp.zeros((n,), jnp.bool_)
+        cnt0 = jnp.zeros((n,), jnp.int32)
+        done, cnt, _ = jax.lax.while_loop(
+            lambda st: (~jnp.all(st[0])) & (st[2] <= max_len),
+            body, (done0, cnt0, jnp.int32(0)))
+        return cnt
+
+    lead = count_spaces(True) if left else jnp.zeros((n,), jnp.int32)
+    trail = count_spaces(False) if right else jnp.zeros((n,), jnp.int32)
+    new_lens = jnp.maximum(lens - lead - trail, 0)
+    from .conditional import _copy_ragged
+    return _copy_ragged(col, starts + lead, new_lens,
+                        int(col.chars.shape[0]))
+
+
+class StringReplace(Expression):
+    """replace(str, search, replace) with literal search (host)."""
+
+    def __init__(self, child, search: str, replacement: str):
+        self.children = (child,)
+        self.search = search
+        self.replacement = replacement
+
+    @property
+    def dtype(self):
+        return dt.STRING
+
+    def tpu_supported(self):
+        return "string replace runs on host"
+
+    def eval_cpu(self, rb, ctx):
+        a = self.children[0].eval_cpu(rb, ctx)
+        if self.search == "":
+            return a
+        return pc.replace_substring(a, pattern=self.search,
+                                    replacement=self.replacement)
+
+
+class RegExpLike(Expression):
+    """rlike — host regex engine (Java-dialect approximated with python re;
+    the reference transpiles to cudf's dialect, same partial-support idea)."""
+
+    def __init__(self, child, pattern: str):
+        self.children = (child,)
+        self.pattern = pattern
+
+    @property
+    def dtype(self):
+        return dt.BOOL
+
+    def tpu_supported(self):
+        return "regular expressions run on host"
+
+    def eval_cpu(self, rb, ctx):
+        a = self.children[0].eval_cpu(rb, ctx)
+        rx = _re.compile(self.pattern)
+        return pa.array([None if v is None else bool(rx.search(v))
+                         for v in a.to_pylist()], pa.bool_())
+
+
+class RegExpReplace(Expression):
+    def __init__(self, child, pattern: str, replacement: str):
+        self.children = (child,)
+        self.pattern = pattern
+        self.replacement = replacement
+
+    @property
+    def dtype(self):
+        return dt.STRING
+
+    def tpu_supported(self):
+        return "regular expressions run on host"
+
+    def eval_cpu(self, rb, ctx):
+        a = self.children[0].eval_cpu(rb, ctx)
+        rx = _re.compile(self.pattern)
+        repl = _re.sub(r"\$(\d)", r"\\\1", self.replacement)
+        return pa.array([None if v is None else rx.sub(repl, v)
+                         for v in a.to_pylist()], pa.string())
+
+
+class RegExpExtract(Expression):
+    def __init__(self, child, pattern: str, group: int = 1):
+        self.children = (child,)
+        self.pattern = pattern
+        self.group = group
+
+    @property
+    def dtype(self):
+        return dt.STRING
+
+    def tpu_supported(self):
+        return "regular expressions run on host"
+
+    def eval_cpu(self, rb, ctx):
+        a = self.children[0].eval_cpu(rb, ctx)
+        rx = _re.compile(self.pattern)
+        out = []
+        for v in a.to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            m = rx.search(v)
+            if m is None:
+                out.append("")
+            else:
+                g = m.group(self.group)
+                out.append(g if g is not None else "")
+        return pa.array(out, pa.string())
+
+
+class StringLocate(Expression):
+    """locate(substr, str, pos) -> 1-based index or 0 (host)."""
+
+    def __init__(self, substr: str, child, pos: int = 1):
+        self.children = (child,)
+        self.substr = substr
+        self.pos = pos
+
+    @property
+    def dtype(self):
+        return dt.INT32
+
+    def tpu_supported(self):
+        return "locate runs on host"
+
+    def eval_cpu(self, rb, ctx):
+        a = self.children[0].eval_cpu(rb, ctx)
+        out = []
+        for v in a.to_pylist():
+            if v is None:
+                out.append(None)
+            elif self.pos <= 0:
+                out.append(0)
+            else:
+                out.append(v.find(self.substr, self.pos - 1) + 1)
+        return pa.array(out, pa.int32())
+
+
+class _Pad(Expression):
+    left = True
+
+    def __init__(self, child, length: int, pad: str = " "):
+        self.children = (child,)
+        self.length = length
+        self.pad = pad
+
+    @property
+    def dtype(self):
+        return dt.STRING
+
+    def tpu_supported(self):
+        return "pad runs on host"
+
+    def eval_cpu(self, rb, ctx):
+        a = self.children[0].eval_cpu(rb, ctx)
+        out = []
+        for v in a.to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            if len(v) >= self.length:
+                out.append(v[: self.length])
+            elif not self.pad:
+                out.append(v)
+            else:
+                fill = (self.pad * self.length)[: self.length - len(v)]
+                out.append(fill + v if self.left else v + fill)
+        return pa.array(out, pa.string())
+
+
+class StringLpad(_Pad):
+    left = True
+
+
+class StringRpad(_Pad):
+    left = False
+
+
+class StringRepeat(Expression):
+    def __init__(self, child, times: int):
+        self.children = (child,)
+        self.times = times
+
+    @property
+    def dtype(self):
+        return dt.STRING
+
+    def tpu_supported(self):
+        return "repeat runs on host"
+
+    def eval_cpu(self, rb, ctx):
+        a = self.children[0].eval_cpu(rb, ctx)
+        return pa.array([None if v is None else v * max(self.times, 0)
+                         for v in a.to_pylist()], pa.string())
+
+
+class Reverse(Expression):
+    """reverse(str) — host (UTF-8 aware)."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return dt.STRING
+
+    def tpu_supported(self):
+        return "reverse runs on host"
+
+    def eval_cpu(self, rb, ctx):
+        a = self.children[0].eval_cpu(rb, ctx)
+        return pa.array([None if v is None else v[::-1]
+                         for v in a.to_pylist()], pa.string())

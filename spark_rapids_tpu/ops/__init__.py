@@ -1,0 +1,2 @@
+"""Device kernel library (Pallas/XLA) — the TPU replacement for libcudf's
+CUDA kernels (SURVEY.md §2.2-E)."""

@@ -1,0 +1,170 @@
+"""Device-side numeric -> string formatting kernels.
+
+cudf has dedicated kernels for this (SURVEY.md §2.2-E); on TPU we generate
+digit bytes with vectorized integer arithmetic into fixed-width per-row
+windows, then compact to ragged Arrow layout.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import datatypes as dt
+from ..columnar.column import TpuColumnVector
+
+__all__ = ["int_to_string_tpu", "bool_to_string_tpu", "date_to_string_tpu",
+           "decimal_to_string_tpu", "ragged_from_fixed"]
+
+_MAX_I64_DIGITS = 19
+
+
+def ragged_from_fixed(bytes_mat: jax.Array, lens: jax.Array,
+                      validity: jax.Array,
+                      dtype=dt.STRING) -> TpuColumnVector:
+    """(n, W) byte matrix + per-row lengths -> ragged string column.
+
+    Rows are left-aligned in the window. Char capacity = n*W (static)."""
+    n, w = bytes_mat.shape
+    lens = lens.astype(jnp.int32)
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(lens, dtype=jnp.int32)])
+    char_cap = n * w
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    in_range = pos < lens[:, None]
+    dst = jnp.where(in_range, offsets[:-1][:, None] + pos, char_cap)
+    out = jnp.zeros((char_cap,), jnp.uint8)
+    out = out.at[dst.reshape(-1)].set(bytes_mat.reshape(-1).astype(jnp.uint8),
+                                      mode="drop")
+    return TpuColumnVector(dtype, validity=validity, offsets=offsets,
+                           chars=out)
+
+
+def _digits_mat(absval: jax.Array, width: int):
+    """(n, width) digit matrix, most significant first, and digit count."""
+    powers = jnp.asarray([10 ** (width - 1 - i) for i in range(width)],
+                         dtype=jnp.int64)[None, :]
+    v = absval.astype(jnp.int64)[:, None]
+    digs = (v // powers) % 10
+    # exact digit count via integer thresholds (float log10 is unsafe on
+    # TPU where f64 computes as f32)
+    thresholds = jnp.asarray([10 ** k for k in range(1, width)],
+                             dtype=jnp.int64)[None, :]
+    ndig = 1 + jnp.sum(absval.astype(jnp.int64)[:, None] >= thresholds,
+                       axis=1).astype(jnp.int32)
+    return digs, ndig
+
+
+def int_to_string_tpu(col: TpuColumnVector) -> TpuColumnVector:
+    """Java Long.toString for any integral lane."""
+    v = col.data.astype(jnp.int64)
+    neg = v < 0
+    # abs(INT64_MIN) overflows int64; compute |v| as (|v|-1)+1 for negatives
+    # and special-case INT64_MIN with its literal below.
+    absv = jnp.where(neg, -(v + 1), v)  # = |v|-1 for negatives, no overflow
+    adj = jnp.where(neg, 1, 0)
+    # digits of absv+adj without overflow: absv <= i64max-1 so +1 safe? only
+    # for min: -(min+1) = max, +1 overflows. Special-case min below.
+    is_min = v == jnp.int64(-(2**63))
+    safe_abs = jnp.where(is_min, 0, absv + adj)
+    width = _MAX_I64_DIGITS
+    digs, ndig = _digits_mat(safe_abs, width)
+    lens = ndig + neg.astype(jnp.int32)
+    total_w = width + 1  # sign slot
+    # layout: optional '-', then digits with leading zeros trimmed.
+    posj = jnp.arange(total_w, dtype=jnp.int32)[None, :]
+    digit_pos = posj - neg[:, None].astype(jnp.int32)  # 0..ndig-1
+    src_idx = width - ndig[:, None] + digit_pos
+    src_idx_c = jnp.clip(src_idx, 0, width - 1)
+    dvals = jnp.take_along_axis(digs, src_idx_c.astype(jnp.int32), axis=1)
+    bytes_ = (dvals + ord("0")).astype(jnp.uint8)
+    bytes_ = jnp.where((posj == 0) & neg[:, None], ord("-"), bytes_)
+    # INT64_MIN literal
+    min_lit = np.frombuffer(b"-9223372036854775808", np.uint8)
+    min_mat = jnp.zeros((total_w,), jnp.uint8).at[:20].set(
+        jnp.asarray(min_lit))
+    bytes_ = jnp.where(is_min[:, None], min_mat[None, :], bytes_)
+    lens = jnp.where(is_min, 20, lens)
+    return ragged_from_fixed(bytes_, lens, col.validity)
+
+
+def bool_to_string_tpu(col: TpuColumnVector) -> TpuColumnVector:
+    t = np.frombuffer(b"true\x00", np.uint8)
+    f = np.frombuffer(b"false", np.uint8)
+    mat = jnp.where(col.data[:, None],
+                    jnp.asarray(t)[None, :], jnp.asarray(f)[None, :])
+    lens = jnp.where(col.data, 4, 5).astype(jnp.int32)
+    return ragged_from_fixed(mat, lens, col.validity)
+
+
+def _civil_from_days(z):
+    """Days-since-epoch -> (year, month, day). Hinnant's algorithm,
+    branch-free integer ops (public-domain well-known algorithm)."""
+    z = z.astype(jnp.int64) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def date_to_string_tpu(col: TpuColumnVector) -> TpuColumnVector:
+    """YYYY-MM-DD (Spark format for positive 4-digit years)."""
+    y, m, d = _civil_from_days(col.data)
+    n = col.data.shape[0]
+
+    def dig(v, p):
+        return ((v // p) % 10 + ord("0")).astype(jnp.uint8)
+
+    cols = [dig(y, 1000), dig(y, 100), dig(y, 10), dig(y, 1),
+            jnp.full((n,), ord("-"), jnp.uint8),
+            dig(m, 10), dig(m, 1),
+            jnp.full((n,), ord("-"), jnp.uint8),
+            dig(d, 10), dig(d, 1)]
+    mat = jnp.stack(cols, axis=1)
+    lens = jnp.full((n,), 10, jnp.int32)
+    return ragged_from_fixed(mat, lens, col.validity)
+
+
+def decimal_to_string_tpu(col: TpuColumnVector, scale: int) \
+        -> TpuColumnVector:
+    """Unscaled int64 -> decimal string like Java BigDecimal.toString
+    (plain notation for our scale ranges)."""
+    v = col.data.astype(jnp.int64)
+    neg = v < 0
+    absv = jnp.where(neg, -v, v)  # (abs of int64-min decimal unlikely: cap)
+    width = _MAX_I64_DIGITS
+    digs, ndig = _digits_mat(absv, width)
+    n = v.shape[0]
+    if scale == 0:
+        posj = jnp.arange(width + 1, dtype=jnp.int32)[None, :]
+        digit_pos = posj - neg[:, None].astype(jnp.int32)
+        src = jnp.clip(width - ndig[:, None] + digit_pos, 0, width - 1)
+        bytes_ = (jnp.take_along_axis(digs, src, axis=1)
+                  + ord("0")).astype(jnp.uint8)
+        bytes_ = jnp.where((posj == 0) & neg[:, None], ord("-"), bytes_)
+        return ragged_from_fixed(bytes_, ndig + neg, col.validity)
+    # with scale: int part digits = max(ndig - scale, 1), then '.', then
+    # `scale` fraction digits (zero-padded)
+    int_digits = jnp.maximum(ndig - scale, 1)
+    total_w = width + 3  # sign + dot + possible leading 0
+    lens = neg.astype(jnp.int32) + int_digits + 1 + scale
+    posj = jnp.arange(total_w, dtype=jnp.int32)[None, :]
+    p = posj - neg[:, None].astype(jnp.int32)  # position ignoring sign
+    intd = int_digits[:, None]
+    is_dot = p == intd
+    # digit index within the full (int+frac) digit string:
+    dpos = jnp.where(p < intd, p, p - 1)  # skip dot
+    total_digits = intd + scale
+    src = jnp.clip(width - total_digits + dpos, 0, width - 1)
+    dvals = jnp.take_along_axis(digs, src, axis=1)
+    # positions before (width - total_digits) are leading zeros -> digit 0
+    bytes_ = (dvals + ord("0")).astype(jnp.uint8)
+    bytes_ = jnp.where(is_dot, ord("."), bytes_)
+    bytes_ = jnp.where((posj == 0) & neg[:, None], ord("-"), bytes_)
+    return ragged_from_fixed(bytes_, lens, col.validity)

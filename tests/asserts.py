@@ -1,0 +1,74 @@
+"""Dual-run equivalence assertions (reference: integration_tests asserts.py
+`assert_gpu_and_cpu_are_equal_collect` — SURVEY.md §4.1; built from
+capability description, mount empty).
+
+Expression-level: evaluate the same expression tree on the CPU (pyarrow/
+numpy, Spark-semantics oracle) and on the TPU path (device batch), compare.
+Plan-level helpers are added with the session API.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import datatypes as dt
+from spark_rapids_tpu.columnar import arrow_to_device
+from spark_rapids_tpu.columnar.arrow_bridge import device_column_to_arrow
+from spark_rapids_tpu.expr.base import EvalCtx, bind_expr
+from spark_rapids_tpu.columnar.arrow_bridge import engine_schema
+
+
+def _normalize(values, t: dt.DataType, approx_float=False):
+    out = []
+    for v in values:
+        if v is None:
+            out.append(None)
+        elif dt.is_floating(t):
+            if isinstance(v, float) and math.isnan(v):
+                out.append("NaN")
+            elif approx_float and isinstance(v, float) and math.isfinite(v):
+                out.append(round(v, 10) if abs(v) < 1e100 else v)
+            else:
+                out.append(v)
+        else:
+            out.append(v)
+    return out
+
+
+def assert_columns_equal(cpu: pa.Array, tpu: pa.Array, t: dt.DataType,
+                         approx_float=False, label=""):
+    cl = _normalize(cpu.to_pylist(), t, approx_float)
+    tl = _normalize(tpu.to_pylist(), t, approx_float)
+    if approx_float and dt.is_floating(t):
+        assert len(cl) == len(tl), f"{label}: length {len(cl)} vs {len(tl)}"
+        for i, (a, b) in enumerate(zip(cl, tl)):
+            if a == b:
+                continue
+            if isinstance(a, float) and isinstance(b, float):
+                assert a == b or abs(a - b) <= 1e-6 * max(1.0, abs(a)), \
+                    f"{label} row {i}: cpu={a!r} tpu={b!r}"
+            else:
+                raise AssertionError(f"{label} row {i}: cpu={a!r} tpu={b!r}")
+    else:
+        assert cl == tl, (
+            f"{label}: mismatch\n cpu={cl[:20]}\n tpu={tl[:20]}"
+            + (f"\n (first diff at row "
+               f"{next(i for i, (a, b) in enumerate(zip(cl, tl)) if a != b)})"
+               if cl != tl and len(cl) == len(tl) else ""))
+
+
+def assert_tpu_and_cpu_expr_equal(expr, rb: pa.RecordBatch, ansi=False,
+                                  approx_float=False, label=""):
+    """Evaluate `expr` (with UnresolvedColumn refs) both ways and compare."""
+    schema = engine_schema(rb.schema)
+    bound = bind_expr(expr, schema)
+    ctx = EvalCtx(ansi=ansi)
+    cpu = bound.eval_cpu(rb, ctx)
+    batch = arrow_to_device(rb, schema)
+    tcol = bound.eval_tpu(batch, ctx)
+    tpu = device_column_to_arrow(tcol, rb.num_rows)
+    assert_columns_equal(cpu, tpu, bound.dtype, approx_float,
+                         label or repr(expr))
+    return cpu

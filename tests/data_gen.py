@@ -1,0 +1,180 @@
+"""Seeded typed data generators — the property-based backbone of the
+dual-run equivalence harness (reference: integration_tests data_gen.py —
+SURVEY.md §4.1; built from capability description, mount empty).
+
+Each generator produces a pyarrow array with configurable null fraction and
+the nasty special values (NaN, ±0.0, INT_MIN/MAX, empty/unicode strings).
+"""
+from __future__ import annotations
+
+import datetime
+import decimal
+import string as _string
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import datatypes as dt
+
+DEFAULT_SEED = 1234
+
+
+class DataGen:
+    def __init__(self, dtype: dt.DataType, nullable=True, null_frac=0.1):
+        self.dtype = dtype
+        self.nullable = nullable
+        self.null_frac = null_frac if nullable else 0.0
+
+    def _nulls(self, rng, n):
+        if not self.null_frac:
+            return None
+        return rng.random(n) < self.null_frac
+
+    def generate(self, rng: np.random.Generator, n: int) -> pa.Array:
+        vals = self._values(rng, n)
+        nulls = self._nulls(rng, n)
+        if nulls is not None:
+            vals = [None if m else v for v, m in zip(vals, nulls)]
+        return pa.array(vals, type=dt.to_arrow(self.dtype))
+
+
+class IntegerGen(DataGen):
+    def __init__(self, dtype=dt.INT32, nullable=True, null_frac=0.1,
+                 min_val=None, max_val=None, special=True):
+        super().__init__(dtype, nullable, null_frac)
+        info = np.iinfo(dtype.np_dtype)
+        self.min_val = info.min if min_val is None else min_val
+        self.max_val = info.max if max_val is None else max_val
+        self.special = special and min_val is None and max_val is None
+
+    def _values(self, rng, n):
+        out = rng.integers(self.min_val, self.max_val, size=n,
+                           endpoint=True, dtype=np.int64).tolist()
+        if self.special and n >= 4:
+            out[0], out[1], out[2] = self.min_val, self.max_val, 0
+        return out
+
+
+class LongGen(IntegerGen):
+    def __init__(self, **kw):
+        kw.setdefault("dtype", dt.INT64)
+        super().__init__(**kw)
+
+
+class ByteGen(IntegerGen):
+    def __init__(self, **kw):
+        kw.setdefault("dtype", dt.INT8)
+        super().__init__(**kw)
+
+
+class ShortGen(IntegerGen):
+    def __init__(self, **kw):
+        kw.setdefault("dtype", dt.INT16)
+        super().__init__(**kw)
+
+
+class BooleanGen(DataGen):
+    def __init__(self, nullable=True, null_frac=0.1):
+        super().__init__(dt.BOOL, nullable, null_frac)
+
+    def _values(self, rng, n):
+        return rng.integers(0, 2, n).astype(bool).tolist()
+
+
+class FloatGen(DataGen):
+    def __init__(self, dtype=dt.FLOAT64, nullable=True, null_frac=0.1,
+                 special=True, no_nans=False):
+        super().__init__(dtype, nullable, null_frac)
+        self.special = special
+        self.no_nans = no_nans
+
+    def _values(self, rng, n):
+        lane = self.dtype.np_dtype
+        out = (rng.standard_normal(n) *
+               rng.choice([1.0, 100.0, 1e6], n)).astype(lane).tolist()
+        if self.special and n >= 6:
+            out[0], out[1], out[2] = 0.0, -0.0, 1.0
+            if not self.no_nans:
+                out[3] = float("nan")
+                out[4] = float("inf")
+                out[5] = float("-inf")
+        return out
+
+
+class DoubleGen(FloatGen):
+    pass
+
+
+class StringGen(DataGen):
+    def __init__(self, nullable=True, null_frac=0.1, max_len=20,
+                 charset=None, special=True, ascii_only=False):
+        super().__init__(dt.STRING, nullable, null_frac)
+        self.max_len = max_len
+        self.charset = charset or (_string.ascii_letters + _string.digits
+                                   + " ,.;-_")
+        self.special = special
+        self.ascii_only = ascii_only
+
+    def _values(self, rng, n):
+        lens = rng.integers(0, self.max_len, n)
+        chars = np.array(list(self.charset))
+        out = ["".join(rng.choice(chars, size=l)) for l in lens]
+        if self.special and n >= 4:
+            out[0] = ""
+            out[1] = "A" * self.max_len
+            if not self.ascii_only:
+                out[2] = "héllo wörld"
+                out[3] = "日本語"
+        return out
+
+
+class DecimalGen(DataGen):
+    def __init__(self, precision=10, scale=2, nullable=True, null_frac=0.1):
+        super().__init__(dt.DecimalType(precision, scale), nullable,
+                         null_frac)
+
+    def _values(self, rng, n):
+        p, s = self.dtype.precision, self.dtype.scale
+        lim = 10 ** p - 1
+        unscaled = rng.integers(-lim, lim, size=n, endpoint=True)
+        q = decimal.Decimal(1).scaleb(-s)
+        return [decimal.Decimal(int(u)).scaleb(-s).quantize(q)
+                for u in unscaled]
+
+
+class DateGen(DataGen):
+    def __init__(self, nullable=True, null_frac=0.1,
+                 start_days=-25567, end_days=40000):  # 1900..2079
+        super().__init__(dt.DATE, nullable, null_frac)
+        self.start_days, self.end_days = start_days, end_days
+
+    def _values(self, rng, n):
+        days = rng.integers(self.start_days, self.end_days, n)
+        epoch = datetime.date(1970, 1, 1)
+        return [epoch + datetime.timedelta(days=int(d)) for d in days]
+
+
+class TimestampGen(DataGen):
+    def __init__(self, nullable=True, null_frac=0.1):
+        super().__init__(dt.TIMESTAMP, nullable, null_frac)
+
+    def _values(self, rng, n):
+        us = rng.integers(-2208988800_000_000, 3250368000_000_000, n)
+        return [datetime.datetime.fromtimestamp(
+            int(u) / 1e6, tz=datetime.timezone.utc) for u in us]
+
+
+# canonical generator sets, mirroring the reference's groupings
+numeric_gens = [ByteGen(), ShortGen(), IntegerGen(), LongGen(),
+                FloatGen(dt.FLOAT32), FloatGen(dt.FLOAT64)]
+integral_gens = [ByteGen(), ShortGen(), IntegerGen(), LongGen()]
+all_basic_gens = numeric_gens + [BooleanGen(), StringGen(), DateGen(),
+                                 TimestampGen(), DecimalGen()]
+
+
+def gen_table(gens, n=256, seed=DEFAULT_SEED, names=None) -> pa.RecordBatch:
+    """Build a RecordBatch from generators (column per gen)."""
+    rng = np.random.default_rng(seed)
+    arrays = [g.generate(rng, n) for g in gens]
+    names = names or [f"c{i}" for i in range(len(gens))]
+    return pa.record_batch(dict(zip(names, arrays)))

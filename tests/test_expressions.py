@@ -1,0 +1,289 @@
+"""Dual-run tests for the scalar expression library (arithmetic, predicates,
+conditionals, cast) — the engine twin of arithmetic_ops_test / cmp_test /
+cast_test in the reference's integration suite."""
+import pytest
+
+from spark_rapids_tpu import datatypes as dt
+from spark_rapids_tpu.expr import (
+    Add, Subtract, Multiply, Divide, IntegralDivide, Remainder, Pmod,
+    UnaryMinus, Abs, EqualTo, EqualNullSafe, LessThan, LessThanOrEqual,
+    GreaterThan, GreaterThanOrEqual, And, Or, Not, IsNull, IsNotNull,
+    IsNaN, In, If, CaseWhen, Coalesce, Least, Greatest, NullIf, Cast,
+    Literal, UnresolvedColumn as col)
+
+from asserts import assert_tpu_and_cpu_expr_equal as check
+from data_gen import (gen_table, IntegerGen, LongGen, ByteGen, ShortGen,
+                      FloatGen, DoubleGen, BooleanGen, StringGen,
+                      DecimalGen, DateGen, TimestampGen)
+
+
+def two_col_table(gen, n=256, seed=7):
+    return gen_table([gen, gen], n=n, seed=seed, names=["a", "b"])
+
+
+INT_GENS = [ByteGen(), ShortGen(), IntegerGen(), LongGen()]
+NUM_GENS = INT_GENS + [FloatGen(dt.FLOAT32), FloatGen(dt.FLOAT64)]
+
+
+@pytest.mark.parametrize("gen", NUM_GENS, ids=lambda g: str(g.dtype))
+@pytest.mark.parametrize("op", [Add, Subtract, Multiply])
+def test_binary_arithmetic(gen, op):
+    rb = two_col_table(gen)
+    check(op(col("a"), col("b")), rb)
+
+
+@pytest.mark.parametrize("gen", [FloatGen(dt.FLOAT32), FloatGen(dt.FLOAT64)],
+                         ids=["f32", "f64"])
+def test_float_divide(gen):
+    rb = two_col_table(gen)
+    check(Divide(col("a"), col("b")), rb)
+
+
+def test_divide_by_zero_null():
+    import pyarrow as pa
+    rb = pa.record_batch({"a": pa.array([1.0, 2.0, None]),
+                          "b": pa.array([0.0, 1.0, 2.0])})
+    out = check(Divide(col("a"), col("b")), rb)
+    assert out.to_pylist() == [None, 2.0, None]
+
+
+@pytest.mark.parametrize("gen", INT_GENS, ids=lambda g: str(g.dtype))
+def test_integral_divide_remainder(gen):
+    rb = two_col_table(gen)
+    check(IntegralDivide(col("a"), col("b")), rb)
+    check(Remainder(col("a"), col("b")), rb)
+    check(Pmod(col("a"), col("b")), rb)
+
+
+def test_remainder_sign():
+    import pyarrow as pa
+    rb = pa.record_batch({"a": pa.array([7, -7, 7, -7], pa.int32()),
+                          "b": pa.array([3, 3, -3, -3], pa.int32())})
+    out = check(Remainder(col("a"), col("b")), rb)
+    assert out.to_pylist() == [1, -1, 1, -1]  # Java % semantics
+    out = check(Pmod(col("a"), col("b")), rb)
+    assert out.to_pylist() == [1, 2, 1, 2]
+
+
+@pytest.mark.parametrize("gen", NUM_GENS + [DecimalGen()],
+                         ids=lambda g: str(g.dtype))
+def test_unary(gen):
+    rb = two_col_table(gen)
+    check(UnaryMinus(col("a")), rb)
+    check(Abs(col("a")), rb)
+
+
+@pytest.mark.parametrize("gen", NUM_GENS + [BooleanGen(), StringGen(),
+                                            DateGen(), TimestampGen(),
+                                            DecimalGen()],
+                         ids=lambda g: str(g.dtype))
+@pytest.mark.parametrize("op", [EqualTo, LessThan, LessThanOrEqual,
+                                GreaterThan, GreaterThanOrEqual,
+                                EqualNullSafe])
+def test_comparisons(gen, op):
+    rb = two_col_table(gen)
+    check(op(col("a"), col("b")), rb)
+
+
+def test_float_nan_ordering():
+    import pyarrow as pa
+    nan = float("nan")
+    rb = pa.record_batch({"a": pa.array([nan, nan, 1.0, float("inf")]),
+                          "b": pa.array([nan, 1.0, nan, nan])})
+    assert check(EqualTo(col("a"), col("b")), rb).to_pylist() == \
+        [True, False, False, False]
+    assert check(GreaterThan(col("a"), col("b")), rb).to_pylist() == \
+        [False, True, False, False]
+    assert check(LessThan(col("a"), col("b")), rb).to_pylist() == \
+        [False, False, True, True]
+
+
+def test_kleene_logic():
+    import pyarrow as pa
+    vals = [True, False, None]
+    a = [x for x in vals for _ in vals]
+    b = vals * 3
+    rb = pa.record_batch({"a": pa.array(a), "b": pa.array(b)})
+    assert check(And(col("a"), col("b")), rb).to_pylist() == \
+        [True, False, None, False, False, False, None, False, None]
+    assert check(Or(col("a"), col("b")), rb).to_pylist() == \
+        [True, True, True, True, False, None, True, None, None]
+    check(Not(col("a")), rb)
+
+
+@pytest.mark.parametrize("gen", [IntegerGen(), StringGen(), DoubleGen()],
+                         ids=["int", "str", "double"])
+def test_null_tests(gen):
+    rb = two_col_table(gen)
+    check(IsNull(col("a")), rb)
+    check(IsNotNull(col("a")), rb)
+
+
+def test_isnan():
+    rb = two_col_table(FloatGen(dt.FLOAT64))
+    check(IsNaN(col("a")), rb)
+
+
+def test_in():
+    rb = two_col_table(IntegerGen(min_val=0, max_val=10))
+    check(In(col("a"), [1, 3, 5]), rb)
+    check(In(col("a"), [1, 3, None]), rb)
+    srb = two_col_table(StringGen(max_len=3))
+    check(In(col("a"), ["a", "Ab", ""]), srb)
+
+
+@pytest.mark.parametrize("gen", [IntegerGen(), DoubleGen(), StringGen(),
+                                 DecimalGen()],
+                         ids=["int", "double", "str", "dec"])
+def test_if_coalesce(gen):
+    rb = gen_table([BooleanGen(), gen, gen], names=["p", "a", "b"])
+    check(If(col("p"), col("a"), col("b")), rb)
+    check(Coalesce(col("a"), col("b")), rb)
+    check(NullIf(col("a"), col("b")), rb)
+
+
+def test_case_when():
+    rb = gen_table([IntegerGen(min_val=-50, max_val=50), IntegerGen()],
+                   names=["x", "y"])
+    ten = Literal(10, dt.INT32)
+    expr = CaseWhen(
+        [(LessThan(col("x"), Literal(0, dt.INT32)), UnaryMinus(col("x"))),
+         (LessThan(col("x"), ten), Add(col("x"), ten))],
+        else_value=col("y"))
+    check(expr, rb)
+
+
+@pytest.mark.parametrize("gen", [IntegerGen(), DoubleGen()],
+                         ids=["int", "double"])
+def test_least_greatest(gen):
+    rb = gen_table([gen, gen, gen], names=["a", "b", "c"])
+    check(Least(col("a"), col("b"), col("c")), rb)
+    check(Greatest(col("a"), col("b"), col("c")), rb)
+
+
+# ---- cast matrix ---------------------------------------------------------
+
+NUMERIC_TYPES = [dt.INT8, dt.INT16, dt.INT32, dt.INT64, dt.FLOAT32,
+                 dt.FLOAT64]
+
+
+@pytest.mark.parametrize("to_t", NUMERIC_TYPES, ids=lambda t: str(t))
+@pytest.mark.parametrize("gen", NUM_GENS, ids=lambda g: str(g.dtype))
+def test_cast_numeric_matrix(gen, to_t):
+    rb = two_col_table(gen)
+    check(Cast(col("a"), to_t), rb)
+
+
+@pytest.mark.parametrize("gen", NUM_GENS, ids=lambda g: str(g.dtype))
+def test_cast_numeric_to_bool(gen):
+    rb = two_col_table(gen)
+    check(Cast(col("a"), dt.BOOL), rb)
+
+
+def test_cast_bool_numeric():
+    rb = two_col_table(BooleanGen())
+    for t in NUMERIC_TYPES:
+        check(Cast(col("a"), t), rb)
+
+
+def test_cast_int_to_string():
+    for gen in INT_GENS:
+        rb = two_col_table(gen)
+        check(Cast(col("a"), dt.STRING), rb)
+
+
+def test_cast_bool_to_string():
+    check(Cast(col("a"), dt.STRING), two_col_table(BooleanGen()))
+
+
+def test_cast_date_to_string():
+    check(Cast(col("a"), dt.STRING), two_col_table(DateGen()))
+
+
+def test_cast_decimal_to_string():
+    for p, s in [(10, 2), (18, 0), (7, 7), (5, 1)]:
+        rb = two_col_table(DecimalGen(p, s))
+        check(Cast(col("a"), dt.STRING), rb)
+
+
+def test_cast_decimal_conversions():
+    rb = two_col_table(DecimalGen(10, 2))
+    check(Cast(col("a"), dt.DecimalType(12, 4)), rb)
+    check(Cast(col("a"), dt.DecimalType(8, 0)), rb)
+    check(Cast(col("a"), dt.INT64), rb)
+    check(Cast(col("a"), dt.FLOAT64), rb)
+    rb2 = two_col_table(IntegerGen(min_val=-10**6, max_val=10**6))
+    check(Cast(col("a"), dt.DecimalType(12, 2)), rb2)
+
+
+def test_cast_date_timestamp():
+    rb = two_col_table(DateGen())
+    check(Cast(col("a"), dt.TIMESTAMP), rb)
+    rb2 = two_col_table(TimestampGen())
+    check(Cast(col("a"), dt.DATE), rb2)
+    check(Cast(col("a"), dt.INT64), rb2)
+
+
+def test_cast_string_to_numeric_cpu():
+    """String parsing runs on host (fallback per tpu_supported)."""
+    import pyarrow as pa
+    rb = pa.record_batch({"a": pa.array(
+        ["1", " 42 ", "-7", "2.5", "abc", "", None, "99999999999999999999",
+         "NaN", "Infinity", "-Infinity", "1e3"])})
+    from spark_rapids_tpu.expr.base import bind_expr, EvalCtx
+    from spark_rapids_tpu.columnar.arrow_bridge import engine_schema
+    bound = bind_expr(Cast(col("a"), dt.INT32), engine_schema(rb.schema))
+    assert bound.tpu_supported() is not None  # planner will fall back
+    out = bound.eval_cpu(rb, EvalCtx())
+    assert out.to_pylist() == [1, 42, -7, 2, None, None, None, None,
+                               None, None, None, None]
+    d = bind_expr(Cast(col("a"), dt.FLOAT64), engine_schema(rb.schema))
+    out = d.eval_cpu(rb, EvalCtx())
+    lst = out.to_pylist()
+    assert lst[0] == 1.0 and lst[3] == 2.5 and lst[4] is None
+    assert str(lst[8]) == "nan" and lst[9] == float("inf")
+    assert lst[11] == 1000.0
+
+
+def test_cast_float_to_string_cpu():
+    import pyarrow as pa
+    rb = pa.record_batch({"a": pa.array(
+        [1.0, -0.5, float("nan"), float("inf"), None, 123456.0])})
+    from spark_rapids_tpu.expr.base import bind_expr, EvalCtx
+    from spark_rapids_tpu.columnar.arrow_bridge import engine_schema
+    bound = bind_expr(Cast(col("a"), dt.STRING), engine_schema(rb.schema))
+    out = bound.eval_cpu(rb, EvalCtx())
+    assert out.to_pylist() == ["1.0", "-0.5", "NaN", "Infinity", None,
+                               "123456.0"]
+
+
+def test_ansi_div_by_zero_raises():
+    import pyarrow as pa
+    from spark_rapids_tpu.expr.base import bind_expr, EvalCtx, ExprError
+    from spark_rapids_tpu.columnar.arrow_bridge import engine_schema
+    rb = pa.record_batch({"a": pa.array([1.0]), "b": pa.array([0.0])})
+    bound = bind_expr(Divide(col("a"), col("b")), engine_schema(rb.schema))
+    with pytest.raises(ExprError):
+        bound.eval_cpu(rb, EvalCtx(ansi=True))
+
+
+# ---- string kernels ------------------------------------------------------
+
+def test_string_comparisons_detail():
+    import pyarrow as pa
+    rb = pa.record_batch({
+        "a": pa.array(["apple", "b", "", "same", "prefix", "unié"]),
+        "b": pa.array(["apricot", "a", "x", "same", "prefixlonger", "uni"])})
+    assert check(LessThan(col("a"), col("b")), rb).to_pylist() == \
+        [True, False, True, False, True, False]
+    assert check(EqualTo(col("a"), col("b")), rb).to_pylist() == \
+        [False, False, False, True, False, False]
+
+
+def test_long_string_comparison():
+    import pyarrow as pa
+    base = "x" * 200  # crosses several compare windows
+    rb = pa.record_batch({"a": pa.array([base + "a", base, base]),
+                          "b": pa.array([base + "b", base, base + "q"])})
+    assert check(LessThan(col("a"), col("b")), rb).to_pylist() == \
+        [True, False, True]

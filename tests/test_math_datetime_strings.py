@@ -1,0 +1,239 @@
+"""Dual-run tests for math, datetime, and string expression families."""
+import pytest
+
+from spark_rapids_tpu import datatypes as dt
+from spark_rapids_tpu.expr import (
+    Sqrt, Cbrt, Exp, Log, Log10, Log2, Log1p, Sin, Cos, Tan, Atan, Tanh,
+    Signum, ToDegrees, ToRadians, Floor, Ceil, Rint, Pow, Atan2, Hypot,
+    Round, BRound, Year, Month, DayOfMonth, Quarter, DayOfWeek, WeekDay,
+    DayOfYear, LastDay, Hour, Minute, Second, DateAdd, DateSub, DateDiff,
+    AddMonths, MonthsBetween, TruncDate, UnixTimestamp, FromUnixTime,
+    Length, Upper, Lower, Substring, ConcatStrings, StartsWith, EndsWith,
+    Contains, Like, StringTrim, StringTrimLeft, StringTrimRight,
+    Cast, Literal, UnresolvedColumn as col)
+
+from asserts import assert_tpu_and_cpu_expr_equal as check
+from data_gen import (gen_table, IntegerGen, FloatGen, StringGen, DateGen,
+                      TimestampGen, DecimalGen, ShortGen)
+
+
+def dtable(n=256, seed=11):
+    return gen_table([FloatGen(dt.FLOAT64), FloatGen(dt.FLOAT64)],
+                     n=n, seed=seed, names=["a", "b"])
+
+
+@pytest.mark.parametrize("op", [Sqrt, Cbrt, Exp, Sin, Cos, Tan, Atan, Tanh,
+                                Signum, ToDegrees, ToRadians, Rint],
+                         ids=lambda o: o.__name__)
+def test_unary_math(op):
+    check(op(col("a")), dtable(), approx_float=True)
+
+
+@pytest.mark.parametrize("op", [Log, Log10, Log2, Log1p],
+                         ids=lambda o: o.__name__)
+def test_log_null_semantics(op):
+    check(op(col("a")), dtable(), approx_float=True)
+
+
+@pytest.mark.parametrize("op", [Pow, Atan2, Hypot], ids=lambda o: o.__name__)
+def test_binary_math(op):
+    check(op(col("a"), col("b")), dtable(), approx_float=True)
+
+
+def test_floor_ceil():
+    check(Floor(col("a")), dtable())
+    check(Ceil(col("a")), dtable())
+    rbd = gen_table([DecimalGen(12, 3)], names=["a"])
+    check(Floor(col("a")), rbd)
+    check(Ceil(col("a")), rbd)
+
+
+def test_round():
+    rb = gen_table([DecimalGen(12, 4)], names=["a"])
+    check(Round(col("a"), 2), rb)
+    check(BRound(col("a"), 2), rb)
+    check(Round(col("a"), 0), rb)
+    rbi = gen_table([IntegerGen()], names=["a"])
+    check(Round(col("a"), -2), rbi)
+
+
+# ---- datetime ------------------------------------------------------------
+
+def date_tab(n=256):
+    # n bounded so date +/- n days/months stays inside python date range
+    return gen_table([DateGen(), DateGen(),
+                      IntegerGen(null_frac=0.05, min_val=-10000,
+                                 max_val=10000)],
+                     n=n, seed=3, names=["a", "b", "n"])
+
+
+@pytest.mark.parametrize("op", [Year, Month, DayOfMonth, Quarter, DayOfWeek,
+                                WeekDay, DayOfYear, LastDay],
+                         ids=lambda o: o.__name__)
+def test_date_parts(op):
+    check(op(col("a")), date_tab())
+
+
+@pytest.mark.parametrize("op", [Hour, Minute, Second],
+                         ids=lambda o: o.__name__)
+def test_time_parts(op):
+    rb = gen_table([TimestampGen()], names=["a"])
+    check(op(col("a")), rb)
+
+
+def test_date_arith():
+    rb = date_tab()
+    check(DateAdd(col("a"), col("n")), rb)
+    check(DateSub(col("a"), col("n")), rb)
+    check(DateDiff(col("a"), col("b")), rb)
+    check(AddMonths(col("a"), col("n")), rb)
+    check(MonthsBetween(col("a"), col("b")), rb, approx_float=True)
+
+
+@pytest.mark.parametrize("fmt", ["YEAR", "MONTH", "QUARTER", "WEEK"])
+def test_trunc_date(fmt):
+    check(TruncDate(col("a"), fmt), date_tab())
+
+
+def test_unix_roundtrip():
+    rb = gen_table([TimestampGen()], names=["a"])
+    check(UnixTimestamp(col("a")), rb)
+    rb2 = gen_table([IntegerGen(min_val=0, max_val=2_000_000_000)],
+                    names=["a"])
+    check(FromUnixTime(Cast(col("a"), dt.INT64)), rb2)
+
+
+def test_epoch_oracle():
+    """Pin a few known dates against hand-computed field values."""
+    import pyarrow as pa
+    import datetime
+    dates = [datetime.date(1970, 1, 1), datetime.date(2000, 2, 29),
+             datetime.date(1999, 12, 31), datetime.date(2026, 7, 29),
+             datetime.date(1900, 3, 1)]
+    rb = pa.record_batch({"a": pa.array(dates, pa.date32())})
+    assert check(Year(col("a")), rb).to_pylist() == \
+        [1970, 2000, 1999, 2026, 1900]
+    assert check(Month(col("a")), rb).to_pylist() == [1, 2, 12, 7, 3]
+    assert check(DayOfMonth(col("a")), rb).to_pylist() == [1, 29, 31, 29, 1]
+    # 1970-01-01 was a Thursday -> Spark dayofweek=5
+    assert check(DayOfWeek(col("a")), rb).to_pylist()[0] == 5
+    assert check(LastDay(col("a")), rb).to_pylist() == [
+        datetime.date(1970, 1, 31), datetime.date(2000, 2, 29),
+        datetime.date(1999, 12, 31), datetime.date(2026, 7, 31),
+        datetime.date(1900, 3, 31)]
+
+
+# ---- strings -------------------------------------------------------------
+
+def stable(n=256, **kw):
+    return gen_table([StringGen(**kw), StringGen(**kw)], n=n, seed=5,
+                     names=["a", "b"])
+
+
+def test_length_utf8():
+    rb = stable()  # includes unicode specials
+    check(Length(col("a")), rb)
+
+
+def test_upper_lower_ascii():
+    rb = stable(ascii_only=True)
+    check(Upper(col("a")), rb)
+    check(Lower(col("a")), rb)
+
+
+def test_substring():
+    rb = stable(ascii_only=True)
+    check(Substring(col("a"), Literal(2, dt.INT32), Literal(3, dt.INT32)),
+          rb)
+    check(Substring(col("a"), Literal(-4, dt.INT32), Literal(2, dt.INT32)),
+          rb)
+    check(Substring(col("a"), Literal(1, dt.INT32), Literal(100, dt.INT32)),
+          rb)
+    check(Substring(col("a"), Literal(0, dt.INT32), Literal(2, dt.INT32)),
+          rb)
+
+
+def test_concat():
+    rb = stable()
+    check(ConcatStrings(col("a"), col("b")), rb)
+    check(ConcatStrings(col("a"), Literal("-", dt.STRING), col("b")), rb)
+
+
+def test_starts_ends_contains():
+    import pyarrow as pa
+    rb = pa.record_batch({"a": pa.array(
+        ["apple pie", "app", "pie", None, "", "a ap app"])})
+    assert check(StartsWith(col("a"), "ap"), rb).to_pylist() == \
+        [True, True, False, None, False, False]
+    assert check(EndsWith(col("a"), "ie"), rb).to_pylist() == \
+        [True, False, True, None, False, False]
+    assert check(Contains(col("a"), "pp"), rb).to_pylist() == \
+        [True, True, False, None, False, True]
+    check(Contains(col("a"), ""), rb)
+
+
+@pytest.mark.parametrize("pattern", ["abc", "ab%", "%bc", "%b%", "a%c", "%",
+                                     ""])
+def test_like_simple(pattern):
+    import pyarrow as pa
+    rb = pa.record_batch({"a": pa.array(
+        ["abc", "abxc", "ab", "bc", "", None, "aabcc"])})
+    e = Like(col("a"), pattern)
+    assert e.tpu_supported() is None
+    check(e, rb)
+
+
+def test_like_complex_host_only():
+    e = Like(col("a"), "a_c")
+    assert e.tpu_supported() is not None
+    import pyarrow as pa
+    from spark_rapids_tpu.expr.base import bind_expr, EvalCtx
+    from spark_rapids_tpu.columnar.arrow_bridge import engine_schema
+    rb = pa.record_batch({"a": pa.array(["abc", "ac", "abbc", None])})
+    bound = bind_expr(e, engine_schema(rb.schema))
+    assert bound.eval_cpu(rb, EvalCtx()).to_pylist() == \
+        [True, False, False, None]
+
+
+def test_trim():
+    import pyarrow as pa
+    rb = pa.record_batch({"a": pa.array(
+        ["  hi  ", "hi", "   ", "", None, " a b "])})
+    assert check(StringTrim(col("a")), rb).to_pylist() == \
+        ["hi", "hi", "", "", None, "a b"]
+    check(StringTrimLeft(col("a")), rb)
+    check(StringTrimRight(col("a")), rb)
+
+
+def test_host_string_ops():
+    """Host-fallback expressions still honest against Spark semantics."""
+    import pyarrow as pa
+    from spark_rapids_tpu.expr import (StringReplace, RegExpLike,
+                                       RegExpReplace, RegExpExtract,
+                                       StringLocate, StringLpad, StringRpad,
+                                       StringRepeat, Reverse)
+    from spark_rapids_tpu.expr.base import bind_expr, EvalCtx
+    from spark_rapids_tpu.columnar.arrow_bridge import engine_schema
+    rb = pa.record_batch({"a": pa.array(["hello world", "abcabc", None,
+                                         ""])})
+    sch = engine_schema(rb.schema)
+    ctx = EvalCtx()
+
+    def run(e):
+        return bind_expr(e, sch).eval_cpu(rb, ctx).to_pylist()
+
+    assert run(StringReplace(col("a"), "abc", "x")) == \
+        ["hello world", "xx", None, ""]
+    assert run(RegExpLike(col("a"), "^h.*d$")) == [True, False, None, False]
+    assert run(RegExpReplace(col("a"), "[aeiou]", "_")) == \
+        ["h_ll_ w_rld", "_bc_bc", None, ""]
+    assert run(RegExpExtract(col("a"), "(\\w+) (\\w+)", 2)) == \
+        ["world", "", None, ""]
+    assert run(StringLocate("bc", col("a"))) == [0, 2, None, 0]
+    assert run(StringLpad(col("a"), 5, "*")) == \
+        ["hello", "abcab", None, "*****"]
+    assert run(StringRpad(col("a"), 13, "!")) == \
+        ["hello world!!", "abcabc!!!!!!!", None, "!!!!!!!!!!!!!"]
+    assert run(StringRepeat(col("a"), 2)) == \
+        ["hello worldhello world", "abcabcabcabc", None, ""]
+    assert run(Reverse(col("a"))) == ["dlrow olleh", "cbacba", None, ""]
