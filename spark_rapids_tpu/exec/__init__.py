@@ -1,0 +1,3 @@
+from .base import (ExecCtx, TpuExec, TpuMetric, HostBatchSourceExec,
+                   collect_arrow, collect_arrow_cpu)
+from .basic import TpuProjectExec, TpuFilterExec, TpuRangeExec

@@ -1,0 +1,200 @@
+"""Physical operator layer.
+
+TPU analog of the reference's `GpuExec` SparkPlan hierarchy (SURVEY.md
+§2.2-B; reference mount empty — built from the capability inventory). Every
+operator implements BOTH:
+
+- ``execute(ctx)``     — iterator of device `TpuBatch`es. Per-batch device
+  work is traced/jitted once per capacity bucket (the engine's analog of
+  whole-stage codegen: a pipeline of exec nodes composes into one XLA
+  program per bucket).
+- ``execute_cpu(ctx)`` — iterator of pyarrow RecordBatches with Spark
+  semantics; the CPU fallback path AND the oracle for the dual-run harness
+  (SURVEY.md §4.1/4.4).
+
+Operators carry `TpuMetric`s (opTime, numOutputRows, …) mirroring the
+reference's GpuMetric surface (SURVEY.md §5.1).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+
+from .. import datatypes as dt
+from ..columnar.arrow_bridge import arrow_to_device, device_to_arrow
+from ..columnar.batch import TpuBatch
+from ..config import RapidsConf
+from ..expr.base import EvalCtx
+
+__all__ = ["ExecCtx", "TpuMetric", "TpuExec", "LeafExec", "UnaryExec",
+           "HostBatchSourceExec", "collect_arrow", "collect_arrow_cpu"]
+
+
+class TpuMetric:
+    """Accumulator metric, analog of GpuMetric over SQLMetric."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def __iadd__(self, v):
+        self.value += v
+        return self
+
+    def set(self, v):
+        self.value = v
+
+    def __repr__(self):
+        return f"{self.name}={self.value}"
+
+
+class ExecCtx:
+    """Per-query execution context: conf snapshot + eval ctx + metric sink."""
+
+    def __init__(self, conf: Optional[RapidsConf] = None):
+        self.conf = conf or RapidsConf()
+        self.eval_ctx = EvalCtx(
+            ansi=self.conf.ansi,
+            timezone=self.conf.get("spark.sql.session.timeZone"))
+        self.metrics: Dict[str, Dict[str, TpuMetric]] = {}
+        # DEBUG metrics block on device completion inside timed regions so
+        # opTime is device time; otherwise timings are async-dispatch cost
+        # (cheap, pipelining preserved).
+        self.sync_metrics = \
+            self.conf.get("spark.rapids.sql.metrics.level") == "DEBUG"
+
+    def metric(self, node: "TpuExec", name: str) -> TpuMetric:
+        m = self.metrics.setdefault(node.node_label(), {})
+        if name not in m:
+            m[name] = TpuMetric(name)
+        return m[name]
+
+
+class TpuExec:
+    """Base physical operator."""
+
+    children: Tuple["TpuExec", ...] = ()
+
+    _label_counter = 0
+
+    def __init__(self):
+        TpuExec._label_counter += 1
+        self._label_id = TpuExec._label_counter
+
+    # --- static metadata --------------------------------------------------
+    @property
+    def output_schema(self) -> dt.Schema:
+        raise NotImplementedError(type(self).__name__)
+
+    def pretty_name(self) -> str:
+        n = type(self).__name__
+        return n[3:] if n.startswith("Tpu") else n
+
+    def node_label(self) -> str:
+        return f"{self.pretty_name()}#{self._label_id}"
+
+    # --- planner hooks ----------------------------------------------------
+    def tpu_supported(self) -> Optional[str]:
+        """None if runnable on TPU, else the willNotWorkOnTpu reason."""
+        return None
+
+    # --- execution --------------------------------------------------------
+    def execute(self, ctx: ExecCtx) -> Iterator[TpuBatch]:
+        raise NotImplementedError(type(self).__name__)
+
+    def execute_cpu(self, ctx: ExecCtx) -> Iterator[pa.RecordBatch]:
+        raise NotImplementedError(type(self).__name__)
+
+    # --- tree utilities ---------------------------------------------------
+    def tree_string(self, indent: int = 0) -> str:
+        lines = [("  " * indent) + self.describe()]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def describe(self) -> str:
+        return self.pretty_name()
+
+    def __repr__(self):
+        return self.tree_string()
+
+
+class LeafExec(TpuExec):
+    children = ()
+
+
+class UnaryExec(TpuExec):
+    def __init__(self, child: TpuExec):
+        super().__init__()
+        self.children = (child,)
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def output_schema(self) -> dt.Schema:
+        return self.child.output_schema
+
+
+class HostBatchSourceExec(LeafExec):
+    """Leaf over in-memory host Arrow batches — the LocalTableScan analog
+    and the entry point the JVM-side bridge feeds (Arrow C Data batches)."""
+
+    def __init__(self, batches: Sequence[pa.RecordBatch],
+                 schema: Optional[dt.Schema] = None):
+        super().__init__()
+        self.batches = list(batches)
+        if schema is None:
+            from ..columnar.arrow_bridge import engine_schema
+            if not self.batches:
+                raise ValueError("empty source needs an explicit schema")
+            schema = engine_schema(self.batches[0].schema)
+        self._schema = schema
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    def execute(self, ctx):
+        rows = ctx.metric(self, "numOutputRows")
+        t = ctx.metric(self, "uploadTime")
+        for rb in self.batches:
+            t0 = time.perf_counter()
+            b = arrow_to_device(rb, self._schema)
+            t.value += time.perf_counter() - t0
+            rows += rb.num_rows
+            yield b
+
+    def execute_cpu(self, ctx):
+        from ..columnar.arrow_bridge import arrow_schema
+        target = arrow_schema(self._schema)
+        for rb in self.batches:
+            if rb.schema != target:
+                rb = pa.RecordBatch.from_arrays(
+                    [rb.column(i).cast(target.field(i).type)
+                     for i in range(rb.num_columns)], schema=target)
+            yield rb
+
+
+def collect_arrow(plan: TpuExec, ctx: Optional[ExecCtx] = None) -> pa.Table:
+    """Run the TPU path and download results as one Arrow table."""
+    ctx = ctx or ExecCtx()
+    batches = [device_to_arrow(b) for b in plan.execute(ctx)]
+    from ..columnar.arrow_bridge import arrow_schema
+    return pa.Table.from_batches(batches, schema=arrow_schema(
+        plan.output_schema))
+
+
+def collect_arrow_cpu(plan: TpuExec, ctx: Optional[ExecCtx] = None) \
+        -> pa.Table:
+    """Run the CPU oracle path."""
+    ctx = ctx or ExecCtx()
+    batches = list(plan.execute_cpu(ctx))
+    from ..columnar.arrow_bridge import arrow_schema
+    return pa.Table.from_batches(batches, schema=arrow_schema(
+        plan.output_schema))

@@ -1,0 +1,179 @@
+"""Basic physical operators: project, filter, range.
+
+TPU analog of the reference's `basicPhysicalOperators.scala`
+(`GpuProjectExec`, `GpuFilterExec`, `GpuRangeExec` — SURVEY.md §2.2-B;
+reference mount empty). Filter is prefix-sum + gather compaction into the
+same static capacity (SURVEY.md §7.1.3, §7.3.1).
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import datatypes as dt
+from ..columnar.batch import TpuBatch, bucket_rows
+from ..columnar.column import TpuColumnVector
+from ..expr.base import Alias, Expression, bind_expr
+from ..ops.gather import compact_batch
+from .base import ExecCtx, LeafExec, TpuExec, UnaryExec
+
+__all__ = ["TpuProjectExec", "TpuFilterExec", "TpuRangeExec",
+           "output_schema_for", "bind_all"]
+
+
+def output_schema_for(exprs: Sequence[Expression]) -> dt.Schema:
+    fields = []
+    for i, e in enumerate(exprs):
+        name = e.name if hasattr(e, "name") else f"col{i}"
+        fields.append(dt.StructField(name, e.dtype, e.nullable))
+    return dt.Schema(fields)
+
+
+def bind_all(exprs: Sequence[Expression], schema: dt.Schema) \
+        -> List[Expression]:
+    return [bind_expr(e, schema) for e in exprs]
+
+
+class TpuProjectExec(UnaryExec):
+    """Expression evaluation over each batch (GpuProjectExec analog)."""
+
+    def __init__(self, exprs: Sequence[Expression], child: TpuExec):
+        super().__init__(child)
+        self.exprs = bind_all(exprs, child.output_schema)
+        self._schema = output_schema_for(self.exprs)
+        self._jitted = None
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"ProjectExec [{', '.join(map(repr, self.exprs))}]"
+
+    def _run(self, batch: TpuBatch, ectx) -> TpuBatch:
+        cols = [e.eval_tpu(batch, ectx) for e in self.exprs]
+        return TpuBatch(cols, self._schema, batch.row_count)
+
+    def execute(self, ctx: ExecCtx):
+        if self._jitted is None:
+            self._jitted = jax.jit(self._run, static_argnums=1)
+        op_time = ctx.metric(self, "opTime")
+        rows = ctx.metric(self, "numOutputRows")
+        for batch in self.child.execute(ctx):
+            t0 = time.perf_counter()
+            out = self._jitted(batch, ctx.eval_ctx)
+            if ctx.sync_metrics:
+                out.block_until_ready()
+            op_time.value += time.perf_counter() - t0
+            # project preserves row count; use the input's if already known
+            if batch._num_rows_cache is not None:
+                rows += batch._num_rows_cache
+            yield out
+
+    def execute_cpu(self, ctx: ExecCtx):
+        from ..columnar.arrow_bridge import arrow_schema
+        aschema = arrow_schema(self._schema)
+        for rb in self.child.execute_cpu(ctx):
+            arrays = [e.eval_cpu(rb, ctx.eval_ctx) for e in self.exprs]
+            arrays = [a.combine_chunks() if isinstance(a, pa.ChunkedArray)
+                      else a for a in arrays]
+            yield pa.RecordBatch.from_arrays(arrays, schema=aschema)
+
+
+class TpuFilterExec(UnaryExec):
+    """Boolean-mask filter + stream compaction (GpuFilterExec analog)."""
+
+    def __init__(self, condition: Expression, child: TpuExec):
+        super().__init__(child)
+        self.condition = bind_expr(condition, child.output_schema)
+        if not isinstance(self.condition.dtype, dt.BooleanType):
+            raise TypeError(
+                f"filter condition must be boolean, got "
+                f"{self.condition.dtype.simple_string()}")
+        self._jitted = None
+
+    def describe(self):
+        return f"FilterExec [{self.condition!r}]"
+
+    def _run(self, batch: TpuBatch, ectx) -> TpuBatch:
+        pred = self.condition.eval_tpu(batch, ectx)
+        # SQL filter keeps only rows where the predicate is TRUE (not null).
+        keep = pred.data & pred.validity
+        return compact_batch(batch, keep)
+
+    def execute(self, ctx: ExecCtx):
+        if self._jitted is None:
+            self._jitted = jax.jit(self._run, static_argnums=1)
+        op_time = ctx.metric(self, "opTime")
+        for batch in self.child.execute(ctx):
+            t0 = time.perf_counter()
+            out = self._jitted(batch, ctx.eval_ctx)
+            if ctx.sync_metrics:
+                out.block_until_ready()
+            op_time.value += time.perf_counter() - t0
+            yield out
+
+    def execute_cpu(self, ctx: ExecCtx):
+        for rb in self.child.execute_cpu(ctx):
+            mask = self.condition.eval_cpu(rb, ctx.eval_ctx)
+            mask = pc.fill_null(mask, False)
+            yield rb.filter(mask)
+
+
+class TpuRangeExec(LeafExec):
+    """spark.range() source (GpuRangeExec analog): int64 sequence generated
+    directly on device, split into bucketed batches."""
+
+    def __init__(self, start: int, end: int, step: int = 1,
+                 max_rows_per_batch: int = 1 << 20, name: str = "id"):
+        super().__init__()
+        if step == 0:
+            raise ValueError("step must not be 0")
+        self.start, self.end, self.step = start, end, step
+        self.max_rows_per_batch = max_rows_per_batch
+        self._schema = dt.Schema([dt.StructField(name, dt.INT64, False)])
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    @property
+    def num_rows(self) -> int:
+        n = (self.end - self.start + self.step
+             - (1 if self.step > 0 else -1)) // self.step
+        return max(0, n)
+
+    def describe(self):
+        return f"RangeExec [{self.start}, {self.end}, step={self.step}]"
+
+    def _chunks(self):
+        total = self.num_rows
+        off = 0
+        while off < total:
+            n = min(self.max_rows_per_batch, total - off)
+            yield off, n
+            off += n
+
+    def execute(self, ctx: ExecCtx):
+        for off, n in self._chunks():
+            cap = bucket_rows(n)
+            first = self.start + off * self.step
+            data = first + jnp.arange(cap, dtype=jnp.int64) * self.step
+            col = TpuColumnVector(dt.INT64, data=data,
+                                  validity=jnp.ones((cap,), jnp.bool_))
+            yield TpuBatch([col], self._schema, n)
+
+    def execute_cpu(self, ctx: ExecCtx):
+        from ..columnar.arrow_bridge import arrow_schema
+        aschema = arrow_schema(self._schema)
+        for off, n in self._chunks():
+            first = self.start + off * self.step
+            vals = first + np.arange(n, dtype=np.int64) * self.step
+            yield pa.RecordBatch.from_arrays([pa.array(vals, pa.int64())],
+                                             schema=aschema)

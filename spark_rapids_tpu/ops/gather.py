@@ -1,0 +1,68 @@
+"""Row gather / stream-compaction kernels.
+
+TPU replacement for libcudf's stream compaction (apply_boolean_mask,
+gather/scatter — SURVEY.md §2.2-E; reference mount empty). Filter output
+size is data-dependent, which XLA can't express as a shape — so compaction
+is prefix-sum + scatter into the SAME static capacity, with the live count
+threaded alongside (SURVEY.md §7.3.1).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.batch import TpuBatch, row_mask
+from ..columnar.column import TpuColumnVector
+from .strings import gather_strings
+
+__all__ = ["compaction_indices", "gather_column", "gather_batch",
+           "compact_batch"]
+
+
+def compaction_indices(keep: jax.Array):
+    """(indices, count): indices[j] = source row of the j-th kept row, for
+    j < count; rows >= count point at row 0 (padding garbage).
+
+    keep must already exclude padding rows (AND with the batch live mask).
+    """
+    cap = keep.shape[0]
+    positions = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    count = positions[-1] + 1 if cap else jnp.int32(0)
+    dst = jnp.where(keep, positions, cap)
+    indices = jnp.zeros((cap,), jnp.int32).at[dst].set(
+        jnp.arange(cap, dtype=jnp.int32), mode="drop")
+    return indices, count
+
+
+def gather_column(col: TpuColumnVector, indices: jax.Array,
+                  out_live: jax.Array,
+                  char_capacity: int = None) -> TpuColumnVector:
+    """Reorder a column by row indices; out_live masks validity of padding
+    rows in the output so downstream null-aware kernels see them as null."""
+    validity = col.validity[indices] & out_live
+    if col.is_string_like:
+        cap = char_capacity if char_capacity is not None \
+            else col.chars.shape[0]
+        out = gather_strings(col, indices, cap)
+        return out.with_arrays(validity=validity)
+    if col.data is None:  # NullType
+        return col.with_arrays(validity=validity)
+    return col.with_arrays(data=col.data[indices], validity=validity)
+
+
+def gather_batch(batch: TpuBatch, indices: jax.Array, count,
+                 char_capacities=None) -> TpuBatch:
+    """Reorder/compact a whole batch by row indices (count = live rows)."""
+    out_live = row_mask(indices.shape[0], count)
+    cols = []
+    for i, c in enumerate(batch.columns):
+        cc = None if char_capacities is None else char_capacities[i]
+        cols.append(gather_column(c, indices, out_live, cc))
+    return TpuBatch(cols, batch.schema, count)
+
+
+def compact_batch(batch: TpuBatch, keep: jax.Array) -> TpuBatch:
+    """Stream compaction: keep rows where `keep` (padding excluded here)."""
+    keep = keep & batch.live_mask()
+    indices, count = compaction_indices(keep)
+    return gather_batch(batch, indices, count)

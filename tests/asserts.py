@@ -72,3 +72,50 @@ def assert_tpu_and_cpu_expr_equal(expr, rb: pa.RecordBatch, ansi=False,
     assert_columns_equal(cpu, tpu, bound.dtype, approx_float,
                          label or repr(expr))
     return cpu
+
+
+def _sorted_rows(table: pa.Table, types, approx_float):
+    cols = [_normalize(c.to_pylist(), t, approx_float)
+            for c, t in zip(table.columns, types)]
+    rows = list(zip(*cols)) if cols else []
+    return sorted(rows, key=lambda r: tuple(
+        (v is None, str(type(v)), str(v)) for v in r))
+
+
+def assert_tpu_and_cpu_plan_equal(plan, conf=None, approx_float=False,
+                                  ignore_order=False, label=""):
+    """Run a physical plan on the TPU path and the CPU oracle path, compare
+    full results (the plan-level dual-run harness — SURVEY.md §4.1)."""
+    from spark_rapids_tpu.exec.base import (ExecCtx, collect_arrow,
+                                            collect_arrow_cpu)
+    label = label or plan.describe()
+    types = plan.output_schema.types
+    tpu = collect_arrow(plan, ExecCtx(conf))
+    cpu = collect_arrow_cpu(plan, ExecCtx(conf))
+    assert cpu.num_rows == tpu.num_rows, (
+        f"{label}: row count cpu={cpu.num_rows} tpu={tpu.num_rows}")
+    if ignore_order:
+        crows = _sorted_rows(cpu, types, approx_float)
+        trows = _sorted_rows(tpu, types, approx_float)
+        if approx_float:
+            assert len(crows) == len(trows)
+            for i, (cr, tr) in enumerate(zip(crows, trows)):
+                for a, b in zip(cr, tr):
+                    if a == b:
+                        continue
+                    if isinstance(a, float) and isinstance(b, float) \
+                            and abs(a - b) <= 1e-6 * max(1.0, abs(a)):
+                        continue
+                    raise AssertionError(
+                        f"{label} sorted row {i}: cpu={cr!r} tpu={tr!r}")
+        else:
+            assert crows == trows, (
+                f"{label}: mismatch (ignore_order)\n cpu={crows[:10]}\n "
+                f"tpu={trows[:10]}")
+    else:
+        for i, t in enumerate(types):
+            assert_columns_equal(cpu.column(i).combine_chunks(),
+                                 tpu.column(i).combine_chunks(), t,
+                                 approx_float,
+                                 f"{label} col {plan.output_schema.names[i]}")
+    return cpu
