@@ -4,10 +4,10 @@ TPU analog of the reference's `GpuExec` SparkPlan hierarchy (SURVEY.md
 §2.2-B; reference mount empty — built from the capability inventory). Every
 operator implements BOTH:
 
-- ``execute(ctx)``     — iterator of device `TpuBatch`es. Per-batch device
-  work is traced/jitted once per capacity bucket (the engine's analog of
-  whole-stage codegen: a pipeline of exec nodes composes into one XLA
-  program per bucket).
+- ``execute(ctx)``     — iterator of device `TpuBatch`es. Each operator
+  traces/jits its per-batch function once per capacity bucket; operators
+  exchange materialized device batches (cross-operator XLA fusion — the
+  whole-stage-codegen analog — is future work at the planner layer).
 - ``execute_cpu(ctx)`` — iterator of pyarrow RecordBatches with Spark
   semantics; the CPU fallback path AND the oracle for the dual-run harness
   (SURVEY.md §4.1/4.4).
@@ -160,17 +160,9 @@ class HostBatchSourceExec(LeafExec):
     def output_schema(self):
         return self._schema
 
-    def execute(self, ctx):
-        rows = ctx.metric(self, "numOutputRows")
-        t = ctx.metric(self, "uploadTime")
-        for rb in self.batches:
-            t0 = time.perf_counter()
-            b = arrow_to_device(rb, self._schema)
-            t.value += time.perf_counter() - t0
-            rows += rb.num_rows
-            yield b
-
-    def execute_cpu(self, ctx):
+    def _normalized(self):
+        """Input batches cast (checked) to the declared schema, so the
+        device and CPU paths see identical values."""
         from ..columnar.arrow_bridge import arrow_schema
         target = arrow_schema(self._schema)
         for rb in self.batches:
@@ -179,6 +171,19 @@ class HostBatchSourceExec(LeafExec):
                     [rb.column(i).cast(target.field(i).type)
                      for i in range(rb.num_columns)], schema=target)
             yield rb
+
+    def execute(self, ctx):
+        rows = ctx.metric(self, "numOutputRows")
+        t = ctx.metric(self, "uploadTime")
+        for rb in self._normalized():
+            t0 = time.perf_counter()
+            b = arrow_to_device(rb, self._schema)
+            t.value += time.perf_counter() - t0
+            rows += rb.num_rows
+            yield b
+
+    def execute_cpu(self, ctx):
+        yield from self._normalized()
 
 
 def collect_arrow(plan: TpuExec, ctx: Optional[ExecCtx] = None) -> pa.Table:

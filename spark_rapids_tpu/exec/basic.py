@@ -70,10 +70,8 @@ class TpuProjectExec(UnaryExec):
             out = self._jitted(batch, ctx.eval_ctx)
             if ctx.sync_metrics:
                 out.block_until_ready()
+                rows += out.num_rows  # syncs; only in DEBUG metrics mode
             op_time.value += time.perf_counter() - t0
-            # project preserves row count; use the input's if already known
-            if batch._num_rows_cache is not None:
-                rows += batch._num_rows_cache
             yield out
 
     def execute_cpu(self, ctx: ExecCtx):
@@ -165,8 +163,9 @@ class TpuRangeExec(LeafExec):
             cap = bucket_rows(n)
             first = self.start + off * self.step
             data = first + jnp.arange(cap, dtype=jnp.int64) * self.step
+            from ..columnar.batch import row_mask
             col = TpuColumnVector(dt.INT64, data=data,
-                                  validity=jnp.ones((cap,), jnp.bool_))
+                                  validity=row_mask(cap, n))
             yield TpuBatch([col], self._schema, n)
 
     def execute_cpu(self, ctx: ExecCtx):

@@ -1,0 +1,218 @@
+"""Sort and limit operators.
+
+TPU analog of the reference's `GpuSortExec` / `limit.scala`
+(`GpuTopN`, `GpuGlobalLimitExec`, `GpuLocalLimitExec`,
+`GpuTakeOrderedAndProjectExec` — SURVEY.md §2.2-B; reference mount empty).
+Sort = key normalization + one `lax.sort` permutation + batch gather
+(SURVEY.md §7.1.3); global sort concatenates the child's batches on device
+first (out-of-core merge comes with the spill framework).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+import pyarrow as pa
+
+from .. import datatypes as dt
+from ..columnar.batch import TpuBatch
+from ..expr.base import Expression, bind_expr
+from ..ops.concat import concat_batches
+from ..ops.gather import gather_batch
+from ..ops.sort_keys import SortSpec, sort_permutation
+from .base import ExecCtx, TpuExec, UnaryExec
+from .basic import bind_all
+
+__all__ = ["SortOrder", "TpuSortExec", "TpuLocalLimitExec",
+           "TpuGlobalLimitExec", "TpuTopNExec", "sort_batch_by",
+           "cpu_sort_table"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SortOrder:
+    """Sort key: expression + direction + null placement (GpuSortOrder).
+    Frozen/hashable so order tuples can be jit static arguments."""
+    child: Expression
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # Spark default: asc <=> nulls first
+
+    def __post_init__(self):
+        if self.nulls_first is None:
+            object.__setattr__(self, "nulls_first", self.ascending)
+
+    @property
+    def spec(self) -> SortSpec:
+        return SortSpec(self.ascending, self.nulls_first)
+
+
+def sort_batch_by(batch: TpuBatch, orders: Sequence[SortOrder],
+                  ectx) -> TpuBatch:
+    """Traced: sort one batch by the given (bound) orders."""
+    key_cols = [o.child.eval_tpu(batch, ectx) for o in orders]
+    perm = sort_permutation(key_cols, [o.spec for o in orders],
+                            batch.live_mask())
+    return gather_batch(batch, perm, batch.row_count)
+
+
+# --- CPU oracle sort (Spark semantics over host rows) ---------------------
+
+def _cpu_pass_key(t: dt.DataType):
+    """Per-value comparable for one sort pass; None handled separately."""
+    if dt.is_floating(t):
+        return lambda v: (1, 0.0) if (isinstance(v, float)
+                                      and math.isnan(v)) else (0, v + 0.0)
+    return lambda v: v
+
+
+def cpu_sort_table(table: pa.Table, key_arrays: List[pa.Array],
+                   orders: Sequence[SortOrder]) -> pa.Table:
+    """Stable multi-pass sort of host rows with Spark null/NaN semantics."""
+    n = table.num_rows
+    idx = list(range(n))
+    for o, arr in reversed(list(zip(orders, key_arrays))):
+        vals = arr.to_pylist()
+        keyf = _cpu_pass_key(o.child.dtype)
+        # Direction applies to values only; nulls keep their placement:
+        # split the (stable) order into null/non-null blocks per pass.
+        nulls = [i for i in idx if vals[i] is None]
+        nonnull = [i for i in idx if vals[i] is not None]
+        nonnull.sort(key=lambda i: keyf(vals[i]), reverse=not o.ascending)
+        idx = nulls + nonnull if o.nulls_first else nonnull + nulls
+    return table.take(pa.array(idx, pa.int64()))
+
+
+class TpuSortExec(UnaryExec):
+    """Total or per-batch sort (GpuSortExec analog)."""
+
+    def __init__(self, orders: Sequence[SortOrder], child: TpuExec,
+                 global_sort: bool = True):
+        super().__init__(child)
+        self.orders = [dataclasses.replace(
+            o, child=bind_expr(o.child, child.output_schema))
+            for o in orders]
+        self.global_sort = global_sort
+        self._jitted = None
+
+    def describe(self):
+        keys = ", ".join(
+            f"{o.child!r} {'ASC' if o.ascending else 'DESC'} NULLS "
+            f"{'FIRST' if o.nulls_first else 'LAST'}" for o in self.orders)
+        return f"SortExec [{keys}] global={self.global_sort}"
+
+    def execute(self, ctx: ExecCtx):
+        if self._jitted is None:
+            self._jitted = jax.jit(sort_batch_by, static_argnums=(1, 2))
+        op_time = ctx.metric(self, "opTime")
+        orders = tuple(self.orders)
+        if self.global_sort:
+            batches = list(self.child.execute(ctx))
+            if not batches:
+                return
+            t0 = time.perf_counter()
+            merged = concat_batches(batches)
+            out = self._jitted(merged, orders, ctx.eval_ctx)
+            if ctx.sync_metrics:
+                out.block_until_ready()
+            op_time.value += time.perf_counter() - t0
+            yield out
+        else:
+            for batch in self.child.execute(ctx):
+                t0 = time.perf_counter()
+                out = self._jitted(batch, orders, ctx.eval_ctx)
+                op_time.value += time.perf_counter() - t0
+                yield out
+
+    def execute_cpu(self, ctx: ExecCtx):
+        rbs = list(self.child.execute_cpu(ctx))
+        if not rbs:
+            return
+        if self.global_sort:
+            tables = [pa.Table.from_batches([rb]) for rb in rbs]
+            table = pa.concat_tables(tables).combine_chunks()
+            rbs = [table.to_batches()[0]] if table.num_rows else []
+        for rb in rbs:
+            keys = [o.child.eval_cpu(rb, ctx.eval_ctx) for o in self.orders]
+            t = cpu_sort_table(pa.Table.from_batches([rb]), keys,
+                               self.orders)
+            for out in t.to_batches():
+                yield out
+
+
+class TpuLocalLimitExec(UnaryExec):
+    """Per-stream limit (GpuLocalLimitExec analog): truncates row_count;
+    contents past the limit become padding."""
+
+    def __init__(self, limit: int, child: TpuExec):
+        super().__init__(child)
+        self.limit = limit
+
+    def describe(self):
+        return f"LocalLimitExec [{self.limit}]"
+
+    def execute(self, ctx: ExecCtx):
+        remaining = self.limit
+        for batch in self.child.execute(ctx):
+            if remaining <= 0:
+                return
+            n = batch.num_rows
+            if n <= remaining:
+                remaining -= n
+                yield batch
+            else:
+                yield batch.with_columns(batch.columns,
+                                         row_count=remaining)
+                return
+
+    def execute_cpu(self, ctx: ExecCtx):
+        remaining = self.limit
+        for rb in self.child.execute_cpu(ctx):
+            if remaining <= 0:
+                return
+            if rb.num_rows <= remaining:
+                remaining -= rb.num_rows
+                yield rb
+            else:
+                yield rb.slice(0, remaining)
+                return
+
+
+class TpuGlobalLimitExec(TpuLocalLimitExec):
+    """Single-partition global limit — same truncation semantics."""
+
+    def describe(self):
+        return f"GlobalLimitExec [{self.limit}]"
+
+
+class TpuTopNExec(UnaryExec):
+    """Take-ordered(-and-project): global sort + limit, optionally a
+    projection on the way out (GpuTopN / GpuTakeOrderedAndProjectExec)."""
+
+    def __init__(self, limit: int, orders: Sequence[SortOrder],
+                 child: TpuExec,
+                 project: Optional[Sequence[Expression]] = None):
+        super().__init__(child)
+        self.limit = limit
+        self._sort = TpuSortExec(orders, child, global_sort=True)
+        self._limit = TpuGlobalLimitExec(limit, self._sort)
+        if project is not None:
+            from .basic import TpuProjectExec
+            self._out: TpuExec = TpuProjectExec(project, self._limit)
+        else:
+            self._out = self._limit
+
+    @property
+    def output_schema(self):
+        return self._out.output_schema
+
+    def describe(self):
+        return f"TopNExec [{self.limit}] {self._sort.describe()}"
+
+    def execute(self, ctx: ExecCtx):
+        return self._out.execute(ctx)
+
+    def execute_cpu(self, ctx: ExecCtx):
+        return self._out.execute_cpu(ctx)

@@ -1,0 +1,125 @@
+"""Device batch concatenation.
+
+TPU replacement for cudf's table concat (used by GpuCoalesceBatches, sort,
+aggregate merge — SURVEY.md §2.2-A; reference mount empty). Batches carry
+padding after row_count, so concatenation is a masked scatter of each
+input's live rows (and live chars) at running offsets. Capacities are
+static per input; output capacity is chosen by the host caller.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.batch import TpuBatch, bucket_bytes, bucket_rows, row_mask
+from ..columnar.column import TpuColumnVector
+
+__all__ = ["concat_batches", "concat_device"]
+
+
+def _scatter_fixed(out, src, dst_idx, keep, out_cap):
+    dst = jnp.where(keep, dst_idx, out_cap)
+    return out.at[dst].set(src, mode="drop")
+
+
+def concat_device(batches: Sequence[TpuBatch], out_capacity: int,
+                  out_char_caps: Sequence[int]) -> TpuBatch:
+    """Traced concat: scatter live rows of each batch at running offsets.
+    out_char_caps has one entry per column (unused for fixed-width)."""
+    schema = batches[0].schema
+    ncols = len(schema)
+    total = jnp.int32(0)
+    row_offs = []
+    for b in batches:
+        row_offs.append(total)
+        total = total + b.row_count.astype(jnp.int32)
+
+    cols = []
+    for ci in range(ncols):
+        dtype = batches[0].columns[ci].dtype
+        first = batches[0].columns[ci]
+        validity = jnp.zeros((out_capacity,), jnp.bool_)
+        if first.is_string_like:
+            ccap = out_char_caps[ci]
+            offsets = jnp.zeros((out_capacity + 1,), jnp.int32)
+            chars = jnp.zeros((ccap,), jnp.uint8)
+            char_off = jnp.int32(0)
+            for b, roff in zip(batches, row_offs):
+                c = b.columns[ci]
+                cap = c.capacity
+                rc = b.row_count.astype(jnp.int32)
+                live = row_mask(cap, rc)
+                pos = jnp.arange(cap, dtype=jnp.int32)
+                validity = _scatter_fixed(validity, c.validity, roff + pos,
+                                          live, out_capacity)
+                # offsets: positions 0..rc inclusive, rebased by char_off
+                opos = jnp.arange(cap + 1, dtype=jnp.int32)
+                okeep = opos <= rc
+                offsets = _scatter_fixed(offsets, c.offsets + char_off,
+                                         roff + opos, okeep,
+                                         out_capacity + 1)
+                # chars: live bytes are [0, offsets[rc])
+                nchars = c.offsets[rc]
+                cpos = jnp.arange(c.chars.shape[0], dtype=jnp.int32)
+                chars = _scatter_fixed(chars, c.chars, char_off + cpos,
+                                       cpos < nchars, ccap)
+                char_off = char_off + nchars
+            # keep offsets monotone through trailing padding
+            opos = jnp.arange(out_capacity + 1, dtype=jnp.int32)
+            offsets = jnp.where(opos > total, char_off, offsets)
+            cols.append(TpuColumnVector(dtype, validity=validity,
+                                        offsets=offsets, chars=chars))
+        elif first.data is None:  # NullType
+            for b, roff in zip(batches, row_offs):
+                c = b.columns[ci]
+                cap = c.capacity
+                live = row_mask(cap, b.row_count)
+                pos = jnp.arange(cap, dtype=jnp.int32)
+                validity = _scatter_fixed(validity, c.validity, roff + pos,
+                                          live, out_capacity)
+            cols.append(TpuColumnVector(dtype, validity=validity))
+        else:
+            data = jnp.zeros((out_capacity,), first.data.dtype)
+            for b, roff in zip(batches, row_offs):
+                c = b.columns[ci]
+                cap = c.capacity
+                live = row_mask(cap, b.row_count)
+                pos = jnp.arange(cap, dtype=jnp.int32)
+                data = _scatter_fixed(data, c.data, roff + pos, live,
+                                      out_capacity)
+                validity = _scatter_fixed(validity, c.validity, roff + pos,
+                                          live, out_capacity)
+            cols.append(TpuColumnVector(dtype, data=data, validity=validity))
+    return TpuBatch(cols, schema, total)
+
+
+_concat_jit_cache = {}
+
+
+def concat_batches(batches: List[TpuBatch]) -> TpuBatch:
+    """Host wrapper: sync row counts, size the output, run the jitted
+    concat. One compiled program per (input capacities, output capacity)
+    combination — bounded by the power-of-two bucketing."""
+    if len(batches) == 1:
+        return batches[0]
+    total = sum(b.num_rows for b in batches)
+    out_cap = bucket_rows(total)
+    ncols = len(batches[0].schema)
+    char_caps = []
+    for ci in range(ncols):
+        if batches[0].columns[ci].is_string_like:
+            nbytes = sum(int(jax.device_get(
+                b.columns[ci].offsets[b.num_rows])) for b in batches)
+            char_caps.append(bucket_bytes(nbytes))
+        else:
+            char_caps.append(0)
+    key = (tuple(b.capacity for b in batches), out_cap, tuple(char_caps),
+           id(batches[0].schema))
+    fn = _concat_jit_cache.get(key)
+    if fn is None:
+        fn = jax.jit(lambda bs: concat_device(bs, out_cap,
+                                              char_caps))
+        _concat_jit_cache[key] = fn
+    return fn(batches)

@@ -1,0 +1,171 @@
+"""Sort-key normalization and multi-key permutation kernels.
+
+TPU replacement for libcudf's radix/merge sort (SURVEY.md §2.2-E, §7.1.3;
+reference mount empty): every key column is normalized to one orderable
+integer lane (floats via IEEE total-order bit tricks with Spark's NaN/-0.0
+semantics; strings via iterative rank refinement), then `jax.lax.sort`
+does one lexicographic sort over the lanes with the row index as the final
+tiebreak key (= stable). The same machinery yields group-ids for the
+sort-based aggregate.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .. import datatypes as dt
+from ..columnar.column import TpuColumnVector
+from .strings import gather_window
+
+__all__ = ["SortSpec", "orderable_int", "string_order_ranks",
+           "sort_permutation", "segment_ids_for_keys"]
+
+_RANK_WINDOW = 7  # bytes per refinement pass: 7 x 9 bits = 63 bits / int64
+
+
+@dataclasses.dataclass(frozen=True)
+class SortSpec:
+    """Per-key direction/null placement (GpuSortOrder analog).
+    Spark defaults: ascending nulls-first; descending nulls-last."""
+    ascending: bool = True
+    nulls_first: bool = True
+
+
+def orderable_int(col: TpuColumnVector) -> jax.Array:
+    """Map a fixed-width column's data lane to a signed integer lane whose
+    ascending order is Spark's ascending order (nulls excluded — handled by
+    a separate rank lane). Floats: -0.0 == 0.0, all NaNs equal and largest."""
+    t = col.dtype
+    d = col.data
+    if isinstance(t, dt.BooleanType):
+        return d.astype(jnp.int8)
+    if dt.is_floating(t):
+        bits_t = jnp.int32 if t.np_dtype == jnp.float32 else jnp.int64
+        # canonicalize: -0.0 -> 0.0, any NaN -> the canonical positive NaN
+        d = jnp.where(d == 0, jnp.zeros_like(d), d)
+        d = jnp.where(jnp.isnan(d), jnp.full_like(d, jnp.nan), d)
+        bits = jax.lax.bitcast_convert_type(d, bits_t)
+        # Signed total-order map: positives (incl. +0, +inf, NaN) keep their
+        # bits (already ascending); negatives map to ~bits + INT_MIN, a
+        # wrapping add that lands them ascending in the negative int range
+        # (-inf lowest, -0.0 -> -1 just below +0.0 -> 0).
+        min_int = jnp.array(jnp.iinfo(bits_t).min, bits_t)
+        return jnp.where(bits < 0, ~bits + min_int, bits)
+    # ints / date / timestamp / decimal already compare as ints
+    return d
+
+
+def string_order_ranks(col: TpuColumnVector, live: jax.Array) -> jax.Array:
+    """Dense order ranks for a string column: rank[i] < rank[j] iff
+    bytes(i) < bytes(j) lexicographically (unsigned); equal strings share a
+    rank. Non-live rows get INT32_MAX so they sort last.
+
+    Iterative refinement: stable-sort by (current-rank, next-7-byte window)
+    and split ties; loops until the longest string is consumed or all ranks
+    are distinct (dynamic trip count, static shapes per pass —
+    SURVEY.md §7.3.1).
+    """
+    offsets, chars = col.offsets, col.chars
+    n = offsets.shape[0] - 1
+    lens = offsets[1:] - offsets[:-1]
+    live_lens = jnp.where(live, lens, 0)
+    max_len = jnp.max(live_lens, initial=0)
+    num_live = jnp.sum(live.astype(jnp.int32))
+    idx = jnp.arange(n, dtype=jnp.int32)
+
+    def window_key(chunk):
+        # pack 7 bytes into one int64, 9 bits each: past-end (-1) -> 0,
+        # real bytes -> 1..256, so shorter strings sort first.
+        w = gather_window(offsets, chars, chunk, window=_RANK_WINDOW)
+        w = (w + 1).astype(jnp.int64)
+        key = jnp.zeros((n,), jnp.int64)
+        for b in range(_RANK_WINDOW):
+            key = (key << 9) | w[:, b]
+        return key
+
+    rank0 = jnp.where(live, jnp.int32(0), jnp.int32(1))
+
+    def cond(state):
+        chunk, rank, distinct = state
+        return (chunk * _RANK_WINDOW < max_len) & (distinct < num_live)
+
+    def body(state):
+        chunk, rank, _ = state
+        key = window_key(chunk)
+        # idx as trailing sort key = stable within (rank, key) ties
+        srank, skey, sidx = jax.lax.sort((rank, key, idx), num_keys=3)
+        boundary = jnp.concatenate([
+            jnp.ones((1,), jnp.bool_),
+            (srank[1:] != srank[:-1]) | (skey[1:] != skey[:-1])])
+        new_rank_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+        new_rank = jnp.zeros((n,), jnp.int32).at[sidx].set(new_rank_sorted)
+        distinct = jnp.max(jnp.where(live, new_rank, -1), initial=-1) + 1
+        return chunk + 1, new_rank, distinct
+
+    _, rank, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), rank0, jnp.int32(0)))
+    return jnp.where(live, rank, jnp.int32(2**31 - 1))
+
+
+def _key_lanes(key_cols: Sequence[TpuColumnVector],
+               specs: Sequence[SortSpec],
+               live: jax.Array) -> List[jax.Array]:
+    """Orderable lanes, most-significant first: a live-rank lane (padding
+    always last), then per key a null-placement lane and a value lane."""
+    lanes: List[jax.Array] = [jnp.where(live, jnp.int8(0), jnp.int8(1))]
+    for col, spec in zip(key_cols, specs):
+        if col.is_string_like:
+            vals = string_order_ranks(col, live & col.validity)
+        elif col.data is None:  # NullType: all rows equal
+            vals = jnp.zeros((live.shape[0],), jnp.int8)
+        else:
+            vals = orderable_int(col)
+        if not spec.ascending:
+            vals = ~vals  # total reversal of the signed int order
+        # Null placement is independent of direction: the value lane
+        # handles direction, this lane handles where nulls land.
+        if spec.nulls_first:
+            null_rank = jnp.where(col.validity, jnp.int8(1), jnp.int8(0))
+        else:
+            null_rank = jnp.where(col.validity, jnp.int8(0), jnp.int8(1))
+        lanes.append(null_rank)
+        lanes.append(vals)
+    return lanes
+
+
+def sort_permutation(key_cols: Sequence[TpuColumnVector],
+                     specs: Sequence[SortSpec],
+                     live: jax.Array) -> jax.Array:
+    """Stable permutation ordering rows by the keys, padding rows last."""
+    n = live.shape[0]
+    lanes = _key_lanes(key_cols, specs, live)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    # idx participates as the least-significant key -> stable
+    out = jax.lax.sort(tuple(lanes) + (idx,), num_keys=len(lanes) + 1)
+    return out[-1]
+
+
+def segment_ids_for_keys(key_cols: Sequence[TpuColumnVector],
+                         live: jax.Array):
+    """(perm, seg_ids_sorted, num_groups): rows permuted so equal keys are
+    adjacent (live rows first), seg ids over the sorted order, and the
+    group count among live rows. Grouping equality is Spark's: null==null,
+    NaN==NaN, -0.0==0.0."""
+    n = live.shape[0]
+    specs = [SortSpec()] * len(key_cols)
+    lanes = _key_lanes(key_cols, specs, live)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    sorted_all = jax.lax.sort(tuple(lanes) + (idx,),
+                              num_keys=len(lanes) + 1)
+    sorted_lanes, perm = sorted_all[:-1], sorted_all[-1]
+    boundary = jnp.zeros((n,), jnp.bool_).at[0].set(True)
+    for lane in sorted_lanes:
+        boundary = boundary | jnp.concatenate(
+            [jnp.zeros((1,), jnp.bool_), lane[1:] != lane[:-1]])
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    live_sorted = live[perm]
+    num_groups = jnp.max(jnp.where(live_sorted, seg + 1, 0), initial=0)
+    return perm, seg, num_groups

@@ -74,12 +74,28 @@ def assert_tpu_and_cpu_expr_equal(expr, rb: pa.RecordBatch, ansi=False,
     return cpu
 
 
+def _elem_sort_key(v, approx_float):
+    """Pairing key for unordered comparison: numeric values compare
+    numerically (so -0.0/0.0 and last-ulp approx noise land in the same
+    position on both sides), everything else by type+string."""
+    if v is None:
+        return (3, "", 0.0)
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        x = float(v) if isinstance(v, float) else v
+        if isinstance(x, float) and approx_float and x != 0 \
+                and math.isfinite(x):
+            # quantize to ~6 significant digits so near-equal values tie
+            x = round(x, 6 - int(math.floor(math.log10(abs(x)))))
+        return (0, "", x)
+    return (1, str(type(v)), str(v))
+
+
 def _sorted_rows(table: pa.Table, types, approx_float):
     cols = [_normalize(c.to_pylist(), t, approx_float)
             for c, t in zip(table.columns, types)]
     rows = list(zip(*cols)) if cols else []
     return sorted(rows, key=lambda r: tuple(
-        (v is None, str(type(v)), str(v)) for v in r))
+        _elem_sort_key(v, approx_float) for v in r))
 
 
 def assert_tpu_and_cpu_plan_equal(plan, conf=None, approx_float=False,

@@ -1,0 +1,133 @@
+"""Sort / TopN / limit operator tests via the dual-run harness
+(reference: sort_test.py, limit_test.py — SURVEY.md §4.1)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import datatypes as dt
+from spark_rapids_tpu.exec import (HostBatchSourceExec, TpuProjectExec)
+from spark_rapids_tpu.exec.sort import (SortOrder, TpuGlobalLimitExec,
+                                        TpuLocalLimitExec, TpuSortExec,
+                                        TpuTopNExec)
+from spark_rapids_tpu.expr import Alias, UnresolvedColumn as col
+
+from asserts import assert_tpu_and_cpu_plan_equal
+from data_gen import (BooleanGen, ByteGen, DateGen, DecimalGen, DoubleGen,
+                      FloatGen, IntegerGen, LongGen, ShortGen, StringGen,
+                      TimestampGen, gen_table)
+
+
+def source(gens, n=256, seed=1234, names=None):
+    return HostBatchSourceExec([gen_table(gens, n, seed, names)])
+
+
+sortable_gens = [ByteGen(), ShortGen(), IntegerGen(), LongGen(),
+                 FloatGen(dt.FLOAT32), DoubleGen(), BooleanGen(),
+                 StringGen(), DateGen(), TimestampGen(), DecimalGen()]
+
+
+@pytest.mark.parametrize("gen", sortable_gens,
+                         ids=lambda g: g.dtype.simple_string())
+@pytest.mark.parametrize("asc", [True, False])
+def test_sort_single_key(gen, asc):
+    # c1 tie-break makes the expected order total (stability-independent).
+    plan = TpuSortExec(
+        [SortOrder(col("c0"), ascending=asc),
+         SortOrder(col("c1"))],
+        source([gen, LongGen(nullable=False)]))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+@pytest.mark.parametrize("nulls_first", [True, False])
+def test_sort_null_placement(nulls_first):
+    plan = TpuSortExec(
+        [SortOrder(col("c0"), ascending=True, nulls_first=nulls_first),
+         SortOrder(col("c1"))],
+        source([IntegerGen(null_frac=0.3), LongGen(nullable=False)]))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_sort_multi_key_mixed_directions():
+    plan = TpuSortExec(
+        [SortOrder(col("c0"), ascending=False),
+         SortOrder(col("c1"), ascending=True, nulls_first=False),
+         SortOrder(col("c2"))],
+        source([IntegerGen(min_val=0, max_val=5), StringGen(max_len=4),
+                LongGen(nullable=False)]))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_sort_strings_long():
+    # strings longer than one 7-byte refinement window, with shared prefixes
+    plan = TpuSortExec(
+        [SortOrder(col("c0")), SortOrder(col("c1"))],
+        source([StringGen(max_len=40, charset="ab"),
+                LongGen(nullable=False)]))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_sort_float_specials():
+    # NaN sorts largest; -0.0 ties 0.0 (broken by c1)
+    plan = TpuSortExec(
+        [SortOrder(col("c0")), SortOrder(col("c1"))],
+        source([DoubleGen(null_frac=0.2), LongGen(nullable=False)]))
+    assert_tpu_and_cpu_plan_equal(plan)
+    plan = TpuSortExec(
+        [SortOrder(col("c0"), ascending=False), SortOrder(col("c1"))],
+        source([DoubleGen(null_frac=0.2), LongGen(nullable=False)]))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_sort_global_multi_batch():
+    rbs = [gen_table([IntegerGen(), LongGen(nullable=False)], n, seed=s)
+           for n, s in [(100, 1), (57, 2), (300, 3)]]
+    plan = TpuSortExec(
+        [SortOrder(col("c0")), SortOrder(col("c1"))],
+        HostBatchSourceExec(rbs))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_sort_local_per_batch():
+    rbs = [gen_table([IntegerGen(nullable=False),
+                      LongGen(nullable=False)], n, seed=s)
+           for n, s in [(64, 1), (32, 2)]]
+    plan = TpuSortExec([SortOrder(col("c0")), SortOrder(col("c1"))],
+                       HostBatchSourceExec(rbs), global_sort=False)
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_sort_strings_multi_batch_concat():
+    rbs = [gen_table([StringGen(max_len=12), LongGen(nullable=False)],
+                     n, seed=s) for n, s in [(80, 4), (120, 5)]]
+    plan = TpuSortExec([SortOrder(col("c0")), SortOrder(col("c1"))],
+                       HostBatchSourceExec(rbs))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_local_limit():
+    rbs = [gen_table([IntegerGen(), StringGen()], n, seed=s)
+           for n, s in [(100, 1), (100, 2), (100, 3)]]
+    for lim in (0, 50, 100, 150, 299, 300, 500):
+        plan = TpuLocalLimitExec(lim, HostBatchSourceExec(rbs))
+        assert_tpu_and_cpu_plan_equal(plan, label=f"limit {lim}")
+
+
+def test_topn():
+    plan = TpuTopNExec(
+        10, [SortOrder(col("c0"), ascending=False), SortOrder(col("c2"))],
+        source([IntegerGen(), StringGen(), LongGen(nullable=False)]))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_topn_with_project():
+    plan = TpuTopNExec(
+        7, [SortOrder(col("c0")), SortOrder(col("c2"))],
+        source([IntegerGen(), StringGen(), LongGen(nullable=False)]),
+        project=[col("c1"), Alias(col("c0"), "k")])
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_limit_after_sort():
+    plan = TpuGlobalLimitExec(
+        25, TpuSortExec([SortOrder(col("c0")), SortOrder(col("c1"))],
+                        source([DateGen(), LongGen(nullable=False)])))
+    assert_tpu_and_cpu_plan_equal(plan)
