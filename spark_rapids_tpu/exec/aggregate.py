@@ -1,0 +1,248 @@
+"""Group-by aggregation operator.
+
+TPU analog of the reference's `aggregate.scala` (`GpuHashAggregateExec` —
+SURVEY.md §2.2-B; reference mount empty), built the TPU-idiomatic way
+(SURVEY.md §7.1.3): no device hash table — rows are sorted by group key,
+segment ids come from key-change boundaries, and aggregate buffers are
+segmented reduces. Two phases like the reference: a partial pass per input
+batch, then partials are concatenated and merged (update -> merge ->
+evaluate), which is exactly the shape a shuffle slots into later.
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import pyarrow as pa
+
+from .. import datatypes as dt
+from ..columnar.arrow_bridge import arrow_schema, arrow_to_device
+from ..columnar.batch import TpuBatch, row_mask
+from ..columnar.column import TpuColumnVector
+from ..expr.aggregates import AggregateFunction
+from ..expr.base import Alias, Expression, bind_expr
+from ..ops.concat import concat_batches
+from ..ops.gather import gather_column
+from ..ops.sort_keys import segment_ids_for_keys
+from .base import ExecCtx, TpuExec, UnaryExec
+from .basic import bind_all
+
+__all__ = ["TpuHashAggregateExec"]
+
+
+def _normalize_float_keys(col: TpuColumnVector) -> TpuColumnVector:
+    """Spark's NormalizeFloatingNumbers for group keys: -0.0 -> 0.0 and
+    every NaN -> the canonical NaN, so grouping and key output agree."""
+    if not dt.is_floating(col.dtype):
+        return col
+    from ..ops.sort_keys import canonicalize_floats
+    return col.with_arrays(data=canonicalize_floats(col.data))
+
+
+def _segment_starts(seg: jax.Array) -> jax.Array:
+    cap = seg.shape[0]
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    starts = jnp.full((cap,), cap - 1, jnp.int32).at[seg].min(
+        pos, mode="drop")
+    return starts
+
+
+def _unalias(e: Expression) -> Tuple[AggregateFunction, str]:
+    if isinstance(e, Alias):
+        fn = e.child
+        name = e.name
+    else:
+        fn = e
+        name = fn.pretty_name().lower()
+    if not isinstance(fn, AggregateFunction):
+        raise TypeError(f"not an aggregate: {e!r}")
+    return fn, name
+
+
+class TpuHashAggregateExec(UnaryExec):
+    """Sort-based group-by with partial/merge phases."""
+
+    def __init__(self, group_exprs: Sequence[Expression],
+                 agg_exprs: Sequence[Expression], child: TpuExec):
+        super().__init__(child)
+        self.group_exprs = bind_all(group_exprs, child.output_schema)
+        self.aggs: List[AggregateFunction] = []
+        self.agg_names: List[str] = []
+        for e in agg_exprs:
+            bound = bind_expr(e, child.output_schema)
+            fn, name = _unalias(bound)
+            self.aggs.append(fn)
+            self.agg_names.append(name)
+
+        from .basic import output_schema_for
+        gfields = list(output_schema_for(self.group_exprs).fields)
+        afields = [dt.StructField(n, a.dtype, a.nullable)
+                   for a, n in zip(self.aggs, self.agg_names)]
+        self._schema = dt.Schema(gfields + afields)
+        # partial buffer schema: group keys + per-agg buffer lanes
+        bfields = list(gfields)
+        self._buf_slices: List[Tuple[int, int]] = []
+        off = len(gfields)
+        for i, a in enumerate(self.aggs):
+            bf = a.buffer_fields
+            self._buf_slices.append((off, off + len(bf)))
+            bfields.extend(dt.StructField(f"_b{i}_{f.name}", f.dtype,
+                                          f.nullable) for f in bf)
+            off += len(bf)
+        self._partial_schema = dt.Schema(bfields)
+        self._jit_partial = None
+        self._jit_final = None
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    def describe(self):
+        g = ", ".join(map(repr, self.group_exprs))
+        a = ", ".join(f"{type(x).__name__.lower()}({', '.join(map(repr, x.children))})"
+                      for x in self.aggs)
+        return f"HashAggregateExec [keys=[{g}] aggs=[{a}]]"
+
+    def tpu_supported(self):
+        for a in self.aggs:
+            r = a.tpu_supported()
+            if r:
+                return r
+        return None
+
+    # --- device phases ----------------------------------------------------
+
+    def _group_and_gather(self, key_cols, extra_cols, live):
+        """Sort by keys; returns (sorted key cols, sorted extra col lists,
+        seg, sorted_live, num_groups, starts)."""
+        cap = live.shape[0]
+        if key_cols:
+            perm, seg, num_groups = segment_ids_for_keys(key_cols, live)
+        else:
+            perm, seg, num_groups0 = segment_ids_for_keys([], live)
+            num_groups = jnp.maximum(num_groups0, 1)  # global agg: 1 group
+        sorted_live = live[perm]
+        out_live = row_mask(cap, num_groups)
+        skeys = [gather_column(c, perm, sorted_live) for c in key_cols]
+        sextras = [[gather_column(c, perm, sorted_live) for c in cols]
+                   for cols in extra_cols]
+        return skeys, sextras, seg, sorted_live, num_groups, out_live
+
+    def _partial(self, batch: TpuBatch, ectx) -> TpuBatch:
+        live = batch.live_mask()
+        key_cols = [_normalize_float_keys(e.eval_tpu(batch, ectx))
+                    for e in self.group_exprs]
+        val_cols = [[c.eval_tpu(batch, ectx) for c in a.children]
+                    for a in self.aggs]
+        skeys, svals, seg, sorted_live, ng, out_live = \
+            self._group_and_gather(key_cols, val_cols, live)
+        starts = _segment_starts(seg)
+        out_cols = [gather_column(k, starts, out_live) for k in skeys]
+        for a, sv in zip(self.aggs, svals):
+            out_cols.extend(a.update_device(sv, seg, sorted_live, out_live))
+        return TpuBatch(out_cols, self._partial_schema, ng)
+
+    def _final(self, pbatch: TpuBatch, ectx) -> TpuBatch:
+        live = pbatch.live_mask()
+        nkeys = len(self.group_exprs)
+        key_cols = pbatch.columns[:nkeys]
+        buf_cols = [[pbatch.columns[i] for i in range(lo, hi)]
+                    for lo, hi in self._buf_slices]
+        skeys, sbufs, seg, sorted_live, ng, out_live = \
+            self._group_and_gather(key_cols, buf_cols, live)
+        starts = _segment_starts(seg)
+        out_cols = [gather_column(k, starts, out_live) for k in skeys]
+        for a, sb in zip(self.aggs, sbufs):
+            merged = a.merge_device(sb, seg, sorted_live, out_live)
+            out_cols.append(a.evaluate_device(merged))
+        return TpuBatch(out_cols, self._schema, ng)
+
+    def _empty_child_batch(self) -> TpuBatch:
+        cschema = self.child.output_schema
+        rb = pa.RecordBatch.from_arrays(
+            [pa.array([], type=dt.to_arrow(f.dtype)) for f in cschema],
+            schema=arrow_schema(cschema))
+        return arrow_to_device(rb, cschema)
+
+    def execute(self, ctx: ExecCtx):
+        if self._jit_partial is None:
+            self._jit_partial = jax.jit(self._partial, static_argnums=1)
+            self._jit_final = jax.jit(self._final, static_argnums=1)
+        op_time = ctx.metric(self, "opTime")
+        t0 = time.perf_counter()
+        partials = [self._jit_partial(b, ctx.eval_ctx)
+                    for b in self.child.execute(ctx)]
+        if not partials:
+            if self.group_exprs:
+                op_time.value += time.perf_counter() - t0
+                return
+            partials = [self._jit_partial(self._empty_child_batch(),
+                                          ctx.eval_ctx)]
+        merged = concat_batches(partials)
+        out = self._jit_final(merged, ctx.eval_ctx)
+        if ctx.sync_metrics:
+            out.block_until_ready()
+        op_time.value += time.perf_counter() - t0
+        yield out
+
+    # --- CPU oracle -------------------------------------------------------
+
+    def execute_cpu(self, ctx: ExecCtx):
+        rbs = list(self.child.execute_cpu(ctx))
+        groups: Dict[tuple, list] = {}
+        key_values: Dict[tuple, tuple] = {}
+
+        def norm_key(v):
+            if isinstance(v, float):
+                if math.isnan(v):
+                    return "\x00__NaN__"
+                if v == 0.0:
+                    return 0.0
+            return v
+
+        for rb in rbs:
+            n = rb.num_rows
+            kcols = [e.eval_cpu(rb, ctx.eval_ctx).to_pylist()
+                     for e in self.group_exprs]
+            vcols = [[c.eval_cpu(rb, ctx.eval_ctx).to_pylist()
+                      for c in a.children] for a in self.aggs]
+            for r in range(n):
+                raw = tuple(k[r] for k in kcols)
+                key = tuple(norm_key(v) for v in raw)
+                if key not in groups:
+                    groups[key] = [[] for _ in self.aggs]
+                    key_values[key] = tuple(
+                        float("nan") if isinstance(v, float)
+                        and math.isnan(v) else
+                        (0.0 if isinstance(v, float) and v == 0.0 else v)
+                        for v in raw)
+                bucket = groups[key]
+                for ai, a in enumerate(self.aggs):
+                    if a.children:
+                        bucket[ai].append(vcols[ai][0][r])
+                    else:
+                        bucket[ai].append(True)  # count(*) placeholder
+
+        if not groups and not self.group_exprs:
+            groups[()] = [[] for _ in self.aggs]
+            key_values[()] = ()
+
+        out_rows_keys = []
+        out_rows_aggs = []
+        for key, buckets in groups.items():
+            out_rows_keys.append(key_values[key])
+            out_rows_aggs.append([a.cpu_agg(vals)
+                                  for a, vals in zip(self.aggs, buckets)])
+        arrays = []
+        for i, f in enumerate(self._schema.fields):
+            nk = len(self.group_exprs)
+            if i < nk:
+                vals = [r[i] for r in out_rows_keys]
+            else:
+                vals = [r[i - nk] for r in out_rows_aggs]
+            arrays.append(pa.array(vals, type=dt.to_arrow(f.dtype)))
+        yield pa.RecordBatch.from_arrays(arrays,
+                                         schema=arrow_schema(self._schema))

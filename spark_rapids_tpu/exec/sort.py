@@ -15,7 +15,6 @@ import time
 from typing import List, Optional, Sequence
 
 import jax
-import numpy as np
 import pyarrow as pa
 
 from .. import datatypes as dt
@@ -25,7 +24,6 @@ from ..ops.concat import concat_batches
 from ..ops.gather import gather_batch
 from ..ops.sort_keys import SortSpec, sort_permutation
 from .base import ExecCtx, TpuExec, UnaryExec
-from .basic import bind_all
 
 __all__ = ["SortOrder", "TpuSortExec", "TpuLocalLimitExec",
            "TpuGlobalLimitExec", "TpuTopNExec", "sort_batch_by",
@@ -187,16 +185,53 @@ class TpuGlobalLimitExec(TpuLocalLimitExec):
         return f"GlobalLimitExec [{self.limit}]"
 
 
+class _PerBatchTopN(UnaryExec):
+    """Sort each incoming batch and truncate it to `limit` rows — the
+    pre-pass that bounds TopN's global merge to O(batches * limit)."""
+
+    def __init__(self, limit: int, orders: Sequence[SortOrder],
+                 child: TpuExec):
+        super().__init__(child)
+        self.limit = limit
+        self.orders = orders  # already bound by the owning TpuTopNExec
+        self._jitted = None
+
+    def describe(self):
+        return f"PerBatchTopN [{self.limit}]"
+
+    def execute(self, ctx: ExecCtx):
+        if self._jitted is None:
+            self._jitted = jax.jit(sort_batch_by, static_argnums=(1, 2))
+        orders = tuple(self.orders)
+        for batch in self.child.execute(ctx):
+            s = self._jitted(batch, orders, ctx.eval_ctx)
+            if s.num_rows > self.limit:
+                s = s.with_columns(s.columns, row_count=self.limit)
+            yield s
+
+    def execute_cpu(self, ctx: ExecCtx):
+        for rb in self.child.execute_cpu(ctx):
+            keys = [o.child.eval_cpu(rb, ctx.eval_ctx) for o in self.orders]
+            t = cpu_sort_table(pa.Table.from_batches([rb]), keys,
+                               self.orders)
+            t = t.slice(0, self.limit)
+            yield from t.combine_chunks().to_batches()
+
+
 class TpuTopNExec(UnaryExec):
-    """Take-ordered(-and-project): global sort + limit, optionally a
-    projection on the way out (GpuTopN / GpuTakeOrderedAndProjectExec)."""
+    """Take-ordered(-and-project): per-batch top-N, global merge sort,
+    limit, optional projection (GpuTopN / GpuTakeOrderedAndProjectExec)."""
 
     def __init__(self, limit: int, orders: Sequence[SortOrder],
                  child: TpuExec,
                  project: Optional[Sequence[Expression]] = None):
         super().__init__(child)
         self.limit = limit
-        self._sort = TpuSortExec(orders, child, global_sort=True)
+        bound = [dataclasses.replace(
+            o, child=bind_expr(o.child, child.output_schema))
+            for o in orders]
+        pre = _PerBatchTopN(limit, bound, child)
+        self._sort = TpuSortExec(orders, pre, global_sort=True)
         self._limit = TpuGlobalLimitExec(limit, self._sort)
         if project is not None:
             from .basic import TpuProjectExec

@@ -1,0 +1,536 @@
+"""Aggregate functions.
+
+TPU analog of the reference's `aggregate/` + `GpuAggregateFunction.scala`
+(SURVEY.md §2.2-C; reference mount empty). Each function defines the
+classic three-phase contract over *segmented* device data (the sort-based
+group-by — SURVEY.md §7.1.3):
+
+- ``update_device``   — raw sorted input rows -> per-group partial buffers
+- ``merge_device``    — sorted partial buffers -> merged buffers
+- ``evaluate_device`` — merged buffers -> final result column
+- ``cpu_agg``         — Spark-semantics oracle over one group's python
+  values (complete mode), for the dual-run harness.
+
+Rows arrive sorted by group key; ``seg`` is the segment id per sorted row,
+``sorted_live`` masks padding, and buffers live in output rows
+[0, num_groups) of the same static capacity.
+"""
+from __future__ import annotations
+
+import decimal
+import math
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .. import datatypes as dt
+from ..columnar.column import TpuColumnVector
+from .base import Expression
+
+__all__ = ["AggregateFunction", "Sum", "Count", "Min", "Max", "Average",
+           "First", "Last", "StddevSamp", "StddevPop", "VarianceSamp",
+           "VariancePop"]
+
+_I64 = jnp.int64
+_F64 = jnp.float64
+
+
+def _seg_sum(vals, seg, cap):
+    return jax.ops.segment_sum(vals, seg, num_segments=cap)
+
+
+def _seg_min(vals, seg, cap):
+    return jax.ops.segment_min(vals, seg, num_segments=cap)
+
+
+def _seg_max(vals, seg, cap):
+    return jax.ops.segment_max(vals, seg, num_segments=cap)
+
+
+def _type_extreme(np_dtype, largest: bool):
+    if jnp.issubdtype(np_dtype, jnp.floating):
+        return jnp.inf if largest else -jnp.inf
+    info = jnp.iinfo(np_dtype)
+    return info.max if largest else info.min
+
+
+class AggregateFunction(Expression):
+    """Base aggregate. children = input value expressions."""
+
+    is_aggregate = True
+
+    @property
+    def nullable(self):
+        # aggregates are null over an empty (global) group; Count overrides
+        return True
+
+    @property
+    def buffer_fields(self) -> List[dt.StructField]:
+        raise NotImplementedError
+
+    def update_device(self, vals: List[TpuColumnVector], seg, sorted_live,
+                      out_live) -> List[TpuColumnVector]:
+        raise NotImplementedError
+
+    def merge_device(self, bufs: List[TpuColumnVector], seg, sorted_live,
+                     out_live) -> List[TpuColumnVector]:
+        raise NotImplementedError
+
+    def evaluate_device(self, bufs: List[TpuColumnVector]) \
+            -> TpuColumnVector:
+        raise NotImplementedError
+
+    def cpu_agg(self, values: List):
+        raise NotImplementedError
+
+
+def _masked(col: TpuColumnVector, seg, sorted_live):
+    """(data, valid) with padding/null rows excluded from valid."""
+    valid = col.validity & sorted_live
+    return col.data, valid
+
+
+def _seg_count_valid(valid, seg, cap):
+    return _seg_sum(valid.astype(_I64), seg, cap)
+
+
+def _sum_lanes(col, seg, sorted_live, cap, acc_dtype):
+    data, valid = _masked(col, seg, sorted_live)
+    contrib = jnp.where(valid, data.astype(acc_dtype),
+                        jnp.zeros((), acc_dtype))
+    s = _seg_sum(contrib, seg, cap)
+    cnt = _seg_count_valid(valid, seg, cap)
+    return s, cnt
+
+
+class Sum(AggregateFunction):
+    """Spark sum: integral->long (wrapping when non-ANSI), float->double,
+    decimal(p,s)->decimal(p+10,s)."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        t = self.children[0].dtype
+        if isinstance(t, dt.DecimalType):
+            return dt.DecimalType(min(t.precision + 10, 38), t.scale)
+        if dt.is_floating(t):
+            return dt.FLOAT64
+        return dt.INT64
+
+    def tpu_supported(self):
+        t = self.dtype
+        if isinstance(t, dt.DecimalType) \
+                and t.precision > dt.DecimalType.MAX_INT64_PRECISION:
+            return f"sum result {t.simple_string()} exceeds device decimal"
+        return None
+
+    @property
+    def buffer_fields(self):
+        return [dt.StructField("sum", self.dtype, True)]
+
+    def _acc(self):
+        return _F64 if dt.is_floating(self.dtype) else _I64
+
+    def update_device(self, vals, seg, sorted_live, out_live):
+        cap = seg.shape[0]
+        s, cnt = _sum_lanes(vals[0], seg, sorted_live, cap, self._acc())
+        return [TpuColumnVector(self.dtype, data=s,
+                                validity=(cnt > 0) & out_live)]
+
+    def merge_device(self, bufs, seg, sorted_live, out_live):
+        cap = seg.shape[0]
+        s, cnt = _sum_lanes(bufs[0], seg, sorted_live, cap, self._acc())
+        return [TpuColumnVector(self.dtype, data=s,
+                                validity=(cnt > 0) & out_live)]
+
+    def evaluate_device(self, bufs):
+        return bufs[0]
+
+    def cpu_agg(self, values):
+        vals = [v for v in values if v is not None]
+        if not vals:
+            return None
+        t = self.dtype
+        if isinstance(t, dt.DecimalType):
+            total = sum(vals, decimal.Decimal(0))
+            return total.quantize(decimal.Decimal(1).scaleb(-t.scale))
+        if dt.is_floating(t):
+            return float(sum(float(v) for v in vals))
+        total = sum(int(v) for v in vals)
+        total &= (1 << 64) - 1  # java long wrap-around
+        return total - (1 << 64) if total >= (1 << 63) else total
+
+
+class Count(AggregateFunction):
+    """count(expr) counts non-null; count(*) (no child) counts rows."""
+
+    def __init__(self, child: Optional[Expression] = None):
+        self.children = (child,) if child is not None else ()
+
+    @property
+    def dtype(self):
+        return dt.INT64
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def buffer_fields(self):
+        return [dt.StructField("count", dt.INT64, False)]
+
+    def update_device(self, vals, seg, sorted_live, out_live):
+        cap = seg.shape[0]
+        if vals:
+            _, valid = _masked(vals[0], seg, sorted_live)
+        else:
+            valid = sorted_live
+        cnt = _seg_count_valid(valid, seg, cap)
+        return [TpuColumnVector(dt.INT64, data=cnt, validity=out_live)]
+
+    def merge_device(self, bufs, seg, sorted_live, out_live):
+        cap = seg.shape[0]
+        data, valid = _masked(bufs[0], seg, sorted_live)
+        s = _seg_sum(jnp.where(valid, data, 0), seg, cap)
+        return [TpuColumnVector(dt.INT64, data=s, validity=out_live)]
+
+    def evaluate_device(self, bufs):
+        return bufs[0]
+
+    def cpu_agg(self, values):
+        if not self.children:
+            return len(values)
+        return sum(1 for v in values if v is not None)
+
+
+class _MinMax(AggregateFunction):
+    largest = False
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def tpu_supported(self):
+        if self.children[0].dtype.is_variable_width:
+            return "min/max over strings not yet on device"
+        return None
+
+    @property
+    def buffer_fields(self):
+        return [dt.StructField("m", self.dtype, True)]
+
+    def _reduce(self, col, seg, sorted_live, out_live):
+        cap = seg.shape[0]
+        data, valid = _masked(col, seg, sorted_live)
+        t = self.dtype
+        if dt.is_floating(t):
+            # Spark: NaN is the largest value; -0.0 == 0.0 (keep either)
+            key_col = TpuColumnVector(t, data=data, validity=valid)
+            from ..ops.sort_keys import orderable_int
+            keys = orderable_int(key_col)
+            fill = jnp.iinfo(keys.dtype).min if self.largest else \
+                jnp.iinfo(keys.dtype).max
+            keys = jnp.where(valid, keys, fill)
+            red = _seg_max(keys, seg, cap) if self.largest else \
+                _seg_min(keys, seg, cap)
+            # map orderable int back to float: invert the bit transform
+            bits_t = keys.dtype
+            min_int = jnp.array(jnp.iinfo(bits_t).min, bits_t)
+            bits = jnp.where(red < 0, ~(red - min_int), red)
+            out = jax.lax.bitcast_convert_type(
+                bits, t.np_dtype)
+            cnt = _seg_count_valid(valid, seg, cap)
+            return TpuColumnVector(t, data=out,
+                                   validity=(cnt > 0) & out_live)
+        is_bool = isinstance(t, dt.BooleanType)
+        if is_bool:
+            data = data.astype(jnp.int8)
+        fill = _type_extreme(data.dtype, largest=not self.largest)
+        vals2 = jnp.where(valid, data, jnp.array(fill, data.dtype))
+        red = _seg_max(vals2, seg, cap) if self.largest else \
+            _seg_min(vals2, seg, cap)
+        if is_bool:
+            red = red.astype(jnp.bool_)
+        cnt = _seg_count_valid(valid, seg, cap)
+        return TpuColumnVector(self.dtype, data=red,
+                               validity=(cnt > 0) & out_live)
+
+    def update_device(self, vals, seg, sorted_live, out_live):
+        return [self._reduce(vals[0], seg, sorted_live, out_live)]
+
+    def merge_device(self, bufs, seg, sorted_live, out_live):
+        return [self._reduce(bufs[0], seg, sorted_live, out_live)]
+
+    def evaluate_device(self, bufs):
+        return bufs[0]
+
+    def cpu_agg(self, values):
+        vals = [v for v in values if v is not None]
+        if not vals:
+            return None
+        if dt.is_floating(self.dtype):
+            def key(v):
+                return (1, 0.0) if math.isnan(v) else (0, v + 0.0)
+            return max(vals, key=key) if self.largest \
+                else min(vals, key=key)
+        return max(vals) if self.largest else min(vals)
+
+
+class Max(_MinMax):
+    largest = True
+
+
+class Min(_MinMax):
+    largest = False
+
+
+class Average(AggregateFunction):
+    """Spark avg: numeric -> double (sum accumulated in double);
+    decimal(p,s) -> decimal(p+4, s+4)."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        t = self.children[0].dtype
+        if isinstance(t, dt.DecimalType):
+            return dt.DecimalType(min(t.precision + 4, 38),
+                                  min(t.scale + 4, 38))
+        return dt.FLOAT64
+
+    def tpu_supported(self):
+        t = self.children[0].dtype
+        if isinstance(t, dt.DecimalType):
+            # evaluate scales the int64 sum by 1e4 before dividing, so the
+            # sum buffer needs p+10+4 digits of headroom
+            if t.precision + 14 > dt.DecimalType.MAX_INT64_PRECISION:
+                return "decimal average exceeds device decimal range"
+        return None
+
+    @property
+    def buffer_fields(self):
+        t = self.children[0].dtype
+        sum_t = dt.DecimalType(min(t.precision + 10, 38), t.scale) \
+            if isinstance(t, dt.DecimalType) else dt.FLOAT64
+        return [dt.StructField("sum", sum_t, True),
+                dt.StructField("count", dt.INT64, False)]
+
+    def _is_decimal(self):
+        return isinstance(self.children[0].dtype, dt.DecimalType)
+
+    def update_device(self, vals, seg, sorted_live, out_live):
+        cap = seg.shape[0]
+        acc = _I64 if self._is_decimal() else _F64
+        s, cnt = _sum_lanes(vals[0], seg, sorted_live, cap, acc)
+        sum_t = self.buffer_fields[0].dtype
+        return [TpuColumnVector(sum_t, data=s,
+                                validity=(cnt > 0) & out_live),
+                TpuColumnVector(dt.INT64, data=cnt, validity=out_live)]
+
+    def merge_device(self, bufs, seg, sorted_live, out_live):
+        cap = seg.shape[0]
+        acc = _I64 if self._is_decimal() else _F64
+        s, scnt = _sum_lanes(bufs[0], seg, sorted_live, cap, acc)
+        cdata, cvalid = _masked(bufs[1], seg, sorted_live)
+        cnt = _seg_sum(jnp.where(cvalid, cdata, 0), seg, cap)
+        sum_t = self.buffer_fields[0].dtype
+        return [TpuColumnVector(sum_t, data=s,
+                                validity=(scnt > 0) & out_live),
+                TpuColumnVector(dt.INT64, data=cnt,
+                                validity=out_live)]
+
+    def evaluate_device(self, bufs):
+        s, cnt = bufs
+        valid = s.validity & (cnt.data > 0)
+        if self._is_decimal():
+            # result scale = input scale + 4: scale the int sum up by 1e4
+            # before the rounded divide (HALF_UP like Spark). jnp // floors,
+            # so rem is in [0, den); HALF_UP (away from zero) means bump
+            # when rem > den/2, or exactly half on a positive quotient.
+            t = self.dtype
+            num = s.data * 10_000
+            den = jnp.where(cnt.data > 0, cnt.data, 1)
+            quot = num // den
+            rem = num - quot * den
+            up = (2 * rem > den) | ((2 * rem == den) & (num > 0))
+            out = quot + up.astype(_I64)
+            return TpuColumnVector(t, data=out.astype(_I64),
+                                   validity=valid)
+        den = jnp.where(cnt.data > 0, cnt.data, 1).astype(_F64)
+        return TpuColumnVector(dt.FLOAT64, data=s.data / den,
+                               validity=valid)
+
+    def cpu_agg(self, values):
+        vals = [v for v in values if v is not None]
+        if not vals:
+            return None
+        if self._is_decimal():
+            t = self.dtype
+            total = sum(vals, decimal.Decimal(0))
+            with decimal.localcontext() as ctx2:
+                ctx2.rounding = decimal.ROUND_HALF_UP
+                return (total / len(vals)).quantize(
+                    decimal.Decimal(1).scaleb(-t.scale),
+                    rounding=decimal.ROUND_HALF_UP)
+        return float(sum(float(v) for v in vals)) / len(vals)
+
+
+class _FirstLast(AggregateFunction):
+    take_last = False
+
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        self.children = (child,)
+        self.ignore_nulls = ignore_nulls
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def tpu_supported(self):
+        if self.children[0].dtype.is_variable_width:
+            return "first/last over strings not yet on device"
+        return None
+
+    @property
+    def buffer_fields(self):
+        return [dt.StructField("v", self.dtype, True)]
+
+    def _pick(self, col, seg, sorted_live, out_live):
+        cap = seg.shape[0]
+        data, valid = _masked(col, seg, sorted_live)
+        candidate = sorted_live & (valid if self.ignore_nulls
+                                   else jnp.ones_like(valid))
+        pos = jnp.arange(cap, dtype=jnp.int32)
+        if self.take_last:
+            marked = jnp.where(candidate, pos, -1)
+            picked = _seg_max(marked, seg, cap)
+            found = picked >= 0
+        else:
+            marked = jnp.where(candidate, pos, cap)
+            picked = _seg_min(marked, seg, cap)
+            found = picked < cap
+        idx = jnp.clip(picked, 0, cap - 1)
+        if col.data is None:
+            return TpuColumnVector(self.dtype,
+                                   validity=jnp.zeros((cap,), jnp.bool_))
+        out = data[idx]
+        out_valid = found & valid[idx] & out_live
+        return TpuColumnVector(self.dtype, data=out, validity=out_valid)
+
+    def update_device(self, vals, seg, sorted_live, out_live):
+        return [self._pick(vals[0], seg, sorted_live, out_live)]
+
+    def merge_device(self, bufs, seg, sorted_live, out_live):
+        return [self._pick(bufs[0], seg, sorted_live, out_live)]
+
+    def evaluate_device(self, bufs):
+        return bufs[0]
+
+    def cpu_agg(self, values):
+        seq = values if not self.take_last else list(reversed(values))
+        for v in seq:
+            if v is not None or not self.ignore_nulls:
+                return v
+        return None
+
+
+class First(_FirstLast):
+    take_last = False
+
+
+class Last(_FirstLast):
+    take_last = True
+
+
+class _CentralMoment(AggregateFunction):
+    """stddev/variance via (n, sum, sumsq) buffers; double precision."""
+
+    sample = True
+    take_sqrt = False
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return dt.FLOAT64
+
+    @property
+    def buffer_fields(self):
+        return [dt.StructField("n", dt.FLOAT64, False),
+                dt.StructField("sum", dt.FLOAT64, False),
+                dt.StructField("sumsq", dt.FLOAT64, False)]
+
+    def update_device(self, vals, seg, sorted_live, out_live):
+        cap = seg.shape[0]
+        data, valid = _masked(vals[0], seg, sorted_live)
+        x = jnp.where(valid, data.astype(_F64), 0.0)
+        n = _seg_sum(valid.astype(_F64), seg, cap)
+        s = _seg_sum(x, seg, cap)
+        ss = _seg_sum(x * x, seg, cap)
+        return [TpuColumnVector(dt.FLOAT64, data=lane, validity=out_live)
+                for lane in (n, s, ss)]
+
+    def merge_device(self, bufs, seg, sorted_live, out_live):
+        cap = seg.shape[0]
+        out = []
+        for b in bufs:
+            data, valid = _masked(b, seg, sorted_live)
+            lane = _seg_sum(jnp.where(valid, data, 0.0), seg, cap)
+            out.append(TpuColumnVector(dt.FLOAT64, data=lane,
+                                       validity=out_live))
+        return out
+
+    def evaluate_device(self, bufs):
+        n, s, ss = (b.data for b in bufs)
+        m2 = ss - jnp.where(n > 0, s * s / jnp.where(n > 0, n, 1.0), 0.0)
+        m2 = jnp.maximum(m2, 0.0)
+        if self.sample:
+            var = jnp.where(n > 1, m2 / jnp.where(n > 1, n - 1, 1.0),
+                            jnp.nan)
+        else:
+            var = jnp.where(n > 0, m2 / jnp.where(n > 0, n, 1.0), jnp.nan)
+        out = jnp.sqrt(var) if self.take_sqrt else var
+        return TpuColumnVector(dt.FLOAT64, data=out,
+                               validity=bufs[0].validity & (n > 0))
+
+    def cpu_agg(self, values):
+        vals = [float(v) for v in values if v is not None]
+        n = len(vals)
+        if n == 0:
+            return None
+        mean = sum(vals) / n
+        m2 = sum((v - mean) ** 2 for v in vals)
+        if self.sample:
+            var = m2 / (n - 1) if n > 1 else float("nan")
+        else:
+            var = m2 / n
+        return math.sqrt(var) if self.take_sqrt and not math.isnan(var) \
+            else (float("nan") if math.isnan(var) else var)
+
+
+class VarianceSamp(_CentralMoment):
+    sample = True
+    take_sqrt = False
+
+
+class VariancePop(_CentralMoment):
+    sample = False
+    take_sqrt = False
+
+
+class StddevSamp(_CentralMoment):
+    sample = True
+    take_sqrt = True
+
+
+class StddevPop(_CentralMoment):
+    sample = False
+    take_sqrt = True

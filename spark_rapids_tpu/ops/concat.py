@@ -104,17 +104,25 @@ def concat_batches(batches: List[TpuBatch]) -> TpuBatch:
     combination — bounded by the power-of-two bucketing."""
     if len(batches) == 1:
         return batches[0]
-    total = sum(b.num_rows for b in batches)
-    out_cap = bucket_rows(total)
     ncols = len(batches[0].schema)
-    char_caps = []
-    for ci in range(ncols):
-        if batches[0].columns[ci].is_string_like:
-            nbytes = sum(int(jax.device_get(
-                b.columns[ci].offsets[b.num_rows])) for b in batches)
-            char_caps.append(bucket_bytes(nbytes))
-        else:
-            char_caps.append(0)
+    str_cols = [ci for ci in range(ncols)
+                if batches[0].columns[ci].is_string_like]
+    # one device->host transfer for all row counts + string byte counts
+    scalars = [b.row_count for b in batches]
+    for ci in str_cols:
+        scalars.extend(b.columns[ci].offsets[b.row_count] for b in batches)
+    host = [int(v) for v in jax.device_get(jnp.stack(
+        [jnp.asarray(s, jnp.int64) for s in scalars]))]
+    nb = len(batches)
+    for b, rc in zip(batches, host[:nb]):
+        if b._num_rows_cache is None:
+            b._num_rows_cache = rc
+    total = sum(host[:nb])
+    out_cap = bucket_rows(total)
+    char_caps = [0] * ncols
+    for si, ci in enumerate(str_cols):
+        nbytes = sum(host[nb * (si + 1): nb * (si + 2)])
+        char_caps[ci] = bucket_bytes(nbytes)
     key = (tuple(b.capacity for b in batches), out_cap, tuple(char_caps),
            id(batches[0].schema))
     fn = _concat_jit_cache.get(key)

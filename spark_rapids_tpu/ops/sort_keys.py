@@ -34,6 +34,14 @@ class SortSpec:
     nulls_first: bool = True
 
 
+def canonicalize_floats(d: jax.Array) -> jax.Array:
+    """-0.0 -> 0.0, any NaN -> the canonical positive NaN (Spark's
+    NormalizeFloatingNumbers semantics, shared by sort keys, group keys
+    and min/max)."""
+    d = jnp.where(d == 0, jnp.zeros_like(d), d)
+    return jnp.where(jnp.isnan(d), jnp.full_like(d, jnp.nan), d)
+
+
 def orderable_int(col: TpuColumnVector) -> jax.Array:
     """Map a fixed-width column's data lane to a signed integer lane whose
     ascending order is Spark's ascending order (nulls excluded — handled by
@@ -44,9 +52,7 @@ def orderable_int(col: TpuColumnVector) -> jax.Array:
         return d.astype(jnp.int8)
     if dt.is_floating(t):
         bits_t = jnp.int32 if t.np_dtype == jnp.float32 else jnp.int64
-        # canonicalize: -0.0 -> 0.0, any NaN -> the canonical positive NaN
-        d = jnp.where(d == 0, jnp.zeros_like(d), d)
-        d = jnp.where(jnp.isnan(d), jnp.full_like(d, jnp.nan), d)
+        d = canonicalize_floats(d)
         bits = jax.lax.bitcast_convert_type(d, bits_t)
         # Signed total-order map: positives (incl. +0, +inf, NaN) keep their
         # bits (already ascending); negatives map to ~bits + INT_MIN, a
@@ -122,7 +128,11 @@ def _key_lanes(key_cols: Sequence[TpuColumnVector],
         elif col.data is None:  # NullType: all rows equal
             vals = jnp.zeros((live.shape[0],), jnp.int8)
         else:
+            # neutralize the lane under nulls: computed expressions leave
+            # garbage in the data lane of null rows, and null==null must
+            # hold for both ordering and grouping
             vals = orderable_int(col)
+            vals = jnp.where(col.validity, vals, jnp.zeros_like(vals))
         if not spec.ascending:
             vals = ~vals  # total reversal of the signed int order
         # Null placement is independent of direction: the value lane

@@ -1,0 +1,204 @@
+"""Group-by / aggregate tests via the dual-run harness
+(reference: hash_aggregate_test.py — SURVEY.md §4.1)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import datatypes as dt
+from spark_rapids_tpu.exec import HostBatchSourceExec
+from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+from spark_rapids_tpu.expr import Alias, UnresolvedColumn as col
+from spark_rapids_tpu.expr.aggregates import (Average, Count, First, Last,
+                                              Max, Min, StddevPop,
+                                              StddevSamp, Sum, VariancePop,
+                                              VarianceSamp)
+
+from asserts import assert_tpu_and_cpu_plan_equal
+from data_gen import (BooleanGen, ByteGen, DateGen, DecimalGen, DoubleGen,
+                      FloatGen, IntegerGen, LongGen, ShortGen, StringGen,
+                      TimestampGen, gen_table)
+
+
+def source(gens, n=256, seed=1234, names=None):
+    return HostBatchSourceExec([gen_table(gens, n, seed, names)])
+
+
+def kv_source(key_gen, val_gen, n=512, seed=7):
+    return source([key_gen, val_gen], n, seed)
+
+
+def agg_plan(src, keys, aggs):
+    return TpuHashAggregateExec(keys, aggs, src)
+
+
+key_gens = [IntegerGen(min_val=0, max_val=10), LongGen(),
+            StringGen(max_len=6), DateGen(), BooleanGen(),
+            DoubleGen(null_frac=0.2)]
+
+
+@pytest.mark.parametrize("kg", key_gens,
+                         ids=lambda g: g.dtype.simple_string())
+def test_groupby_count_star(kg):
+    plan = agg_plan(kv_source(kg, IntegerGen()), [col("c0")],
+                    [Alias(Count(), "cnt")])
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+@pytest.mark.parametrize("vg", [ByteGen(), ShortGen(), IntegerGen(),
+                                LongGen(), FloatGen(dt.FLOAT32),
+                                DoubleGen()],
+                         ids=lambda g: g.dtype.simple_string())
+def test_groupby_sum(vg):
+    plan = agg_plan(
+        kv_source(IntegerGen(min_val=0, max_val=20), vg),
+        [col("c0")], [Alias(Sum(col("c1")), "s")])
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True,
+                                  approx_float=True)
+
+
+def test_groupby_sum_decimal():
+    plan = agg_plan(
+        kv_source(IntegerGen(min_val=0, max_val=10),
+                  DecimalGen(precision=7, scale=2)),
+        [col("c0")], [Alias(Sum(col("c1")), "s")])
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+@pytest.mark.parametrize("vg", [IntegerGen(null_frac=0.3), LongGen(),
+                                DoubleGen(), DateGen(), TimestampGen(),
+                                BooleanGen()],
+                         ids=lambda g: g.dtype.simple_string())
+def test_groupby_min_max(vg):
+    plan = agg_plan(
+        kv_source(IntegerGen(min_val=0, max_val=15), vg),
+        [col("c0")],
+        [Alias(Min(col("c1")), "mn"), Alias(Max(col("c1")), "mx")])
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+def test_groupby_avg():
+    plan = agg_plan(
+        kv_source(IntegerGen(min_val=0, max_val=12), LongGen()),
+        [col("c0")], [Alias(Average(col("c1")), "a")])
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True,
+                                  approx_float=True)
+
+
+def test_groupby_avg_decimal():
+    plan = agg_plan(
+        kv_source(IntegerGen(min_val=0, max_val=5),
+                  DecimalGen(precision=4, scale=1)),
+        [col("c0")], [Alias(Average(col("c1")), "a")])
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+def test_groupby_count_column():
+    plan = agg_plan(
+        kv_source(IntegerGen(min_val=0, max_val=8),
+                  IntegerGen(null_frac=0.4)),
+        [col("c0")],
+        [Alias(Count(col("c1")), "c"), Alias(Count(), "cs")])
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+def test_groupby_first_last():
+    # first/last are order-dependent: make values unique per key via a
+    # single-batch source with ignore_nulls both ways
+    plan = agg_plan(
+        kv_source(IntegerGen(min_val=0, max_val=6, nullable=False),
+                  IntegerGen(null_frac=0.5), n=64),
+        [col("c0")],
+        [Alias(First(col("c1"), ignore_nulls=True), "f"),
+         Alias(Last(col("c1"), ignore_nulls=True), "l")])
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+def test_groupby_stddev_variance():
+    plan = agg_plan(
+        kv_source(IntegerGen(min_val=0, max_val=10),
+                  DoubleGen(special=False)),
+        [col("c0")],
+        [Alias(StddevSamp(col("c1")), "ss"),
+         Alias(StddevPop(col("c1")), "sp"),
+         Alias(VarianceSamp(col("c1")), "vs"),
+         Alias(VariancePop(col("c1")), "vp")])
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True,
+                                  approx_float=True)
+
+
+def test_groupby_multi_key():
+    plan = agg_plan(
+        source([IntegerGen(min_val=0, max_val=4), StringGen(max_len=3),
+                BooleanGen(), LongGen()], n=512),
+        [col("c0"), col("c1"), col("c2")],
+        [Alias(Sum(col("c3")), "s"), Alias(Count(), "c")])
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+def test_groupby_float_key_specials():
+    # NaN groups as one; -0.0 and 0.0 group together
+    plan = agg_plan(
+        kv_source(DoubleGen(null_frac=0.2), IntegerGen()),
+        [col("c0")], [Alias(Count(), "c")])
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+def test_groupby_null_keys_group():
+    plan = agg_plan(
+        kv_source(IntegerGen(null_frac=0.5), LongGen()),
+        [col("c0")], [Alias(Sum(col("c1")), "s"), Alias(Count(), "c")])
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+def test_global_agg():
+    plan = agg_plan(
+        source([IntegerGen(), DoubleGen()], n=300), [],
+        [Alias(Sum(col("c0")), "s"), Alias(Count(), "c"),
+         Alias(Min(col("c0")), "mn"), Alias(Max(col("c1")), "mx"),
+         Alias(Average(col("c0")), "a")])
+    assert_tpu_and_cpu_plan_equal(plan, approx_float=True)
+
+
+def test_global_agg_empty_input():
+    empty = pa.record_batch(
+        {"c0": pa.array([], pa.int32()), "c1": pa.array([], pa.float64())})
+    plan = agg_plan(HostBatchSourceExec([empty]), [],
+                    [Alias(Sum(col("c0")), "s"), Alias(Count(), "c"),
+                     Alias(Min(col("c1")), "mn")])
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_groupby_empty_input():
+    empty = pa.record_batch(
+        {"c0": pa.array([], pa.int32()), "c1": pa.array([], pa.int64())})
+    plan = agg_plan(HostBatchSourceExec([empty]), [col("c0")],
+                    [Alias(Sum(col("c1")), "s")])
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_groupby_multi_batch_merge():
+    rbs = [gen_table([IntegerGen(min_val=0, max_val=10), LongGen()],
+                     n, seed=s) for n, s in [(200, 1), (150, 2), (300, 3)]]
+    plan = agg_plan(HostBatchSourceExec(rbs), [col("c0")],
+                    [Alias(Sum(col("c1")), "s"), Alias(Count(), "c"),
+                     Alias(Min(col("c1")), "mn"),
+                     Alias(Max(col("c1")), "mx")])
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+def test_groupby_string_keys_multi_batch():
+    rbs = [gen_table([StringGen(max_len=4), IntegerGen()], n, seed=s)
+           for n, s in [(120, 5), (180, 6)]]
+    plan = agg_plan(HostBatchSourceExec(rbs), [col("c0")],
+                    [Alias(Count(), "c"), Alias(Sum(col("c1")), "s")])
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+def test_groupby_computed_key_with_nulls():
+    # Regression: null==null must hold for computed group keys whose data
+    # lane holds garbage under nulls.
+    from spark_rapids_tpu.expr import Add
+    plan = agg_plan(
+        kv_source(IntegerGen(null_frac=0.4), IntegerGen(null_frac=0.4)),
+        [Alias(Add(col("c0"), col("c1")), "k")],
+        [Alias(Count(), "c")])
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)

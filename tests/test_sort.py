@@ -131,3 +131,14 @@ def test_limit_after_sort():
         25, TpuSortExec([SortOrder(col("c0")), SortOrder(col("c1"))],
                         source([DateGen(), LongGen(nullable=False)])))
     assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_sort_computed_key_with_nulls():
+    # Regression: computed keys leave garbage in null rows' data lane;
+    # null ordering must not depend on it.
+    from spark_rapids_tpu.expr import Add
+    plan = TpuSortExec(
+        [SortOrder(Add(col("c0"), col("c1"))), SortOrder(col("c2"))],
+        source([IntegerGen(null_frac=0.4), IntegerGen(null_frac=0.4),
+                LongGen(nullable=False)]))
+    assert_tpu_and_cpu_plan_equal(plan)
