@@ -33,13 +33,7 @@ from .basic import bind_all
 __all__ = ["TpuHashAggregateExec"]
 
 
-def _normalize_float_keys(col: TpuColumnVector) -> TpuColumnVector:
-    """Spark's NormalizeFloatingNumbers for group keys: -0.0 -> 0.0 and
-    every NaN -> the canonical NaN, so grouping and key output agree."""
-    if not dt.is_floating(col.dtype):
-        return col
-    from ..ops.sort_keys import canonicalize_floats
-    return col.with_arrays(data=canonicalize_floats(col.data))
+from ..ops.sort_keys import normalize_float_key_col as _normalize_float_keys
 
 
 def _segment_starts(seg: jax.Array) -> jax.Array:
@@ -172,9 +166,12 @@ class TpuHashAggregateExec(UnaryExec):
             self._jit_partial = jax.jit(self._partial, static_argnums=1)
             self._jit_final = jax.jit(self._final, static_argnums=1)
         op_time = ctx.metric(self, "opTime")
+        partials = []
+        for b in self.child.execute(ctx):
+            t0 = time.perf_counter()
+            partials.append(self._jit_partial(b, ctx.eval_ctx))
+            op_time.value += time.perf_counter() - t0
         t0 = time.perf_counter()
-        partials = [self._jit_partial(b, ctx.eval_ctx)
-                    for b in self.child.execute(ctx)]
         if not partials:
             if self.group_exprs:
                 op_time.value += time.perf_counter() - t0

@@ -1,0 +1,340 @@
+"""Join operators.
+
+TPU analog of the reference's join execs (`GpuShuffledHashJoinExec`,
+`GpuBroadcastHashJoinExec`, `GpuSortMergeJoinMeta` — rewritten to a hash
+join there, a sort join here — `GpuBroadcastNestedLoopJoinExec`,
+`GpuCartesianProductExec`; SURVEY.md §2.2-B; reference mount empty).
+
+Single-partition local join core: the build (right) side is concatenated
+once; each stream (left) batch runs the staged sort-join kernel
+(ops/join.py). Shuffled/broadcast distribution wraps this core at the
+exchange layer. Extra non-equi conditions are applied as a post-filter for
+inner/cross joins (other types report unsupported and fall back).
+"""
+from __future__ import annotations
+
+import math
+import time
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import pyarrow as pa
+
+from .. import datatypes as dt
+from ..columnar.arrow_bridge import arrow_schema
+from ..columnar.batch import TpuBatch, bucket_bytes, bucket_rows
+from ..columnar.column import TpuColumnVector
+from ..expr.base import Expression, bind_expr
+from ..ops.concat import concat_batches
+from ..ops.gather import compact_batch
+from ..ops.join import (JOIN_TYPES, join_counts, join_gather, join_indices,
+                        join_total)
+from .base import ExecCtx, TpuExec
+from .basic import bind_all
+
+__all__ = ["TpuShuffledHashJoinExec", "TpuBroadcastHashJoinExec",
+           "TpuCartesianProductExec"]
+
+
+def _join_output_schema(left: dt.Schema, right: dt.Schema,
+                        join_type: str) -> dt.Schema:
+    if join_type in ("left_semi", "left_anti"):
+        return left
+    lf = list(left.fields)
+    rf = list(right.fields)
+    if join_type in ("right_outer", "full_outer"):
+        lf = [dt.StructField(f.name, f.dtype, True) for f in lf]
+    if join_type in ("left_outer", "full_outer"):
+        rf = [dt.StructField(f.name, f.dtype, True) for f in rf]
+    return dt.Schema(lf + rf)
+
+
+class _BaseJoinExec(TpuExec):
+    """Shared staged-join execution over a built right side."""
+
+    def __init__(self, left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression], join_type: str,
+                 left: TpuExec, right: TpuExec,
+                 condition: Optional[Expression] = None):
+        super().__init__()
+        if join_type not in JOIN_TYPES:
+            raise ValueError(f"unknown join type {join_type}")
+        self.children = (left, right)
+        self.join_type = join_type
+        self.left_keys = bind_all(left_keys, left.output_schema)
+        self.right_keys = bind_all(right_keys, right.output_schema)
+        for lk, rk in zip(self.left_keys, self.right_keys):
+            if lk.dtype != rk.dtype:
+                raise TypeError(
+                    f"join key type mismatch: {lk.dtype.simple_string()} "
+                    f"vs {rk.dtype.simple_string()}")
+        self._schema = _join_output_schema(left.output_schema,
+                                           right.output_schema, join_type)
+        # conditions see both sides even when the output is left-only
+        self._cond_schema = dt.Schema(list(left.output_schema.fields)
+                                      + list(right.output_schema.fields))
+        self.condition = bind_expr(condition, self._cond_schema) \
+            if condition is not None else None
+        self._jit_a = None
+        self._jit_b: Dict[int, object] = {}
+        self._jit_c: Dict[tuple, object] = {}
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    def tpu_supported(self):
+        if self.condition is not None and \
+                self.join_type not in ("inner", "cross"):
+            return (f"non-equi condition on {self.join_type} join not yet "
+                    "on device")
+        return None
+
+    def describe(self):
+        c = f" cond={self.condition!r}" if self.condition is not None \
+            else ""
+        return (f"{self.pretty_name()} [{self.join_type}] "
+                f"keys={list(zip(self.left_keys, self.right_keys))}{c}")
+
+    # --- staged device execution -----------------------------------------
+
+    def _cross(self):
+        return self.join_type == "cross" or not self.left_keys
+
+    def _stage_a(self, lbatch: TpuBatch, rbatch: TpuBatch, ectx):
+        lkeys = [k.eval_tpu(lbatch, ectx) for k in self.left_keys]
+        rkeys = [k.eval_tpu(rbatch, ectx) for k in self.right_keys]
+        plan = join_counts(lkeys, rkeys, lbatch.live_mask(),
+                           rbatch.live_mask(), cross=self._cross())
+        return plan, join_total(plan, self.join_type)
+
+    def _stage_b(self, out_cap: int, plan, lbatch: TpuBatch,
+                 rbatch: TpuBatch):
+        lidx, ridx, lvalid, rvalid, total = join_indices(
+            plan, self.join_type, out_cap)
+        semi = self.join_type in ("left_semi", "left_anti")
+        byte_counts = []
+        for c in lbatch.columns:
+            if c.is_string_like:
+                lens = c.offsets[1:] - c.offsets[:-1]
+                byte_counts.append(jnp.sum(lens[lidx]))
+        if not semi:
+            for c in rbatch.columns:
+                if c.is_string_like:
+                    lens = c.offsets[1:] - c.offsets[:-1]
+                    byte_counts.append(jnp.sum(lens[ridx]))
+        stacked = jnp.stack(byte_counts) if byte_counts else \
+            jnp.zeros((0,), jnp.int32)
+        return lidx, ridx, lvalid, rvalid, total, stacked
+
+    def _stage_c(self, char_caps: tuple, lbatch, rbatch, lidx, ridx,
+                 lvalid, rvalid, total):
+        if self.join_type in ("left_semi", "left_anti"):
+            from ..ops.gather import gather_batch
+            return gather_batch(lbatch, lidx, total,
+                                char_capacities=list(char_caps))
+        return join_gather(lbatch, rbatch, lidx, ridx, lvalid, rvalid,
+                           total, self._schema, char_caps)
+
+    def _join_batch(self, lbatch: TpuBatch, rbatch: TpuBatch,
+                    ctx: ExecCtx) -> TpuBatch:
+        if self._jit_a is None:
+            self._jit_a = jax.jit(self._stage_a, static_argnums=2)
+        plan, total_dev = self._jit_a(lbatch, rbatch, ctx.eval_ctx)
+        total = int(jax.device_get(total_dev))
+        out_cap = bucket_rows(total)
+        bfn = self._jit_b.get(out_cap)
+        if bfn is None:
+            bfn = jax.jit(partial(self._stage_b, out_cap))
+            self._jit_b[out_cap] = bfn
+        lidx, ridx, lvalid, rvalid, total_d, bytes_d = bfn(plan, lbatch,
+                                                          rbatch)
+        nbytes = [int(v) for v in jax.device_get(bytes_d)] \
+            if bytes_d.shape[0] else []
+        char_caps = []
+        bi = 0
+        semi = self.join_type in ("left_semi", "left_anti")
+        cols = list(lbatch.columns) + ([] if semi else
+                                       list(rbatch.columns))
+        for c in cols:
+            if c.is_string_like:
+                char_caps.append(bucket_bytes(max(nbytes[bi], 1)))
+                bi += 1
+            else:
+                char_caps.append(0)
+        ckey = (out_cap, tuple(char_caps))
+        cfn = self._jit_c.get(ckey)
+        if cfn is None:
+            cfn = jax.jit(partial(self._stage_c, tuple(char_caps)))
+            self._jit_c[ckey] = cfn
+        out = cfn(lbatch, rbatch, lidx, ridx, lvalid, rvalid, total_d)
+        if self.condition is not None:
+            ectx = ctx.eval_ctx
+            pred = self.condition.eval_tpu(out, ectx)
+            out = compact_batch(out, pred.data & pred.validity)
+        return out
+
+    def _build_right(self, ctx: ExecCtx) -> Optional[TpuBatch]:
+        batches = list(self.right.execute(ctx))
+        if not batches:
+            return None
+        return concat_batches(batches)
+
+    @staticmethod
+    def _empty_batch(schema: dt.Schema) -> TpuBatch:
+        from ..columnar.arrow_bridge import arrow_to_device
+        rb = pa.RecordBatch.from_arrays(
+            [pa.array([], type=dt.to_arrow(f.dtype)) for f in schema],
+            schema=arrow_schema(schema))
+        return arrow_to_device(rb, schema)
+
+    def execute(self, ctx: ExecCtx):
+        op_time = ctx.metric(self, "opTime")
+        t0 = time.perf_counter()
+        rbatch = self._build_right(ctx)
+        if rbatch is None:
+            # nothing can match; for semi/inner/cross/right-outer the
+            # result is empty, for the others every left row is unmatched
+            if self.join_type in ("inner", "cross", "left_semi",
+                                  "right_outer"):
+                return
+            rbatch = self._empty_batch(self.right.output_schema)
+        op_time.value += time.perf_counter() - t0
+        if self.join_type in ("right_outer", "full_outer"):
+            # unmatched-build-rows are emitted once per join call, so the
+            # whole stream side must join in a single call
+            lbatches = list(self.left.execute(ctx))
+            lbatch = concat_batches(lbatches) if lbatches else \
+                self._empty_batch(self.left.output_schema)
+            t0 = time.perf_counter()
+            out = self._join_batch(lbatch, rbatch, ctx)
+            if ctx.sync_metrics:
+                out.block_until_ready()
+            op_time.value += time.perf_counter() - t0
+            yield out
+            return
+        for lbatch in self.left.execute(ctx):
+            t0 = time.perf_counter()
+            out = self._join_batch(lbatch, rbatch, ctx)
+            if ctx.sync_metrics:
+                out.block_until_ready()
+            op_time.value += time.perf_counter() - t0
+            yield out
+
+    # --- CPU oracle -------------------------------------------------------
+
+    def execute_cpu(self, ctx: ExecCtx):
+        lt = [rb for rb in self.left.execute_cpu(ctx)]
+        rt = [rb for rb in self.right.execute_cpu(ctx)]
+        lrows, lkeys = self._cpu_rows(lt, self.left_keys, ctx)
+        rrows, rkeys = self._cpu_rows(rt, self.right_keys, ctx)
+        jt = self.join_type
+        cross = self._cross()
+
+        index: Dict[object, List[int]] = {}
+        for j, key in enumerate(rkeys):
+            if key is None and not cross:
+                continue
+            index.setdefault(key if not cross else 0, []).append(j)
+
+        out: List[tuple] = []
+        matched_right = set()
+        for i, key in enumerate(lkeys):
+            matches = index.get(key if not cross else 0, []) \
+                if (key is not None or cross) else []
+            if jt == "left_semi":
+                if self._any_cond_match(lrows[i], rrows, matches, ctx):
+                    out.append(lrows[i])
+                continue
+            if jt == "left_anti":
+                if not self._any_cond_match(lrows[i], rrows, matches, ctx):
+                    out.append(lrows[i])
+                continue
+            emitted = False
+            for j in matches:
+                row = lrows[i] + rrows[j]
+                if self.condition is not None and \
+                        not self._cond_ok(row, ctx):
+                    continue
+                out.append(row)
+                matched_right.add(j)
+                emitted = True
+            if not emitted and jt in ("left_outer", "full_outer"):
+                out.append(lrows[i] + (None,) * len(self.right.output_schema))
+        if jt in ("right_outer", "full_outer"):
+            nl = len(self.left.output_schema)
+            for j, row in enumerate(rrows):
+                if j not in matched_right:
+                    out.append((None,) * nl + row)
+        yield self._rows_to_batch(out)
+
+    def _cpu_rows(self, rbs, key_exprs, ctx):
+        rows: List[tuple] = []
+        keys: List[object] = []
+        for rb in rbs:
+            cols = [rb.column(i).to_pylist() for i in range(rb.num_columns)]
+            kcols = [k.eval_cpu(rb, ctx.eval_ctx).to_pylist()
+                     for k in key_exprs]
+            for r in range(rb.num_rows):
+                rows.append(tuple(c[r] for c in cols))
+                key = []
+                has_null = False
+                for kc in kcols:
+                    v = kc[r]
+                    if v is None:
+                        has_null = True
+                        break
+                    if isinstance(v, float):
+                        if math.isnan(v):
+                            v = "\x00__NaN__"
+                        elif v == 0.0:
+                            v = 0.0
+                    key.append(v)
+                keys.append(None if has_null else tuple(key))
+        return rows, keys
+
+    def _cond_ok(self, row, ctx) -> bool:
+        arrays = [pa.array([row[i]], type=dt.to_arrow(f.dtype))
+                  for i, f in enumerate(self._cond_schema.fields)]
+        rb = pa.RecordBatch.from_arrays(
+            arrays, schema=arrow_schema(self._cond_schema))
+        res = self.condition.eval_cpu(rb, ctx.eval_ctx).to_pylist()[0]
+        return bool(res)
+
+    def _any_cond_match(self, lrow, rrows, matches, ctx) -> bool:
+        if self.condition is None:
+            return bool(matches)
+        return any(self._cond_ok(lrow + rrows[j], ctx) for j in matches)
+
+    def _rows_to_batch(self, rows: List[tuple]) -> pa.RecordBatch:
+        schema = self._schema  # for semi/anti this is the left schema
+        arrays = []
+        for i, f in enumerate(schema.fields):
+            arrays.append(pa.array([r[i] for r in rows],
+                                   type=dt.to_arrow(f.dtype)))
+        return pa.RecordBatch.from_arrays(arrays,
+                                          schema=arrow_schema(schema))
+
+
+class TpuShuffledHashJoinExec(_BaseJoinExec):
+    """Local equi-join core (both sides materialized on this chip)."""
+
+
+class TpuBroadcastHashJoinExec(_BaseJoinExec):
+    """Same core; the build side is a broadcast table (exchange layer)."""
+
+
+class TpuCartesianProductExec(_BaseJoinExec):
+    def __init__(self, left: TpuExec, right: TpuExec,
+                 condition: Optional[Expression] = None):
+        super().__init__([], [], "cross", left, right, condition)

@@ -203,10 +203,12 @@ class _PerBatchTopN(UnaryExec):
         if self._jitted is None:
             self._jitted = jax.jit(sort_batch_by, static_argnums=(1, 2))
         orders = tuple(self.orders)
+        import jax.numpy as jnp
         for batch in self.child.execute(ctx):
             s = self._jitted(batch, orders, ctx.eval_ctx)
-            if s.num_rows > self.limit:
-                s = s.with_columns(s.columns, row_count=self.limit)
+            # truncate without a device sync: row_count stays traced
+            s = s.with_columns(s.columns, row_count=jnp.minimum(
+                s.row_count, jnp.int32(self.limit)))
             yield s
 
     def execute_cpu(self, ctx: ExecCtx):

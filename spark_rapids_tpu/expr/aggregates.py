@@ -450,7 +450,9 @@ class Last(_FirstLast):
 
 
 class _CentralMoment(AggregateFunction):
-    """stddev/variance via (n, sum, sumsq) buffers; double precision."""
+    """stddev/variance via mergeable (n, mean, M2) buffers — the parallel
+    Welford formulation, exact two-pass within a segment, so no
+    sum-of-squares catastrophic cancellation."""
 
     sample = True
     take_sqrt = False
@@ -465,8 +467,8 @@ class _CentralMoment(AggregateFunction):
     @property
     def buffer_fields(self):
         return [dt.StructField("n", dt.FLOAT64, False),
-                dt.StructField("sum", dt.FLOAT64, False),
-                dt.StructField("sumsq", dt.FLOAT64, False)]
+                dt.StructField("mean", dt.FLOAT64, False),
+                dt.StructField("m2", dt.FLOAT64, False)]
 
     def update_device(self, vals, seg, sorted_live, out_live):
         cap = seg.shape[0]
@@ -474,23 +476,31 @@ class _CentralMoment(AggregateFunction):
         x = jnp.where(valid, data.astype(_F64), 0.0)
         n = _seg_sum(valid.astype(_F64), seg, cap)
         s = _seg_sum(x, seg, cap)
-        ss = _seg_sum(x * x, seg, cap)
+        mean = s / jnp.where(n > 0, n, 1.0)
+        # second pass: exact centered sum of squares per segment
+        d = jnp.where(valid, x - mean[seg], 0.0)
+        m2 = _seg_sum(d * d, seg, cap)
         return [TpuColumnVector(dt.FLOAT64, data=lane, validity=out_live)
-                for lane in (n, s, ss)]
+                for lane in (n, mean, m2)]
 
     def merge_device(self, bufs, seg, sorted_live, out_live):
         cap = seg.shape[0]
-        out = []
-        for b in bufs:
-            data, valid = _masked(b, seg, sorted_live)
-            lane = _seg_sum(jnp.where(valid, data, 0.0), seg, cap)
-            out.append(TpuColumnVector(dt.FLOAT64, data=lane,
-                                       validity=out_live))
-        return out
+        ndata, nvalid = _masked(bufs[0], seg, sorted_live)
+        mdata, _ = _masked(bufs[1], seg, sorted_live)
+        m2data, _ = _masked(bufs[2], seg, sorted_live)
+        n_i = jnp.where(nvalid, ndata, 0.0)
+        mdata = jnp.where(nvalid, mdata, 0.0)  # 0*garbage could be NaN
+        N = _seg_sum(n_i, seg, cap)
+        wsum = _seg_sum(n_i * mdata, seg, cap)
+        MEAN = wsum / jnp.where(N > 0, N, 1.0)
+        delta = mdata - MEAN[seg]
+        M2 = _seg_sum(jnp.where(nvalid, m2data + n_i * delta * delta, 0.0),
+                      seg, cap)
+        return [TpuColumnVector(dt.FLOAT64, data=lane, validity=out_live)
+                for lane in (N, MEAN, M2)]
 
     def evaluate_device(self, bufs):
-        n, s, ss = (b.data for b in bufs)
-        m2 = ss - jnp.where(n > 0, s * s / jnp.where(n > 0, n, 1.0), 0.0)
+        n, _, m2 = (b.data for b in bufs)
         m2 = jnp.maximum(m2, 0.0)
         if self.sample:
             var = jnp.where(n > 1, m2 / jnp.where(n > 1, n - 1, 1.0),

@@ -1,0 +1,247 @@
+"""Equi-join kernels.
+
+TPU replacement for libcudf's hash-join (SURVEY.md §2.2-E; reference mount
+empty), built sort-based as §7.1.3 prescribes: both sides' key columns are
+reduced to shared dense group ids (joint string ranks / orderable int
+lanes over the virtual union), the build side is ordered by group, and
+per-stream-row match ranges come from per-group counts + offsets — no
+device hash table, every step a sort/scan/gather.
+
+SQL semantics: rows with any null key never match (but are emitted by
+outer/anti sides); NaN==NaN and -0.0==0.0 for keys (Spark normalization).
+
+Output sizing is data-dependent, so a join is staged (SURVEY.md §7.3.1):
+  stage A (jit)  — group ids, match counts, total output rows
+  host sync      — choose static output capacity bucket
+  stage B (jit)  — build output row indices + string byte counts
+  host sync      — choose char capacities (string outputs only)
+  stage C (jit)  — gather both sides into the output batch
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import datatypes as dt
+from ..columnar.batch import TpuBatch, row_mask
+from ..columnar.column import TpuColumnVector
+from .sort_keys import (normalize_float_key_col, orderable_int,
+                        string_order_ranks_multi)
+
+__all__ = ["JOIN_TYPES", "union_group_ids", "JoinPlanA", "join_counts",
+           "join_total", "join_indices", "join_gather"]
+
+JOIN_TYPES = ("inner", "left_outer", "right_outer", "full_outer",
+              "left_semi", "left_anti", "cross")
+
+
+_norm_key_col = normalize_float_key_col
+
+
+def union_group_ids(left_keys: Sequence[TpuColumnVector],
+                    right_keys: Sequence[TpuColumnVector],
+                    live_l: jax.Array, live_r: jax.Array):
+    """Dense group ids shared across sides: g_l[i] == g_r[j] iff the key
+    tuples are equal (null==null at this layer; null-key *matching* policy
+    is applied by the caller via the valid-key masks)."""
+    nl, nr = live_l.shape[0], live_r.shape[0]
+    n = nl + nr
+    live = jnp.concatenate([live_l, live_r])
+    lanes: List[jax.Array] = [jnp.where(live, jnp.int8(0), jnp.int8(1))]
+    for lk, rk in zip(left_keys, right_keys):
+        lk, rk = _norm_key_col(lk), _norm_key_col(rk)
+        validity = jnp.concatenate([lk.validity, rk.validity])
+        lanes.append(jnp.where(validity, jnp.int8(1), jnp.int8(0)))
+        if lk.is_string_like:
+            vals = string_order_ranks_multi(
+                [lk, rk], [live_l & lk.validity, live_r & rk.validity])
+        elif lk.data is None:
+            vals = jnp.zeros((n,), jnp.int8)
+        else:
+            v_l = orderable_int(lk)
+            v_r = orderable_int(rk)
+            if v_l.dtype != v_r.dtype:
+                tgt = jnp.promote_types(v_l.dtype, v_r.dtype)
+                v_l, v_r = v_l.astype(tgt), v_r.astype(tgt)
+            vals = jnp.concatenate([v_l, v_r])
+            vals = jnp.where(validity, vals, jnp.zeros_like(vals))
+        lanes.append(vals)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    sorted_all = jax.lax.sort(tuple(lanes) + (idx,),
+                              num_keys=len(lanes) + 1)
+    sorted_lanes, perm = sorted_all[:-1], sorted_all[-1]
+    boundary = jnp.zeros((n,), jnp.bool_).at[0].set(True)
+    for lane in sorted_lanes:
+        boundary = boundary | jnp.concatenate(
+            [jnp.zeros((1,), jnp.bool_), lane[1:] != lane[:-1]])
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    g = jnp.zeros((n,), jnp.int32).at[perm].set(seg)
+    return g[:nl], g[nl:]
+
+
+def _any_null_key(keys: Sequence[TpuColumnVector], cap: int) -> jax.Array:
+    if not keys:
+        return jnp.zeros((cap,), jnp.bool_)
+    bad = ~keys[0].validity
+    for k in keys[1:]:
+        bad = bad | ~k.validity
+    return bad
+
+
+class JoinPlanA:
+    """Results of stage A, a pytree of device arrays + static shapes."""
+
+    def __init__(self, g_l, g_r, matches, starts_g, perm_r, eligible_l,
+                 eligible_r, matched_r, live_l, live_r):
+        self.g_l = g_l
+        self.g_r = g_r
+        self.matches = matches          # per left row, 0 for null-key/dead
+        self.starts_g = starts_g        # per group: start in perm_r order
+        self.perm_r = perm_r            # right rows sorted by (group, idx)
+        self.eligible_l = eligible_l    # live & no null key
+        self.eligible_r = eligible_r
+        self.matched_r = matched_r      # right rows with >=1 left match
+        self.live_l = live_l
+        self.live_r = live_r
+
+    def tree_flatten(self):
+        return ((self.g_l, self.g_r, self.matches, self.starts_g,
+                 self.perm_r, self.eligible_l, self.eligible_r,
+                 self.matched_r, self.live_l, self.live_r), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    JoinPlanA, lambda p: p.tree_flatten(),
+    lambda aux, ch: JoinPlanA.tree_unflatten(aux, ch))
+
+
+def join_counts(left_keys, right_keys, live_l, live_r,
+                cross: bool = False) -> JoinPlanA:
+    """Stage A: shared group ids, right-side ordering, per-left-row match
+    counts. For cross joins every live pair matches."""
+    nl, nr = live_l.shape[0], live_r.shape[0]
+    gcap = nl + nr
+    if cross:
+        g_l = jnp.zeros((nl,), jnp.int32)
+        g_r = jnp.zeros((nr,), jnp.int32)
+        eligible_l, eligible_r = live_l, live_r
+    else:
+        g_l, g_r = union_group_ids(left_keys, right_keys, live_l, live_r)
+        eligible_l = live_l & ~_any_null_key(left_keys, nl)
+        eligible_r = live_r & ~_any_null_key(right_keys, nr)
+    # order right rows by (group, original idx); ineligible go last
+    g_r_sort = jnp.where(eligible_r, g_r, gcap)
+    idx_r = jnp.arange(nr, dtype=jnp.int32)
+    _, perm_r = jax.lax.sort((g_r_sort, idx_r), num_keys=2)
+    counts = jax.ops.segment_sum(eligible_r.astype(jnp.int32),
+                                 jnp.where(eligible_r, g_r, gcap - 1),
+                                 num_segments=gcap)
+    # exclusive prefix: start of each group's run in perm_r order
+    starts_g = jnp.cumsum(counts) - counts
+    matches = jnp.where(eligible_l, counts[g_l], 0)
+    counts_l = jax.ops.segment_sum(eligible_l.astype(jnp.int32),
+                                   jnp.where(eligible_l, g_l, gcap - 1),
+                                   num_segments=gcap)
+    matched_r = eligible_r & (counts_l[g_r] > 0)
+    return JoinPlanA(g_l, g_r, matches, starts_g, perm_r, eligible_l,
+                     eligible_r, matched_r, live_l, live_r)
+
+
+def join_total(plan: JoinPlanA, join_type: str) -> jax.Array:
+    """Total output rows (device scalar) for the given join type."""
+    m = plan.matches
+    if join_type in ("inner", "cross"):
+        return jnp.sum(m)
+    if join_type == "left_outer":
+        return jnp.sum(jnp.where(plan.live_l, jnp.maximum(m, 1), 0))
+    if join_type == "right_outer":
+        unmatched = plan.live_r & ~plan.matched_r
+        return jnp.sum(m) + jnp.sum(unmatched.astype(jnp.int32))
+    if join_type == "full_outer":
+        unmatched = plan.live_r & ~plan.matched_r
+        return jnp.sum(jnp.where(plan.live_l, jnp.maximum(m, 1), 0)) \
+            + jnp.sum(unmatched.astype(jnp.int32))
+    if join_type == "left_semi":
+        return jnp.sum((plan.live_l & (m > 0)).astype(jnp.int32))
+    if join_type == "left_anti":
+        return jnp.sum((plan.live_l & (m == 0)).astype(jnp.int32))
+    raise ValueError(join_type)
+
+
+def join_indices(plan: JoinPlanA, join_type: str, out_cap: int):
+    """Stage B: per-output-row (left_idx, right_idx, left_valid,
+    right_valid) with static out_cap; rows >= total are padding."""
+    nl = plan.live_l.shape[0]
+    nr = plan.live_r.shape[0]
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+
+    if join_type in ("left_semi", "left_anti"):
+        keep = plan.live_l & ((plan.matches > 0) if join_type == "left_semi"
+                              else (plan.matches == 0))
+        from .gather import compaction_indices
+        lidx, count = compaction_indices(keep)
+        lidx = lidx[:out_cap] if out_cap <= nl else jnp.concatenate(
+            [lidx, jnp.zeros((out_cap - nl,), jnp.int32)])
+        live_out = j < count
+        ridx = jnp.zeros((out_cap,), jnp.int32)
+        return lidx, ridx, live_out, jnp.zeros((out_cap,), jnp.bool_), count
+
+    emit = plan.matches
+    if join_type in ("left_outer", "full_outer"):
+        emit = jnp.where(plan.live_l, jnp.maximum(plan.matches, 1), 0)
+    # exclusive cumsum of per-left-row output counts
+    out_start = jnp.cumsum(emit) - emit
+    pairs_total = jnp.sum(emit)
+    # map output row -> left row: last i with out_start[i] <= j, restricted
+    # to emitting rows (emit>0). searchsorted over the cumsum works because
+    # non-emitting rows collapse to zero-width intervals.
+    ends = out_start + emit  # exclusive end per left row
+    lidx = jnp.searchsorted(ends, j, side="right").astype(jnp.int32)
+    lidx = jnp.clip(lidx, 0, nl - 1)
+    k = j - out_start[lidx]
+    is_pair = k < plan.matches[lidx]
+    g = plan.g_l[lidx]
+    rpos = jnp.clip(plan.starts_g[g] + k, 0, nr - 1)
+    ridx = plan.perm_r[rpos]
+    left_valid = j < pairs_total
+    right_valid = left_valid & is_pair
+
+    total = pairs_total
+    if join_type in ("right_outer", "full_outer"):
+        unmatched = plan.live_r & ~plan.matched_r
+        from .gather import compaction_indices
+        uidx, ucount = compaction_indices(unmatched)
+        total = pairs_total + ucount
+        in_extra = (j >= pairs_total) & (j < total)
+        epos = jnp.clip(j - pairs_total, 0, nr - 1)
+        extra_r = uidx[jnp.clip(epos, 0, uidx.shape[0] - 1)]
+        ridx = jnp.where(in_extra, extra_r, ridx)
+        right_valid = right_valid | in_extra
+        left_valid = left_valid & (j < pairs_total)
+    live_out = j < total
+    return lidx, ridx, left_valid & live_out, right_valid & live_out, total
+
+
+def join_gather(left: TpuBatch, right: TpuBatch, lidx, ridx, lvalid,
+                rvalid, total, out_schema,
+                char_caps: Sequence[int]) -> TpuBatch:
+    """Stage C: gather both sides into the output batch. lvalid/rvalid
+    mask whole sides (outer-join nulls)."""
+    from .gather import gather_column
+    cols = []
+    ci = 0
+    for c in left.columns:
+        cols.append(gather_column(c, lidx, lvalid, char_caps[ci]
+                                  if c.is_string_like else None))
+        ci += 1
+    for c in right.columns:
+        cols.append(gather_column(c, ridx, rvalid, char_caps[ci]
+                                  if c.is_string_like else None))
+        ci += 1
+    return TpuBatch(cols, out_schema, total)

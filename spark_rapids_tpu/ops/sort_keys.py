@@ -20,7 +20,8 @@ from .. import datatypes as dt
 from ..columnar.column import TpuColumnVector
 from .strings import gather_window
 
-__all__ = ["SortSpec", "orderable_int", "string_order_ranks",
+__all__ = ["SortSpec", "orderable_int", "canonicalize_floats",
+           "string_order_ranks", "string_order_ranks_multi",
            "sort_permutation", "segment_ids_for_keys"]
 
 _RANK_WINDOW = 7  # bytes per refinement pass: 7 x 9 bits = 63 bits / int64
@@ -40,6 +41,16 @@ def canonicalize_floats(d: jax.Array) -> jax.Array:
     and min/max)."""
     d = jnp.where(d == 0, jnp.zeros_like(d), d)
     return jnp.where(jnp.isnan(d), jnp.full_like(d, jnp.nan), d)
+
+
+def normalize_float_key_col(col: TpuColumnVector) -> TpuColumnVector:
+    """Column-level float key normalization (Spark's
+    NormalizeFloatingNumbers): shared by group-by keys, join keys and any
+    other place key *values* are emitted, not just compared."""
+    from .. import datatypes as _dt
+    if not _dt.is_floating(col.dtype):
+        return col
+    return col.with_arrays(data=canonicalize_floats(col.data))
 
 
 def orderable_int(col: TpuColumnVector) -> jax.Array:
@@ -64,19 +75,23 @@ def orderable_int(col: TpuColumnVector) -> jax.Array:
     return d
 
 
-def string_order_ranks(col: TpuColumnVector, live: jax.Array) -> jax.Array:
-    """Dense order ranks for a string column: rank[i] < rank[j] iff
-    bytes(i) < bytes(j) lexicographically (unsigned); equal strings share a
-    rank. Non-live rows get INT32_MAX so they sort last.
+def string_order_ranks_multi(cols: Sequence[TpuColumnVector],
+                             lives: Sequence[jax.Array]) -> jax.Array:
+    """Dense order ranks over the virtual concatenation of several string
+    columns: rank[i] < rank[j] iff bytes(i) < bytes(j) lexicographically
+    (unsigned); equal strings share a rank — also across columns, which is
+    what makes this the join-key equality kernel. Non-live rows get
+    INT32_MAX so they sort last. Returns one rank vector of length
+    sum(capacities) in column order.
 
     Iterative refinement: stable-sort by (current-rank, next-7-byte window)
     and split ties; loops until the longest string is consumed or all ranks
     are distinct (dynamic trip count, static shapes per pass —
     SURVEY.md §7.3.1).
     """
-    offsets, chars = col.offsets, col.chars
-    n = offsets.shape[0] - 1
-    lens = offsets[1:] - offsets[:-1]
+    live = jnp.concatenate([jnp.asarray(lv) for lv in lives])
+    n = live.shape[0]
+    lens = jnp.concatenate([c.offsets[1:] - c.offsets[:-1] for c in cols])
     live_lens = jnp.where(live, lens, 0)
     max_len = jnp.max(live_lens, initial=0)
     num_live = jnp.sum(live.astype(jnp.int32))
@@ -85,8 +100,12 @@ def string_order_ranks(col: TpuColumnVector, live: jax.Array) -> jax.Array:
     def window_key(chunk):
         # pack 7 bytes into one int64, 9 bits each: past-end (-1) -> 0,
         # real bytes -> 1..256, so shorter strings sort first.
-        w = gather_window(offsets, chars, chunk, window=_RANK_WINDOW)
-        w = (w + 1).astype(jnp.int64)
+        parts = []
+        for c in cols:
+            w = gather_window(c.offsets, c.chars, chunk,
+                              window=_RANK_WINDOW)
+            parts.append((w + 1).astype(jnp.int64))
+        w = jnp.concatenate(parts)
         key = jnp.zeros((n,), jnp.int64)
         for b in range(_RANK_WINDOW):
             key = (key << 9) | w[:, b]
@@ -114,6 +133,11 @@ def string_order_ranks(col: TpuColumnVector, live: jax.Array) -> jax.Array:
     _, rank, _ = jax.lax.while_loop(
         cond, body, (jnp.int32(0), rank0, jnp.int32(0)))
     return jnp.where(live, rank, jnp.int32(2**31 - 1))
+
+
+def string_order_ranks(col: TpuColumnVector, live: jax.Array) -> jax.Array:
+    """Single-column case of string_order_ranks_multi."""
+    return string_order_ranks_multi([col], [live])
 
 
 def _key_lanes(key_cols: Sequence[TpuColumnVector],

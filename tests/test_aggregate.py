@@ -202,3 +202,16 @@ def test_groupby_computed_key_with_nulls():
         [Alias(Add(col("c0"), col("c1")), "k")],
         [Alias(Count(), "c")])
     assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+def test_stddev_large_mean_no_cancellation():
+    # Regression: sum/sumsq formulation catastrophically cancels when the
+    # mean is large relative to the spread; Welford (n, mean, M2) must not.
+    vals = [1e9, 1e9 + 1, 1e9 + 2, 1e9 + 3] * 3
+    rb = pa.record_batch({"k": pa.array([0, 0, 0, 1, 1, 1] * 2, pa.int32()),
+                          "v": pa.array(vals, pa.float64())})
+    plan = agg_plan(HostBatchSourceExec([rb]), [col("k")],
+                    [Alias(VarianceSamp(col("v")), "vs"),
+                     Alias(StddevSamp(col("v")), "ss")])
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True,
+                                  approx_float=True)

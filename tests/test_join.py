@@ -1,0 +1,180 @@
+"""Join tests via the dual-run harness (reference: join_test.py —
+SURVEY.md §4.1)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import datatypes as dt
+from spark_rapids_tpu.exec import HostBatchSourceExec
+from spark_rapids_tpu.exec.joins import (TpuCartesianProductExec,
+                                         TpuShuffledHashJoinExec)
+from spark_rapids_tpu.expr import (GreaterThan, Literal,
+                                   UnresolvedColumn as col)
+
+from asserts import assert_tpu_and_cpu_plan_equal
+from data_gen import (BooleanGen, DateGen, DecimalGen, DoubleGen, FloatGen,
+                      IntegerGen, LongGen, StringGen, TimestampGen,
+                      gen_table)
+
+ALL_TYPES = ["inner", "left_outer", "right_outer", "full_outer",
+             "left_semi", "left_anti"]
+
+
+def two_sources(key_gen_l, key_gen_r, nl=150, nr=120, seeds=(11, 22)):
+    left = HostBatchSourceExec(
+        [gen_table([key_gen_l, LongGen(nullable=False)], nl, seeds[0],
+                   names=["lk", "lv"])])
+    right = HostBatchSourceExec(
+        [gen_table([key_gen_r, LongGen(nullable=False)], nr, seeds[1],
+                   names=["rk", "rv"])])
+    return left, right
+
+
+def join_plan(jt, key_gen, **kw):
+    left, right = two_sources(key_gen, key_gen, **kw)
+    return TpuShuffledHashJoinExec([col("lk")], [col("rk")], jt, left,
+                                   right)
+
+
+@pytest.mark.parametrize("jt", ALL_TYPES)
+def test_join_int_keys(jt):
+    plan = join_plan(jt, IntegerGen(min_val=0, max_val=40))
+    assert_tpu_and_cpu_plan_equal(plan, label=jt)
+
+
+@pytest.mark.parametrize("jt", ALL_TYPES)
+def test_join_null_keys(jt):
+    # null keys never match; outer/anti sides still emit them
+    plan = join_plan(jt, IntegerGen(min_val=0, max_val=10, null_frac=0.3))
+    assert_tpu_and_cpu_plan_equal(plan, label=jt)
+
+
+@pytest.mark.parametrize("jt", ALL_TYPES)
+def test_join_string_keys(jt):
+    plan = join_plan(jt, StringGen(max_len=4, charset="abc",
+                                   null_frac=0.2))
+    assert_tpu_and_cpu_plan_equal(plan, label=jt)
+
+
+@pytest.mark.parametrize("kg", [LongGen(), DateGen(), TimestampGen(),
+                                BooleanGen(), DecimalGen(precision=5),
+                                DoubleGen(null_frac=0.2)],
+                         ids=lambda g: g.dtype.simple_string())
+def test_join_key_types_inner(kg):
+    plan = join_plan("inner", kg)
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_join_float_key_specials():
+    # NaN==NaN and -0.0==0.0 for join keys
+    left = HostBatchSourceExec([pa.record_batch(
+        {"lk": pa.array([float("nan"), 0.0, -0.0, 1.5, None]),
+         "lv": pa.array([1, 2, 3, 4, 5], pa.int64())})])
+    right = HostBatchSourceExec([pa.record_batch(
+        {"rk": pa.array([float("nan"), -0.0, 2.5, None]),
+         "rv": pa.array([10, 20, 30, 40], pa.int64())})])
+    for jt in ALL_TYPES:
+        plan = TpuShuffledHashJoinExec([col("lk")], [col("rk")], jt,
+                                       left, right)
+        assert_tpu_and_cpu_plan_equal(plan, label=jt)
+
+
+def test_join_multi_key():
+    gens = [IntegerGen(min_val=0, max_val=5), StringGen(max_len=2,
+                                                        charset="xy")]
+    left = HostBatchSourceExec(
+        [gen_table(gens + [LongGen(nullable=False)], 100, 1,
+                   names=["k1", "k2", "lv"])])
+    right = HostBatchSourceExec(
+        [gen_table(gens + [LongGen(nullable=False)], 80, 2,
+                   names=["k1", "k2", "rv"])])
+    for jt in ("inner", "left_outer", "left_anti"):
+        plan = TpuShuffledHashJoinExec(
+            [col("k1"), col("k2")], [col("k1"), col("k2")], jt, left,
+            right)
+        assert_tpu_and_cpu_plan_equal(plan, label=jt)
+
+
+def test_join_empty_sides():
+    empty = HostBatchSourceExec([pa.record_batch(
+        {"rk": pa.array([], pa.int32()), "rv": pa.array([], pa.int64())})])
+    left = HostBatchSourceExec(
+        [gen_table([IntegerGen(), LongGen(nullable=False)], 50, 3,
+                   names=["lk", "lv"])])
+    for jt in ALL_TYPES:
+        plan = TpuShuffledHashJoinExec([col("lk")], [col("rk")], jt, left,
+                                       empty)
+        assert_tpu_and_cpu_plan_equal(plan, label=f"{jt} empty right")
+    empty_l = HostBatchSourceExec([pa.record_batch(
+        {"lk": pa.array([], pa.int32()), "lv": pa.array([], pa.int64())})])
+    right = HostBatchSourceExec(
+        [gen_table([IntegerGen(), LongGen(nullable=False)], 50, 4,
+                   names=["rk", "rv"])])
+    for jt in ALL_TYPES:
+        plan = TpuShuffledHashJoinExec([col("lk")], [col("rk")], jt,
+                                       empty_l, right)
+        assert_tpu_and_cpu_plan_equal(plan, label=f"{jt} empty left")
+
+
+def test_join_multi_batch_stream():
+    rbs = [gen_table([IntegerGen(min_val=0, max_val=20),
+                      LongGen(nullable=False)], n, seed=s,
+                     names=["lk", "lv"]) for n, s in [(60, 1), (90, 2)]]
+    left = HostBatchSourceExec(rbs)
+    right = HostBatchSourceExec(
+        [gen_table([IntegerGen(min_val=0, max_val=20),
+                    LongGen(nullable=False)], 70, 9,
+                   names=["rk", "rv"])])
+    for jt in ("inner", "left_outer", "full_outer", "left_semi"):
+        plan = TpuShuffledHashJoinExec([col("lk")], [col("rk")], jt, left,
+                                       right)
+        assert_tpu_and_cpu_plan_equal(plan, label=jt)
+
+
+def test_join_duplicate_heavy_keys():
+    plan = join_plan("inner", IntegerGen(min_val=0, max_val=3), nl=100,
+                     nr=100)
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_inner_join_with_condition():
+    left, right = two_sources(IntegerGen(min_val=0, max_val=10),
+                              IntegerGen(min_val=0, max_val=10))
+    plan = TpuShuffledHashJoinExec(
+        [col("lk")], [col("rk")], "inner", left, right,
+        condition=GreaterThan(col("lv"), col("rv")))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_cartesian_product():
+    left = HostBatchSourceExec(
+        [gen_table([IntegerGen(), LongGen(nullable=False)], 30, 1,
+                   names=["a", "b"])])
+    right = HostBatchSourceExec(
+        [gen_table([StringGen(max_len=3), LongGen(nullable=False)], 20, 2,
+                   names=["c", "d"])])
+    plan = TpuCartesianProductExec(left, right)
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_cartesian_with_condition():
+    left = HostBatchSourceExec(
+        [gen_table([LongGen(nullable=False)], 25, 1, names=["a"])])
+    right = HostBatchSourceExec(
+        [gen_table([LongGen(nullable=False)], 25, 2, names=["b"])])
+    plan = TpuCartesianProductExec(
+        left, right, condition=GreaterThan(col("a"), col("b")))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_join_strings_payload():
+    # string payload columns exercise gather char sizing on both sides
+    left = HostBatchSourceExec(
+        [gen_table([IntegerGen(min_val=0, max_val=8), StringGen()],
+                   60, 5, names=["lk", "ls"])])
+    right = HostBatchSourceExec(
+        [gen_table([IntegerGen(min_val=0, max_val=8), StringGen()],
+                   50, 6, names=["rk", "rs"])])
+    for jt in ("inner", "left_outer", "full_outer"):
+        plan = TpuShuffledHashJoinExec([col("lk")], [col("rk")], jt, left,
+                                       right)
+        assert_tpu_and_cpu_plan_equal(plan, label=jt)
