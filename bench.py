@@ -1,0 +1,131 @@
+"""Driver benchmark: TPC-H q6 shape at SF1 through the engine's physical
+operator pipeline on the real chip (BASELINE config 1 — SURVEY.md §6).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline compares against the same query executed by the numpy/pyarrow
+host path on this machine (the stand-in for CPU Spark until a cluster
+baseline is measured — SURVEY.md §6 action note).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+SF_ROWS = 6_001_215  # lineitem rows at SF1
+
+
+def gen_lineitem(n):
+    rng = np.random.default_rng(0)
+    return {
+        "l_quantity": rng.uniform(1, 50, n).astype(np.float32),
+        "l_extendedprice": rng.uniform(900, 105000, n).astype(np.float32),
+        "l_discount": (rng.integers(0, 11, n) / 100.0).astype(np.float32),
+        "l_shipdate": rng.integers(8000, 10600, n).astype(np.int32),
+    }
+
+
+def numpy_q6(cols):
+    t0 = time.perf_counter()
+    mask = ((cols["l_shipdate"] >= 8766) & (cols["l_shipdate"] < 9131)
+            & (cols["l_discount"] >= 0.05) & (cols["l_discount"] <= 0.07)
+            & (cols["l_quantity"] < 24.0))
+    revenue = float((cols["l_extendedprice"][mask]
+                     * cols["l_discount"][mask]).sum())
+    return revenue, time.perf_counter() - t0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import spark_rapids_tpu  # noqa: F401
+    from spark_rapids_tpu import datatypes as dt
+    from spark_rapids_tpu.columnar.batch import TpuBatch, bucket_rows
+    from spark_rapids_tpu.columnar.column import TpuColumnVector
+    from spark_rapids_tpu.exec.base import DeviceBatchSourceExec, ExecCtx, \
+        collect_arrow
+    from spark_rapids_tpu.exec.basic import TpuFilterExec, TpuProjectExec
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.expr import (Alias, And, GreaterThanOrEqual,
+                                       LessThan, LessThanOrEqual, Literal,
+                                       Multiply, UnresolvedColumn as col)
+    from spark_rapids_tpu.expr.aggregates import Sum
+
+    n = SF_ROWS
+    cols = gen_lineitem(n)
+
+    # host numpy baseline (median of 3)
+    host_times = []
+    for _ in range(3):
+        rev_host, t = numpy_q6(cols)
+        host_times.append(t)
+    host_t = sorted(host_times)[1]
+
+    # engine pipeline over device-resident batches
+    schema = dt.Schema([
+        dt.StructField("l_quantity", dt.FLOAT32, False),
+        dt.StructField("l_extendedprice", dt.FLOAT32, False),
+        dt.StructField("l_discount", dt.FLOAT32, False),
+        dt.StructField("l_shipdate", dt.DATE, False),
+    ])
+    batch_rows = 1 << 21
+    batches = []
+    for off in range(0, n, batch_rows):
+        m = min(batch_rows, n - off)
+        cap = bucket_rows(m)
+        cs = []
+        for name, t in [("l_quantity", dt.FLOAT32),
+                        ("l_extendedprice", dt.FLOAT32),
+                        ("l_discount", dt.FLOAT32),
+                        ("l_shipdate", dt.DATE)]:
+            cs.append(TpuColumnVector.from_numpy(
+                t, cols[name][off:off + m], None, cap))
+        batches.append(TpuBatch(cs, schema, m))
+
+    def build_plan():
+        src = DeviceBatchSourceExec(batches, schema)
+        d = lambda v: Literal(np.float32(v), dt.FLOAT32)
+        cond = And(
+            And(GreaterThanOrEqual(col("l_shipdate"),
+                                   Literal(8766, dt.DATE)),
+                LessThan(col("l_shipdate"), Literal(9131, dt.DATE))),
+            And(And(GreaterThanOrEqual(col("l_discount"), d(0.05)),
+                    LessThanOrEqual(col("l_discount"), d(0.07))),
+                LessThan(col("l_quantity"), d(24.0))))
+        filt = TpuFilterExec(cond, src)
+        proj = TpuProjectExec(
+            [Alias(Multiply(col("l_extendedprice"), col("l_discount")),
+                   "rev")], filt)
+        return TpuHashAggregateExec([], [Alias(Sum(col("rev")), "revenue")],
+                                    proj)
+
+    plan = build_plan()  # one plan: per-operator jit caches are reused
+    rev_tpu = collect_arrow(plan).column(0)[0].as_py()  # warm-up compile
+
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = collect_arrow(plan)
+        times.append(time.perf_counter() - t0)
+    tpu_t = sorted(times)[len(times) // 2]
+
+    rel_err = abs(rev_tpu - rev_host) / max(1.0, abs(rev_host))
+    assert rel_err < 1e-2, (rev_tpu, rev_host)
+
+    rows_per_sec = n / tpu_t
+    print(json.dumps({
+        "metric": "tpch_q6_sf1_rows_per_sec",
+        "value": round(rows_per_sec / 1e6, 2),
+        "unit": "Mrows/s",
+        "vs_baseline": round(host_t / tpu_t, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -130,18 +130,22 @@ def _null_buffer(valid: np.ndarray):
     return pa.array(valid).buffers()[1]
 
 
-def device_column_to_arrow(col: TpuColumnVector, n: int) -> pa.Array:
-    """Download one device column (first n rows) as an Arrow array."""
-    import jax
+def _host_column_to_arrow(col: TpuColumnVector, host, n: int) -> pa.Array:
+    """Build an Arrow array from prefetched host buffers. `host` maps the
+    column's device arrays (by position in col.arrays()) to numpy."""
     t = col.dtype
     atype = dt.to_arrow(t)
-    valid = np.asarray(jax.device_get(col.validity))[:n]
+    bufs = list(host)
+    data = bufs.pop(0) if col.data is not None else None
+    valid = np.asarray(bufs.pop(0))[:n]
+    offsets_h = np.asarray(bufs.pop(0)) if col.offsets is not None else None
+    chars_h = np.asarray(bufs.pop(0)) if col.chars is not None else None
     mask = None if bool(valid.all()) else ~valid
     if isinstance(t, dt.NullType):
         return pa.nulls(n)
     if col.is_string_like:
-        offsets = np.asarray(jax.device_get(col.offsets))[: n + 1]
-        chars = np.asarray(jax.device_get(col.chars))
+        offsets = offsets_h[: n + 1]
+        chars = chars_h
         end = int(offsets[-1]) if n else 0
         # Rebuild via Arrow buffers (zero-copy from the host numpy views).
         if offsets[0] != 0:
@@ -153,7 +157,7 @@ def device_column_to_arrow(col: TpuColumnVector, n: int) -> pa.Array:
              pa.py_buffer(np.ascontiguousarray(chars[:end]))],
             null_count=-1)
         return arr
-    values = np.asarray(jax.device_get(col.data))[:n]
+    values = np.asarray(data)[:n]
     if isinstance(t, dt.DecimalType):
         lo = values.astype(np.int64)
         hi = (lo >> 63).astype(np.int64)  # sign extension
@@ -171,7 +175,30 @@ def device_column_to_arrow(col: TpuColumnVector, n: int) -> pa.Array:
     return pa.array(values, atype, mask=mask)
 
 
+def device_column_to_arrow(col: TpuColumnVector, n: int) -> pa.Array:
+    """Download one device column (first n rows) as an Arrow array."""
+    import jax
+    return _host_column_to_arrow(col, jax.device_get(col.arrays()), n)
+
+
 def device_to_arrow(batch: TpuBatch) -> pa.RecordBatch:
-    n = batch.num_rows
-    arrays = [device_column_to_arrow(c, n) for c in batch.columns]
-    return pa.RecordBatch.from_arrays(arrays, schema=arrow_schema(batch.schema))
+    """Download a batch in ONE device->host transfer: per-RPC latency on
+    a tunneled device dwarfs the extra padding bytes, so every buffer
+    (plus the row count) rides a single device_get."""
+    import jax
+    leaves = [batch.row_count]
+    spans = []
+    for c in batch.columns:
+        arrs = c.arrays()
+        spans.append(len(arrs))
+        leaves.extend(arrs)
+    host = jax.device_get(leaves)
+    n = int(host[0])
+    batch._num_rows_cache = n
+    arrays = []
+    off = 1
+    for c, k in zip(batch.columns, spans):
+        arrays.append(_host_column_to_arrow(c, host[off:off + k], n))
+        off += k
+    return pa.RecordBatch.from_arrays(arrays,
+                                      schema=arrow_schema(batch.schema))

@@ -55,7 +55,9 @@ class TpuBatch:
         self.schema = schema
         if isinstance(row_count, (int, np.integer)):
             self._num_rows_cache = int(row_count)
-            row_count = jnp.int32(row_count)
+            # np scalar, NOT jnp: an eager device op here costs a full
+            # host->device dispatch round-trip per batch construction
+            row_count = np.int32(row_count)
         else:
             self._num_rows_cache = None
         self.row_count = row_count

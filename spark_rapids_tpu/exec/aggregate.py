@@ -37,10 +37,13 @@ from ..ops.sort_keys import normalize_float_key_col as _normalize_float_keys
 
 
 def _segment_starts(seg: jax.Array) -> jax.Array:
-    cap = seg.shape[0]
-    pos = jnp.arange(cap, dtype=jnp.int32)
-    starts = jnp.full((cap,), cap - 1, jnp.int32).at[seg].min(
-        pos, mode="drop")
+    """starts[g] = first sorted position of segment g. seg is sorted, so
+    group starts are the boundary positions, and the g-th boundary is a
+    stream compaction — sort-based, no scatter (slow on TPU)."""
+    from ..ops.gather import compaction_indices
+    boundary = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                                seg[1:] != seg[:-1]])
+    starts, _ = compaction_indices(boundary)
     return starts
 
 
@@ -115,14 +118,24 @@ class TpuHashAggregateExec(UnaryExec):
         cap = live.shape[0]
         if key_cols:
             perm, seg, num_groups = segment_ids_for_keys(key_cols, live)
+            sorted_live = live[perm]
+            skeys = [gather_column(c, perm, sorted_live) for c in key_cols]
+            sextras = [[gather_column(c, perm, sorted_live) for c in cols]
+                       for cols in extra_cols]
         else:
-            perm, seg, num_groups0 = segment_ids_for_keys([], live)
-            num_groups = jnp.maximum(num_groups0, 1)  # global agg: 1 group
-        sorted_live = live[perm]
+            # global aggregate: one segment; seg=None selects the
+            # plain-reduction path in the agg functions (segment_* is a
+            # scatter-add, ~100ms per 2M rows on TPU) with GLOBAL_LANES
+            # output lanes
+            from ..expr.aggregates import GLOBAL_LANES
+            seg = None
+            num_groups = jnp.int32(1)
+            sorted_live = live
+            skeys = []
+            sextras = extra_cols
+            out_live = row_mask(GLOBAL_LANES, num_groups)
+            return skeys, sextras, seg, sorted_live, num_groups, out_live
         out_live = row_mask(cap, num_groups)
-        skeys = [gather_column(c, perm, sorted_live) for c in key_cols]
-        sextras = [[gather_column(c, perm, sorted_live) for c in cols]
-                   for cols in extra_cols]
         return skeys, sextras, seg, sorted_live, num_groups, out_live
 
     def _partial(self, batch: TpuBatch, ectx) -> TpuBatch:
@@ -133,8 +146,10 @@ class TpuHashAggregateExec(UnaryExec):
                     for a in self.aggs]
         skeys, svals, seg, sorted_live, ng, out_live = \
             self._group_and_gather(key_cols, val_cols, live)
-        starts = _segment_starts(seg)
-        out_cols = [gather_column(k, starts, out_live) for k in skeys]
+        out_cols = []
+        if skeys:
+            starts = _segment_starts(seg)
+            out_cols = [gather_column(k, starts, out_live) for k in skeys]
         for a, sv in zip(self.aggs, svals):
             out_cols.extend(a.update_device(sv, seg, sorted_live, out_live))
         return TpuBatch(out_cols, self._partial_schema, ng)
@@ -147,8 +162,10 @@ class TpuHashAggregateExec(UnaryExec):
                     for lo, hi in self._buf_slices]
         skeys, sbufs, seg, sorted_live, ng, out_live = \
             self._group_and_gather(key_cols, buf_cols, live)
-        starts = _segment_starts(seg)
-        out_cols = [gather_column(k, starts, out_live) for k in skeys]
+        out_cols = []
+        if skeys:
+            starts = _segment_starts(seg)
+            out_cols = [gather_column(k, starts, out_live) for k in skeys]
         for a, sb in zip(self.aggs, sbufs):
             merged = a.merge_device(sb, seg, sorted_live, out_live)
             out_cols.append(a.evaluate_device(merged))
@@ -178,7 +195,11 @@ class TpuHashAggregateExec(UnaryExec):
                 return
             partials = [self._jit_partial(self._empty_child_batch(),
                                           ctx.eval_ctx)]
-        merged = concat_batches(partials)
+        if not self.group_exprs:
+            from ..ops.concat import concat_batches_bounded
+            merged = concat_batches_bounded(partials)
+        else:
+            merged = concat_batches(partials)
         out = self._jit_final(merged, ctx.eval_ctx)
         if ctx.sync_metrics:
             out.block_until_ready()

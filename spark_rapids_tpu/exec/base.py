@@ -186,6 +186,27 @@ class HostBatchSourceExec(LeafExec):
         yield from self._normalized()
 
 
+class DeviceBatchSourceExec(LeafExec):
+    """Leaf over already-resident device batches (bench/internal use)."""
+
+    def __init__(self, batches: Sequence[TpuBatch], schema: dt.Schema):
+        super().__init__()
+        self.batches = list(batches)
+        self._schema = schema
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    def execute(self, ctx):
+        yield from self.batches
+
+    def execute_cpu(self, ctx):
+        from ..columnar.arrow_bridge import device_to_arrow
+        for b in self.batches:
+            yield device_to_arrow(b)
+
+
 def collect_arrow(plan: TpuExec, ctx: Optional[ExecCtx] = None) -> pa.Table:
     """Run the TPU path and download results as one Arrow table."""
     ctx = ctx or ExecCtx()

@@ -1,0 +1,169 @@
+"""Exchange operators: shuffle, broadcast, coalesce.
+
+TPU analog of the reference's `GpuShuffleExchangeExecBase`,
+`GpuBroadcastExchangeExec`, `GpuCoalesceBatches`, `GpuShuffleCoalesceExec`
+(SURVEY.md §2.2-A/B/D; reference mount empty). The single-process engine
+uses the LocalShuffleTransport seam; partition split is per-partition
+stream compaction (the contiguous_split analog). The ICI SPMD all-to-all
+path plugs in behind the same seam (shuffle/ici.py).
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Dict, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import datatypes as dt
+from ..columnar.batch import TpuBatch
+from ..ops.concat import concat_batches
+from ..ops.gather import compact_batch
+from ..shuffle.partitioner import Partitioning, SinglePartitioning
+from ..shuffle.transport import LocalShuffleTransport, ShuffleTransport
+from .base import ExecCtx, TpuExec, UnaryExec
+
+__all__ = ["TpuShuffleExchangeExec", "TpuBroadcastExchangeExec",
+           "TpuCoalesceBatchesExec"]
+
+_shuffle_ids = itertools.count()
+
+
+class TpuShuffleExchangeExec(UnaryExec):
+    """Repartition child output by a Partitioning strategy. Output batches
+    arrive partition-major (partition 0's batches first), map-order within
+    a partition — deterministic for the dual-run harness."""
+
+    def __init__(self, partitioning: Partitioning, child: TpuExec,
+                 transport: Optional[ShuffleTransport] = None):
+        super().__init__(child)
+        self.partitioning = partitioning.bind(child.output_schema)
+        self.transport = transport or LocalShuffleTransport()
+        self._jit_split = None
+
+    def describe(self):
+        return (f"ShuffleExchangeExec [{type(self.partitioning).__name__} "
+                f"n={self.partitioning.num_partitions}]")
+
+    def _split(self, batch: TpuBatch, part: int, ectx) -> TpuBatch:
+        pids = self.partitioning.partition_ids_device(batch, ectx)
+        return compact_batch(batch, pids == part)
+
+    def execute(self, ctx: ExecCtx):
+        if self._jit_split is None:
+            self._jit_split = jax.jit(self._split,
+                                      static_argnums=(1, 2))
+        n = self.partitioning.num_partitions
+        sid = next(_shuffle_ids)
+        self.transport.register_shuffle(sid, n)
+        op_time = ctx.metric(self, "opTime")
+        rows = ctx.metric(self, "numPartitions")
+        rows.set(n)
+        for map_id, batch in enumerate(self.child.execute(ctx)):
+            writer = self.transport.writer(sid, map_id)
+            t0 = time.perf_counter()
+            if n == 1:
+                writer.write(0, batch)
+            else:
+                for p in range(n):
+                    writer.write(p, self._jit_split(batch, p, ctx.eval_ctx))
+            op_time.value += time.perf_counter() - t0
+            writer.close()
+        try:
+            for p in range(n):
+                yield from self.transport.read_partition(sid, p)
+        finally:
+            self.transport.unregister_shuffle(sid)
+
+    def execute_cpu(self, ctx: ExecCtx):
+        n = self.partitioning.num_partitions
+        parts: Dict[int, List[pa.RecordBatch]] = {p: [] for p in range(n)}
+        for rb in self.child.execute_cpu(ctx):
+            pids = self.partitioning.partition_ids_cpu(rb, ctx.eval_ctx)
+            for p in range(n):
+                idx = np.nonzero(pids == p)[0]
+                if n == 1:
+                    parts[p].append(rb)
+                elif len(idx):
+                    parts[p].append(rb.take(pa.array(idx, pa.int64())))
+        for p in range(n):
+            yield from parts[p]
+
+
+class TpuBroadcastExchangeExec(UnaryExec):
+    """Materialize the child once as a single device batch (the build-side
+    table). Single-process: concat; multi-chip: replicate over ICI."""
+
+    def __init__(self, child: TpuExec):
+        super().__init__(child)
+        self._cached: Optional[TpuBatch] = None
+
+    def execute(self, ctx: ExecCtx):
+        if self._cached is None:
+            batches = list(self.child.execute(ctx))
+            if not batches:
+                return
+            self._cached = concat_batches(batches)
+        yield self._cached
+
+    def execute_cpu(self, ctx: ExecCtx):
+        rbs = list(self.child.execute_cpu(ctx))
+        if not rbs:
+            return
+        t = pa.Table.from_batches(rbs).combine_chunks()
+        yield from t.to_batches()
+
+
+class TpuCoalesceBatchesExec(UnaryExec):
+    """Concatenate small batches up to a target row count
+    (GpuCoalesceBatches analog; target bytes logic arrives with the
+    memory manager)."""
+
+    def __init__(self, child: TpuExec, target_rows: int = 1 << 17):
+        super().__init__(child)
+        self.target_rows = target_rows
+
+    def describe(self):
+        return f"CoalesceBatchesExec [target={self.target_rows}]"
+
+    def execute(self, ctx: ExecCtx):
+        pending: List[TpuBatch] = []
+        pending_rows = 0
+        concat_time = ctx.metric(self, "concatTime")
+        for batch in self.child.execute(ctx):
+            n = batch.num_rows
+            if n == 0:
+                continue
+            if pending_rows + n > self.target_rows and pending:
+                t0 = time.perf_counter()
+                yield concat_batches(pending)
+                concat_time.value += time.perf_counter() - t0
+                pending, pending_rows = [], 0
+            pending.append(batch)
+            pending_rows += n
+        if pending:
+            t0 = time.perf_counter()
+            yield concat_batches(pending)
+            concat_time.value += time.perf_counter() - t0
+
+    def execute_cpu(self, ctx: ExecCtx):
+        pending: List[pa.RecordBatch] = []
+        pending_rows = 0
+        for rb in self.child.execute_cpu(ctx):
+            if rb.num_rows == 0:
+                continue
+            if pending_rows + rb.num_rows > self.target_rows and pending:
+                yield _concat_host(pending)
+                pending, pending_rows = [], 0
+            pending.append(rb)
+            pending_rows += rb.num_rows
+        if pending:
+            yield _concat_host(pending)
+
+
+def _concat_host(rbs: List[pa.RecordBatch]) -> pa.RecordBatch:
+    t = pa.Table.from_batches(rbs).combine_chunks()
+    return t.to_batches()[0]

@@ -48,12 +48,18 @@ class SortOrder:
 
 
 def sort_batch_by(batch: TpuBatch, orders: Sequence[SortOrder],
-                  ectx) -> TpuBatch:
-    """Traced: sort one batch by the given (bound) orders."""
+                  ectx, limit: Optional[int] = None) -> TpuBatch:
+    """Traced: sort one batch by the given (bound) orders; optional
+    row-count truncation (kept inside the jit — an eager op would pay a
+    dispatch round-trip per batch)."""
+    import jax.numpy as jnp
     key_cols = [o.child.eval_tpu(batch, ectx) for o in orders]
     perm = sort_permutation(key_cols, [o.spec for o in orders],
                             batch.live_mask())
-    return gather_batch(batch, perm, batch.row_count)
+    rc = batch.row_count
+    if limit is not None:
+        rc = jnp.minimum(rc, jnp.int32(limit))
+    return gather_batch(batch, perm, rc)
 
 
 # --- CPU oracle sort (Spark semantics over host rows) ---------------------
@@ -201,15 +207,11 @@ class _PerBatchTopN(UnaryExec):
 
     def execute(self, ctx: ExecCtx):
         if self._jitted is None:
-            self._jitted = jax.jit(sort_batch_by, static_argnums=(1, 2))
+            self._jitted = jax.jit(sort_batch_by,
+                                   static_argnums=(1, 2, 3))
         orders = tuple(self.orders)
-        import jax.numpy as jnp
         for batch in self.child.execute(ctx):
-            s = self._jitted(batch, orders, ctx.eval_ctx)
-            # truncate without a device sync: row_count stays traced
-            s = s.with_columns(s.columns, row_count=jnp.minimum(
-                s.row_count, jnp.int32(self.limit)))
-            yield s
+            yield self._jitted(batch, orders, ctx.eval_ctx, self.limit)
 
     def execute_cpu(self, ctx: ExecCtx):
         for rb in self.child.execute_cpu(ctx):

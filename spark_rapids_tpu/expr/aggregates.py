@@ -35,16 +35,36 @@ __all__ = ["AggregateFunction", "Sum", "Count", "Min", "Max", "Average",
 _I64 = jnp.int64
 _F64 = jnp.float64
 
+# Global (no-key) aggregates pass seg=None: one segment, reduced with
+# plain jnp reductions into a tiny fixed lane count — segment_* lowers to
+# scatter-add, which costs ~100ms/2M rows on TPU, vs ~0 for a reduce.
+GLOBAL_LANES = 128
+
+
+def _lane0(value, dtype):
+    out = jnp.zeros((GLOBAL_LANES,), dtype)
+    return out.at[0].set(value.astype(dtype))
+
+
+def _out_cap(seg):
+    return GLOBAL_LANES if seg is None else seg.shape[0]
+
 
 def _seg_sum(vals, seg, cap):
+    if seg is None:
+        return _lane0(jnp.sum(vals), vals.dtype)
     return jax.ops.segment_sum(vals, seg, num_segments=cap)
 
 
 def _seg_min(vals, seg, cap):
+    if seg is None:
+        return _lane0(jnp.min(vals), vals.dtype)
     return jax.ops.segment_min(vals, seg, num_segments=cap)
 
 
 def _seg_max(vals, seg, cap):
+    if seg is None:
+        return _lane0(jnp.max(vals), vals.dtype)
     return jax.ops.segment_max(vals, seg, num_segments=cap)
 
 
@@ -135,13 +155,13 @@ class Sum(AggregateFunction):
         return _F64 if dt.is_floating(self.dtype) else _I64
 
     def update_device(self, vals, seg, sorted_live, out_live):
-        cap = seg.shape[0]
+        cap = _out_cap(seg)
         s, cnt = _sum_lanes(vals[0], seg, sorted_live, cap, self._acc())
         return [TpuColumnVector(self.dtype, data=s,
                                 validity=(cnt > 0) & out_live)]
 
     def merge_device(self, bufs, seg, sorted_live, out_live):
-        cap = seg.shape[0]
+        cap = _out_cap(seg)
         s, cnt = _sum_lanes(bufs[0], seg, sorted_live, cap, self._acc())
         return [TpuColumnVector(self.dtype, data=s,
                                 validity=(cnt > 0) & out_live)]
@@ -183,7 +203,7 @@ class Count(AggregateFunction):
         return [dt.StructField("count", dt.INT64, False)]
 
     def update_device(self, vals, seg, sorted_live, out_live):
-        cap = seg.shape[0]
+        cap = _out_cap(seg)
         if vals:
             _, valid = _masked(vals[0], seg, sorted_live)
         else:
@@ -192,7 +212,7 @@ class Count(AggregateFunction):
         return [TpuColumnVector(dt.INT64, data=cnt, validity=out_live)]
 
     def merge_device(self, bufs, seg, sorted_live, out_live):
-        cap = seg.shape[0]
+        cap = _out_cap(seg)
         data, valid = _masked(bufs[0], seg, sorted_live)
         s = _seg_sum(jnp.where(valid, data, 0), seg, cap)
         return [TpuColumnVector(dt.INT64, data=s, validity=out_live)]
@@ -226,7 +246,7 @@ class _MinMax(AggregateFunction):
         return [dt.StructField("m", self.dtype, True)]
 
     def _reduce(self, col, seg, sorted_live, out_live):
-        cap = seg.shape[0]
+        cap = _out_cap(seg)
         data, valid = _masked(col, seg, sorted_live)
         t = self.dtype
         if dt.is_floating(t):
@@ -326,7 +346,7 @@ class Average(AggregateFunction):
         return isinstance(self.children[0].dtype, dt.DecimalType)
 
     def update_device(self, vals, seg, sorted_live, out_live):
-        cap = seg.shape[0]
+        cap = _out_cap(seg)
         acc = _I64 if self._is_decimal() else _F64
         s, cnt = _sum_lanes(vals[0], seg, sorted_live, cap, acc)
         sum_t = self.buffer_fields[0].dtype
@@ -335,7 +355,7 @@ class Average(AggregateFunction):
                 TpuColumnVector(dt.INT64, data=cnt, validity=out_live)]
 
     def merge_device(self, bufs, seg, sorted_live, out_live):
-        cap = seg.shape[0]
+        cap = _out_cap(seg)
         acc = _I64 if self._is_decimal() else _F64
         s, scnt = _sum_lanes(bufs[0], seg, sorted_live, cap, acc)
         cdata, cvalid = _masked(bufs[1], seg, sorted_live)
@@ -403,20 +423,21 @@ class _FirstLast(AggregateFunction):
         return [dt.StructField("v", self.dtype, True)]
 
     def _pick(self, col, seg, sorted_live, out_live):
-        cap = seg.shape[0]
+        cap = _out_cap(seg)
         data, valid = _masked(col, seg, sorted_live)
+        n_in = valid.shape[0]  # input rows; != cap on the global path
         candidate = sorted_live & (valid if self.ignore_nulls
                                    else jnp.ones_like(valid))
-        pos = jnp.arange(cap, dtype=jnp.int32)
+        pos = jnp.arange(n_in, dtype=jnp.int32)
         if self.take_last:
             marked = jnp.where(candidate, pos, -1)
             picked = _seg_max(marked, seg, cap)
             found = picked >= 0
         else:
-            marked = jnp.where(candidate, pos, cap)
+            marked = jnp.where(candidate, pos, n_in)
             picked = _seg_min(marked, seg, cap)
-            found = picked < cap
-        idx = jnp.clip(picked, 0, cap - 1)
+            found = picked < n_in
+        idx = jnp.clip(picked, 0, n_in - 1)
         if col.data is None:
             return TpuColumnVector(self.dtype,
                                    validity=jnp.zeros((cap,), jnp.bool_))
@@ -471,20 +492,21 @@ class _CentralMoment(AggregateFunction):
                 dt.StructField("m2", dt.FLOAT64, False)]
 
     def update_device(self, vals, seg, sorted_live, out_live):
-        cap = seg.shape[0]
+        cap = _out_cap(seg)
         data, valid = _masked(vals[0], seg, sorted_live)
         x = jnp.where(valid, data.astype(_F64), 0.0)
         n = _seg_sum(valid.astype(_F64), seg, cap)
         s = _seg_sum(x, seg, cap)
         mean = s / jnp.where(n > 0, n, 1.0)
         # second pass: exact centered sum of squares per segment
-        d = jnp.where(valid, x - mean[seg], 0.0)
+        mu = mean[0] if seg is None else mean[seg]
+        d = jnp.where(valid, x - mu, 0.0)
         m2 = _seg_sum(d * d, seg, cap)
         return [TpuColumnVector(dt.FLOAT64, data=lane, validity=out_live)
                 for lane in (n, mean, m2)]
 
     def merge_device(self, bufs, seg, sorted_live, out_live):
-        cap = seg.shape[0]
+        cap = _out_cap(seg)
         ndata, nvalid = _masked(bufs[0], seg, sorted_live)
         mdata, _ = _masked(bufs[1], seg, sorted_live)
         m2data, _ = _masked(bufs[2], seg, sorted_live)
@@ -493,7 +515,7 @@ class _CentralMoment(AggregateFunction):
         N = _seg_sum(n_i, seg, cap)
         wsum = _seg_sum(n_i * mdata, seg, cap)
         MEAN = wsum / jnp.where(N > 0, N, 1.0)
-        delta = mdata - MEAN[seg]
+        delta = mdata - (MEAN[0] if seg is None else MEAN[seg])
         M2 = _seg_sum(jnp.where(nvalid, m2data + n_i * delta * delta, 0.0),
                       seg, cap)
         return [TpuColumnVector(dt.FLOAT64, data=lane, validity=out_live)

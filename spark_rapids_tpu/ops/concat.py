@@ -12,6 +12,7 @@ from typing import List, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..columnar.batch import TpuBatch, bucket_bytes, bucket_rows, row_mask
 from ..columnar.column import TpuColumnVector
@@ -19,83 +20,118 @@ from ..columnar.column import TpuColumnVector
 __all__ = ["concat_batches", "concat_device"]
 
 
-def _scatter_fixed(out, src, dst_idx, keep, out_cap):
-    dst = jnp.where(keep, dst_idx, out_cap)
-    return out.at[dst].set(src, mode="drop")
-
-
 def concat_device(batches: Sequence[TpuBatch], out_capacity: int,
                   out_char_caps: Sequence[int]) -> TpuBatch:
-    """Traced concat: scatter live rows of each batch at running offsets.
+    """Traced concat, all gathers (arbitrary scatters serialize on TPU):
+    output row j finds its source batch by searchsorted over the running
+    row counts, then gathers from the statically-concatenated inputs.
     out_char_caps has one entry per column (unused for fixed-width)."""
     schema = batches[0].schema
     ncols = len(schema)
-    total = jnp.int32(0)
-    row_offs = []
-    for b in batches:
-        row_offs.append(total)
-        total = total + b.row_count.astype(jnp.int32)
+    nb = len(batches)
+    rcs = jnp.stack([b.row_count.astype(jnp.int32) for b in batches])
+    cum_rc = jnp.cumsum(rcs)           # inclusive; nb is small
+    total = cum_rc[-1]
+    row_base = jnp.concatenate([jnp.zeros((1,), jnp.int32), cum_rc[:-1]])
+    # static bases into the axis-concatenated input arrays
+    caps = [b.capacity for b in batches]
+    cap_base = np.concatenate([[0], np.cumsum(caps)[:-1]]).astype(np.int32)
+
+    j = jnp.arange(out_capacity, dtype=jnp.int32)
+    src_b = jnp.searchsorted(cum_rc, j, side="right").astype(jnp.int32)
+    src_b = jnp.clip(src_b, 0, nb - 1)
+    local = j - row_base[src_b]
+    src_row = jnp.asarray(cap_base)[src_b] + local
+    out_live = j < total
+    max_row = sum(caps) - 1
+    src_row = jnp.clip(src_row, 0, max_row)
 
     cols = []
     for ci in range(ncols):
-        dtype = batches[0].columns[ci].dtype
         first = batches[0].columns[ci]
-        validity = jnp.zeros((out_capacity,), jnp.bool_)
+        dtype = first.dtype
+        validity_all = jnp.concatenate(
+            [b.columns[ci].validity for b in batches])
+        validity = validity_all[src_row] & out_live
         if first.is_string_like:
             ccap = out_char_caps[ci]
-            offsets = jnp.zeros((out_capacity + 1,), jnp.int32)
-            chars = jnp.zeros((ccap,), jnp.uint8)
-            char_off = jnp.int32(0)
-            for b, roff in zip(batches, row_offs):
-                c = b.columns[ci]
-                cap = c.capacity
-                rc = b.row_count.astype(jnp.int32)
-                live = row_mask(cap, rc)
-                pos = jnp.arange(cap, dtype=jnp.int32)
-                validity = _scatter_fixed(validity, c.validity, roff + pos,
-                                          live, out_capacity)
-                # offsets: positions 0..rc inclusive, rebased by char_off
-                opos = jnp.arange(cap + 1, dtype=jnp.int32)
-                okeep = opos <= rc
-                offsets = _scatter_fixed(offsets, c.offsets + char_off,
-                                         roff + opos, okeep,
-                                         out_capacity + 1)
-                # chars: live bytes are [0, offsets[rc])
-                nchars = c.offsets[rc]
-                cpos = jnp.arange(c.chars.shape[0], dtype=jnp.int32)
-                chars = _scatter_fixed(chars, c.chars, char_off + cpos,
-                                       cpos < nchars, ccap)
-                char_off = char_off + nchars
-            # keep offsets monotone through trailing padding
-            opos = jnp.arange(out_capacity + 1, dtype=jnp.int32)
-            offsets = jnp.where(opos > total, char_off, offsets)
+            # per-batch live char counts and bases
+            nchars = jnp.stack([
+                b.columns[ci].offsets[b.row_count.astype(jnp.int32)]
+                for b in batches])
+            cum_ch = jnp.cumsum(nchars)
+            ch_base = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                       cum_ch[:-1]])
+            char_caps_in = [b.columns[ci].chars.shape[0] for b in batches]
+            ch_cap_base = np.concatenate(
+                [[0], np.cumsum(char_caps_in)[:-1]]).astype(np.int32)
+            chars_all = jnp.concatenate(
+                [b.columns[ci].chars for b in batches]) \
+                if sum(char_caps_in) else jnp.zeros((0,), jnp.uint8)
+            offsets_all = jnp.concatenate(
+                [b.columns[ci].offsets[:-1] for b in batches])
+            # output offsets: source row's offset rebased into the packed
+            # char space; rows past total pin to the final byte count
+            o = offsets_all[src_row] + ch_base[src_b]
+            o = jnp.where(out_live, o, cum_ch[-1])
+            offsets = jnp.concatenate(
+                [o, cum_ch[-1:].astype(jnp.int32)])
+            # chars: position c -> source batch by char count, then byte
+            cpos = jnp.arange(ccap, dtype=jnp.int32)
+            cb = jnp.searchsorted(cum_ch, cpos, side="right") \
+                .astype(jnp.int32)
+            cb = jnp.clip(cb, 0, nb - 1)
+            within = cpos - ch_base[cb]
+            csrc = jnp.asarray(ch_cap_base)[cb] + within
+            cvalid = cpos < cum_ch[-1]
+            if sum(char_caps_in):
+                chars = jnp.where(
+                    cvalid,
+                    chars_all[jnp.clip(csrc, 0, sum(char_caps_in) - 1)],
+                    jnp.uint8(0))
+            else:
+                chars = jnp.zeros((ccap,), jnp.uint8)
             cols.append(TpuColumnVector(dtype, validity=validity,
                                         offsets=offsets, chars=chars))
         elif first.data is None:  # NullType
-            for b, roff in zip(batches, row_offs):
-                c = b.columns[ci]
-                cap = c.capacity
-                live = row_mask(cap, b.row_count)
-                pos = jnp.arange(cap, dtype=jnp.int32)
-                validity = _scatter_fixed(validity, c.validity, roff + pos,
-                                          live, out_capacity)
             cols.append(TpuColumnVector(dtype, validity=validity))
         else:
-            data = jnp.zeros((out_capacity,), first.data.dtype)
-            for b, roff in zip(batches, row_offs):
-                c = b.columns[ci]
-                cap = c.capacity
-                live = row_mask(cap, b.row_count)
-                pos = jnp.arange(cap, dtype=jnp.int32)
-                data = _scatter_fixed(data, c.data, roff + pos, live,
-                                      out_capacity)
-                validity = _scatter_fixed(validity, c.validity, roff + pos,
-                                          live, out_capacity)
-            cols.append(TpuColumnVector(dtype, data=data, validity=validity))
+            data_all = jnp.concatenate(
+                [b.columns[ci].data for b in batches])
+            cols.append(TpuColumnVector(dtype, data=data_all[src_row],
+                                        validity=validity))
     return TpuBatch(cols, schema, total)
 
 
 _concat_jit_cache = {}
+_size_jit_cache = {}
+
+
+def concat_batches_bounded(batches: List[TpuBatch]) -> TpuBatch:
+    """Sync-free concat: output capacity is the bucketed SUM OF INPUT
+    CAPACITIES (a static upper bound), so no device->host size transfer is
+    needed — one RPC saved per merge, at the cost of up to 2x padding.
+    Use when capacities are already tight (e.g. shrunk aggregate
+    partials); use concat_batches when exact sizing matters."""
+    if len(batches) == 1:
+        return batches[0]
+    ncols = len(batches[0].schema)
+    out_cap = bucket_rows(sum(b.capacity for b in batches))
+    char_caps = []
+    for ci in range(ncols):
+        c = batches[0].columns[ci]
+        if c.is_string_like:
+            char_caps.append(bucket_bytes(sum(
+                b.columns[ci].chars.shape[0] for b in batches)))
+        else:
+            char_caps.append(0)
+    key = ("bounded", tuple(b.capacity for b in batches), out_cap,
+           tuple(char_caps), id(batches[0].schema))
+    fn = _concat_jit_cache.get(key)
+    if fn is None:
+        fn = jax.jit(lambda bs: concat_device(bs, out_cap, char_caps))
+        _concat_jit_cache[key] = fn
+    return fn(batches)
 
 
 def concat_batches(batches: List[TpuBatch]) -> TpuBatch:
@@ -107,12 +143,21 @@ def concat_batches(batches: List[TpuBatch]) -> TpuBatch:
     ncols = len(batches[0].schema)
     str_cols = [ci for ci in range(ncols)
                 if batches[0].columns[ci].is_string_like]
-    # one device->host transfer for all row counts + string byte counts
-    scalars = [b.row_count for b in batches]
-    for ci in str_cols:
-        scalars.extend(b.columns[ci].offsets[b.row_count] for b in batches)
-    host = [int(v) for v in jax.device_get(jnp.stack(
-        [jnp.asarray(s, jnp.int64) for s in scalars]))]
+    # one jitted call + one device->host transfer for all row counts and
+    # string byte counts (eager ops pay a dispatch round-trip each)
+    key_sizes = (tuple(b.capacity for b in batches), tuple(str_cols))
+    fn = _size_jit_cache.get(key_sizes)
+    if fn is None:
+        def _sizes(bs):
+            out = [b.row_count.astype(jnp.int64) for b in bs]
+            for ci in str_cols:
+                out.extend(b.columns[ci].offsets[
+                    b.row_count.astype(jnp.int32)].astype(jnp.int64)
+                    for b in bs)
+            return jnp.stack(out)
+        fn = jax.jit(_sizes)
+        _size_jit_cache[key_sizes] = fn
+    host = [int(v) for v in jax.device_get(fn(batches))]
     nb = len(batches)
     for b, rc in zip(batches, host[:nb]):
         if b._num_rows_cache is None:

@@ -15,22 +15,41 @@ from ..columnar.batch import TpuBatch, row_mask
 from ..columnar.column import TpuColumnVector
 from .strings import gather_strings
 
-__all__ = ["compaction_indices", "gather_column", "gather_batch",
-           "compact_batch"]
+__all__ = ["compaction_indices", "exclusive_cumsum", "invert_permutation",
+           "gather_column", "gather_batch", "compact_batch"]
+
+
+def exclusive_cumsum(x: jax.Array) -> jax.Array:
+    """Exclusive int prefix sum, computed in f64.
+
+    XLA-on-TPU lowers integer cumsum to a serial loop (~100ms for 2M
+    elements) but float cumsum to a parallel prefix (~0.3ms); f64 is exact
+    for sums below 2^53, far past any batch capacity."""
+    s = jnp.cumsum(x.astype(jnp.float64))
+    return (s - x).astype(jnp.int32)
+
+
+def invert_permutation(perm: jax.Array, values: jax.Array) -> jax.Array:
+    """out[perm[i]] = values[i] without a scatter: sorting (perm, values)
+    by perm reorders values back to original positions. lax.sort is fast
+    on TPU where arbitrary scatters serialize."""
+    _, out = jax.lax.sort((perm, values), num_keys=1)
+    return out
 
 
 def compaction_indices(keep: jax.Array):
     """(indices, count): indices[j] = source row of the j-th kept row, for
-    j < count; rows >= count point at row 0 (padding garbage).
+    j < count; rows >= count hold the non-kept rows (gather of them is
+    masked by the caller's out_live).
 
-    keep must already exclude padding rows (AND with the batch live mask).
+    Sort-based: one stable 2-key sort, no scatter, no int cumsum (both
+    serialize on TPU). keep must already exclude padding rows.
     """
     cap = keep.shape[0]
-    positions = jnp.cumsum(keep.astype(jnp.int32)) - 1
-    count = positions[-1] + 1 if cap else jnp.int32(0)
-    dst = jnp.where(keep, positions, cap)
-    indices = jnp.zeros((cap,), jnp.int32).at[dst].set(
-        jnp.arange(cap, dtype=jnp.int32), mode="drop")
+    key = jnp.where(keep, jnp.int8(0), jnp.int8(1))
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    _, indices = jax.lax.sort((key, idx), num_keys=2)
+    count = jnp.sum(keep.astype(jnp.int32))
     return indices, count
 
 
@@ -43,7 +62,7 @@ def gather_column(col: TpuColumnVector, indices: jax.Array,
     if col.is_string_like:
         cap = char_capacity if char_capacity is not None \
             else col.chars.shape[0]
-        out = gather_strings(col, indices, cap)
+        out = gather_strings(col, indices, cap, out_live=out_live)
         return out.with_arrays(validity=validity)
     if col.data is None:  # NullType
         return col.with_arrays(validity=validity)
@@ -52,12 +71,80 @@ def gather_column(col: TpuColumnVector, indices: jax.Array,
 
 def gather_batch(batch: TpuBatch, indices: jax.Array, count,
                  char_capacities=None) -> TpuBatch:
-    """Reorder/compact a whole batch by row indices (count = live rows)."""
-    out_live = row_mask(indices.shape[0], count)
+    """Reorder/compact a whole batch by row indices (count = live rows).
+
+    All fixed-width data lanes are bitcast to int32 words and packed —
+    together with the validity bits (one int32 bitfield lane per 32
+    columns) — into a single (rows, words) matrix, so the whole batch
+    moves in ONE row gather: N separate 1-D gathers cost ~30ms each on
+    TPU, a packed 2-D row gather is ~free."""
+    import numpy as np
+    n = batch.capacity          # input rows (packing side)
+    n_out = indices.shape[0]    # output rows (gather side)
+    out_live = row_mask(n_out, count)
+
+    lanes = []          # (n, w) int32 blocks to pack
+    col_lanes = []      # per column: (kind, lane_offset, width)
+    off = 0
+    for c in batch.columns:
+        if c.is_string_like or c.data is None:
+            col_lanes.append(("special", 0, 0))
+            continue
+        d = c.data
+        if d.dtype == jnp.bool_:
+            w = d.astype(jnp.int32)[:, None]
+        elif d.dtype.itemsize < 4:
+            w = d.astype(jnp.int32)[:, None]
+        elif d.dtype.itemsize == 4:
+            w = jax.lax.bitcast_convert_type(d, jnp.int32)[:, None]
+        else:  # 8-byte lanes -> two int32 words: (n,) i64 -> (n, 2) i32
+            w = jax.lax.bitcast_convert_type(
+                jax.lax.bitcast_convert_type(d, jnp.int64), jnp.int32)
+        lanes.append(w)
+        col_lanes.append(("packed", off, w.shape[1]))
+        off += w.shape[1]
+    # validity bitfields: 32 columns per int32 lane
+    ncols = len(batch.columns)
+    vwords = []
+    for base in range(0, ncols, 32):
+        word = jnp.zeros((n,), jnp.int32)
+        for bit, c in enumerate(batch.columns[base: base + 32]):
+            word = word | (c.validity.astype(jnp.int32) << bit)
+        vwords.append(word[:, None])
+    vbase = off
+    lanes.extend(vwords)
+    off += len(vwords)
+
+    packed = jnp.concatenate(lanes, axis=1) if lanes else None
+    gathered = packed[indices] if packed is not None else None
+
     cols = []
     for i, c in enumerate(batch.columns):
-        cc = None if char_capacities is None else char_capacities[i]
-        cols.append(gather_column(c, indices, out_live, cc))
+        word = gathered[:, vbase + i // 32]
+        validity = (((word >> (i % 32)) & 1) != 0) & out_live
+        kind, loff, width = col_lanes[i]
+        if kind == "special":
+            if c.is_string_like:
+                cc = char_capacities[i] if char_capacities is not None \
+                    else c.chars.shape[0]
+                out = gather_strings(c, indices, cc, out_live=out_live)
+                cols.append(out.with_arrays(validity=validity))
+            else:  # NullType
+                cols.append(c.with_arrays(validity=validity))
+            continue
+        d = c.data
+        g = gathered[:, loff: loff + width]
+        if d.dtype == jnp.bool_:
+            data = g[:, 0] != 0
+        elif d.dtype.itemsize < 4:
+            data = g[:, 0].astype(d.dtype)
+        elif d.dtype.itemsize == 4:
+            data = jax.lax.bitcast_convert_type(g[:, 0], d.dtype)
+        else:
+            i64 = jax.lax.bitcast_convert_type(g, jnp.int64)  # (n_out,)
+            data = i64 if d.dtype == jnp.int64 else \
+                jax.lax.bitcast_convert_type(i64, d.dtype)
+        cols.append(c.with_arrays(data=data, validity=validity))
     return TpuBatch(cols, batch.schema, count)
 
 

@@ -1,0 +1,288 @@
+"""Spark-compatible Murmur3 hash kernels.
+
+TPU replacement for the reference's hash partitioning / GpuMurmur3Hash
+(`HashFunctions.scala`, `GpuHashPartitioningBase` — SURVEY.md §2.2-C/D;
+reference mount empty). Spark uses Murmur3_x86_32 with seed 42 for
+`hash()` and shuffle partitioning; matching it bit-for-bit keeps partition
+placement identical to CPU Spark (important for AQE stats parity and for
+the dual-run harness's exchange tests).
+
+Written against an array-module parameter `xp` so the SAME code runs as a
+jnp device kernel and as the numpy host oracle; all arithmetic in uint32
+(wrapping).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import datatypes as dt
+from ..columnar.column import TpuColumnVector
+
+__all__ = ["murmur3_int32", "murmur3_int64",
+           "murmur3_bytes_device_seeded", "hash_column_device",
+           "hash_columns_device", "hash_columns_numpy", "pmod"]
+
+_C1 = np.uint32(0xcc9e2d51)
+_C2 = np.uint32(0x1b873593)
+SEED = np.uint32(42)
+
+
+def _rotl(x, r, xp):
+    r32 = np.uint32(32 - r)
+    return (x << np.uint32(r)) | (x >> r32)
+
+
+def _mix_k1(k1, xp):
+    k1 = k1 * _C1
+    k1 = _rotl(k1, 15, xp)
+    return k1 * _C2
+
+
+def _mix_h1(h1, k1, xp):
+    h1 = h1 ^ k1
+    h1 = _rotl(h1, 13, xp)
+    return h1 * np.uint32(5) + np.uint32(0xe6546b64)
+
+
+def _fmix(h1, length, xp):
+    h1 = h1 ^ np.uint32(length)
+    h1 = h1 ^ (h1 >> np.uint32(16))
+    h1 = h1 * np.uint32(0x85ebca6b)
+    h1 = h1 ^ (h1 >> np.uint32(13))
+    h1 = h1 * np.uint32(0xc2b2ae35)
+    return h1 ^ (h1 >> np.uint32(16))
+
+
+def murmur3_int32(v, seed, xp):
+    """v: uint32 array (the 4-byte value), seed: uint32 array/scalar."""
+    h = _mix_h1(seed, _mix_k1(v, xp), xp)
+    return _fmix(h, 4, xp)
+
+
+def murmur3_int64(v, seed, xp):
+    """v: uint64-ish as two uint32 lanes (lo, hi) — Spark hashes the low
+    word then the high word."""
+    lo, hi = v
+    h = _mix_h1(seed, _mix_k1(lo, xp), xp)
+    h = _mix_h1(h, _mix_k1(hi, xp), xp)
+    return _fmix(h, 8, xp)
+
+
+def _split64(v64, xp):
+    u = v64.astype(xp.uint64) if xp is np else \
+        jax.lax.bitcast_convert_type(v64, jnp.uint64)
+    lo = (u & xp.uint64(0xffffffff)).astype(xp.uint32)
+    hi = (u >> xp.uint64(32)).astype(xp.uint32)
+    return lo, hi
+
+
+def _hash_fixed(values, t: dt.DataType, seed, xp):
+    """Hash one fixed-width column's dense values with Spark semantics."""
+    if isinstance(t, dt.BooleanType):
+        v = values.astype(xp.uint32) if xp is np else \
+            values.astype(jnp.uint32)
+        return murmur3_int32(v, seed, xp)
+    if isinstance(t, (dt.ByteType, dt.ShortType, dt.IntegerType,
+                      dt.DateType)):
+        v = values.astype(xp.int32)
+        v = v.view(xp.uint32) if xp is np else \
+            jax.lax.bitcast_convert_type(v, jnp.uint32)
+        return murmur3_int32(v, seed, xp)
+    if isinstance(t, (dt.LongType, dt.TimestampType, dt.DecimalType)):
+        return murmur3_int64(_split64(values.astype(xp.int64), xp), seed,
+                             xp)
+    if isinstance(t, dt.FloatType):
+        v = values
+        v = xp.where(v == 0, xp.zeros_like(v), v)  # -0.0 -> 0.0
+        nan_bits = np.float32(np.nan)
+        v = xp.where(xp.isnan(v), xp.full_like(v, nan_bits), v)
+        bits = v.view(xp.uint32) if xp is np else \
+            jax.lax.bitcast_convert_type(v, jnp.uint32)
+        return murmur3_int32(bits, seed, xp)
+    if isinstance(t, dt.DoubleType):
+        v = values
+        v = xp.where(v == 0, xp.zeros_like(v), v)
+        v = xp.where(xp.isnan(v), xp.full_like(v, np.nan), v)
+        bits = v.view(xp.int64) if xp is np else v  # split64 bitcasts
+        if xp is np:
+            return murmur3_int64(_split64_np_bits(bits), seed, xp)
+        return murmur3_int64(_split64_f64_device(v), seed, xp)
+    raise NotImplementedError(f"hash of {t.simple_string()}")
+
+
+def _split64_np_bits(bits):
+    u = bits.view(np.uint64)
+    return ((u & np.uint64(0xffffffff)).astype(np.uint32),
+            (u >> np.uint64(32)).astype(np.uint32))
+
+
+def _split64_f64_device(v):
+    u = jax.lax.bitcast_convert_type(v, jnp.uint64)
+    return ((u & jnp.uint64(0xffffffff)).astype(jnp.uint32),
+            (u >> jnp.uint64(32)).astype(jnp.uint32))
+
+
+def _fmix_len(h1, lens):
+    h1 = h1 ^ lens.astype(jnp.uint32)
+    h1 = h1 ^ (h1 >> jnp.uint32(16))
+    h1 = h1 * jnp.uint32(0x85ebca6b)
+    h1 = h1 ^ (h1 >> jnp.uint32(13))
+    h1 = h1 * jnp.uint32(0xc2b2ae35)
+    return h1 ^ (h1 >> jnp.uint32(16))
+
+
+def hash_column_device(col: TpuColumnVector, seed) -> jax.Array:
+    """One column's contribution: null rows keep the incoming seed
+    (Spark semantics: null doesn't change the running hash)."""
+    if col.is_string_like:
+        h = murmur3_bytes_device_seeded(col.offsets, col.chars, seed)
+    elif col.data is None:
+        return seed
+    else:
+        h = _hash_fixed(col.data, col.dtype, seed, jnp)
+    return jnp.where(col.validity, h, seed)
+
+
+def murmur3_bytes_device_seeded(offsets, chars, seed):
+    """Like murmur3_bytes_device but threading a per-row seed array."""
+    n = offsets.shape[0] - 1
+    starts = offsets[:-1]
+    lens = offsets[1:] - starts
+    nblocks = lens // 4
+    max_blocks = jnp.max(nblocks, initial=0)
+    limit = max(chars.shape[0] - 1, 0)
+
+    def get_byte(pos):
+        idx = jnp.clip(pos, 0, limit)
+        return (chars[idx] if chars.shape[0] else
+                jnp.zeros_like(idx, jnp.uint8)).astype(jnp.uint32)
+
+    def block_word(b):
+        base = starts + b * 4
+        w = get_byte(base)
+        w = w | (get_byte(base + 1) << 8)
+        w = w | (get_byte(base + 2) << 16)
+        w = w | (get_byte(base + 3) << 24)
+        return w
+
+    def body(state):
+        b, h = state
+        active = b < nblocks
+        w = block_word(b)
+        h2 = _mix_h1(h, _mix_k1(w, jnp), jnp)
+        return b + 1, jnp.where(active, h2, h)
+
+    h = seed * jnp.ones((n,), jnp.uint32)
+    _, h = jax.lax.while_loop(lambda s: s[0] < max_blocks, body,
+                              (jnp.int32(0), h))
+    for tpos in range(3):
+        pos = nblocks * 4 + tpos
+        active = pos < lens
+        byte = get_byte(starts + pos)
+        sbyte = jnp.where(byte >= 128, byte.astype(jnp.int32) - 256,
+                          byte.astype(jnp.int32))
+        k = jax.lax.bitcast_convert_type(sbyte, jnp.uint32)
+        h2 = _mix_h1(h, _mix_k1(k, jnp), jnp)
+        h = jnp.where(active, h2, h)
+    return _fmix_len(h, lens)
+
+
+def hash_columns_device(cols: Sequence[TpuColumnVector]) -> jax.Array:
+    """Spark hash(cols...): running seed threaded through columns."""
+    n = cols[0].capacity if cols else 0
+    h = jnp.full((n,), SEED, jnp.uint32)
+    for c in cols:
+        h = hash_column_device(c, h)
+    return jax.lax.bitcast_convert_type(h, jnp.int32)
+
+
+def hash_columns_numpy(arrays, types: Sequence[dt.DataType],
+                       n: int) -> np.ndarray:
+    """Host oracle: same running-seed scheme over pyarrow arrays."""
+    np_err = np.seterr(over="ignore")  # uint32 wraparound is intended
+    h = np.full(n, SEED, np.uint32)
+    for arr, t in zip(arrays, types):
+        valid = np.ones(n, bool) if arr.null_count == 0 else \
+            np.array([v is not None for v in arr.to_pylist()])
+        if isinstance(t, (dt.StringType, dt.BinaryType)):
+            vals = arr.to_pylist()
+            for i in range(n):
+                if not valid[i]:
+                    continue
+                b = vals[i].encode() if isinstance(vals[i], str) else \
+                    bytes(vals[i])
+                h[i] = _hash_bytes_seeded_np(b, h[i])
+        else:
+            vals = arr.to_pylist()
+            for i in range(n):
+                if not valid[i]:
+                    continue
+                h[i] = _hash_scalar_np(vals[i], t, h[i])
+    np.seterr(**np_err)
+    return h.view(np.int32)
+
+
+def _hash_scalar_np(v, t: dt.DataType, seed: np.uint32) -> np.uint32:
+    import decimal as _dec
+    import datetime as _dtm
+    if isinstance(t, dt.BooleanType):
+        return murmur3_int32(np.uint32(1 if v else 0), seed, np)
+    if isinstance(t, (dt.ByteType, dt.ShortType, dt.IntegerType)):
+        return murmur3_int32(np.uint32(int(v) & 0xffffffff), seed, np)
+    if isinstance(t, dt.DateType):
+        days = (v - _dtm.date(1970, 1, 1)).days if isinstance(v, _dtm.date) \
+            else int(v)
+        return murmur3_int32(np.uint32(days & 0xffffffff), seed, np)
+    if isinstance(t, (dt.LongType, dt.TimestampType, dt.DecimalType)):
+        if isinstance(t, dt.TimestampType) and isinstance(v, _dtm.datetime):
+            if v.tzinfo is None:
+                v = v.replace(tzinfo=_dtm.timezone.utc)
+            epoch = _dtm.datetime(1970, 1, 1, tzinfo=_dtm.timezone.utc)
+            v = (v - epoch) // _dtm.timedelta(microseconds=1)
+        elif isinstance(t, dt.DecimalType):
+            v = int(_dec.Decimal(v).scaleb(t.scale))
+        u = int(v) & 0xffffffffffffffff
+        return murmur3_int64((np.uint32(u & 0xffffffff),
+                              np.uint32(u >> 32)), seed, np)
+    if isinstance(t, dt.FloatType):
+        f = np.float32(v)
+        if f == 0:
+            f = np.float32(0.0)
+        if np.isnan(f):
+            f = np.float32(np.nan)
+        return murmur3_int32(f.view(np.uint32), seed, np)
+    if isinstance(t, dt.DoubleType):
+        f = np.float64(v)
+        if f == 0:
+            f = np.float64(0.0)
+        if np.isnan(f):
+            f = np.float64(np.nan)
+        u = f.view(np.uint64)
+        return murmur3_int64((np.uint32(u & np.uint64(0xffffffff)),
+                              np.uint32(u >> np.uint64(32))), seed, np)
+    raise NotImplementedError(t.simple_string())
+
+
+def _hash_bytes_seeded_np(b: bytes, seed: np.uint32) -> np.uint32:
+    h = seed
+    nb = len(b) // 4
+    for blk in range(nb):
+        w = np.uint32(int.from_bytes(b[blk * 4: blk * 4 + 4], "little"))
+        h = _mix_h1(h, _mix_k1(w, np), np)
+    for t in range(nb * 4, len(b)):
+        sb = b[t]
+        if sb >= 128:
+            sb -= 256
+        k = np.uint32(sb & 0xffffffff)
+        h = _mix_h1(h, _mix_k1(k, np), np)
+    return _fmix(h, len(b), np)
+
+
+def pmod(hash_vals, n: int, xp=jnp):
+    """Spark's positive modulo for partition ids."""
+    r = hash_vals % xp.int32(n)
+    return xp.where(r < 0, r + n, r)

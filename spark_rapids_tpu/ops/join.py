@@ -76,8 +76,9 @@ def union_group_ids(left_keys: Sequence[TpuColumnVector],
     for lane in sorted_lanes:
         boundary = boundary | jnp.concatenate(
             [jnp.zeros((1,), jnp.bool_), lane[1:] != lane[:-1]])
-    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-    g = jnp.zeros((n,), jnp.int32).at[perm].set(seg)
+    seg = jnp.cumsum(boundary.astype(jnp.float64)).astype(jnp.int32) - 1
+    from .gather import invert_permutation
+    g = invert_permutation(perm, seg)
     return g[:nl], g[nl:]
 
 
@@ -143,7 +144,8 @@ def join_counts(left_keys, right_keys, live_l, live_r,
                                  jnp.where(eligible_r, g_r, gcap - 1),
                                  num_segments=gcap)
     # exclusive prefix: start of each group's run in perm_r order
-    starts_g = jnp.cumsum(counts) - counts
+    from .gather import exclusive_cumsum
+    starts_g = exclusive_cumsum(counts)
     matches = jnp.where(eligible_l, counts[g_l], 0)
     counts_l = jax.ops.segment_sum(eligible_l.astype(jnp.int32),
                                    jnp.where(eligible_l, g_l, gcap - 1),
@@ -196,7 +198,8 @@ def join_indices(plan: JoinPlanA, join_type: str, out_cap: int):
     if join_type in ("left_outer", "full_outer"):
         emit = jnp.where(plan.live_l, jnp.maximum(plan.matches, 1), 0)
     # exclusive cumsum of per-left-row output counts
-    out_start = jnp.cumsum(emit) - emit
+    from .gather import exclusive_cumsum
+    out_start = exclusive_cumsum(emit)
     pairs_total = jnp.sum(emit)
     # map output row -> left row: last i with out_start[i] <= j, restricted
     # to emitting rows (emit>0). searchsorted over the cumsum works because

@@ -80,49 +80,42 @@ def string_compare_tpu(a: TpuColumnVector, b: TpuColumnVector) -> jax.Array:
 
 
 def gather_strings(col: TpuColumnVector, indices: jax.Array,
-                   char_capacity: int) -> TpuColumnVector:
-    """Reorder a string column by row indices (device gather/scatter).
+                   char_capacity: int, out_live=None) -> TpuColumnVector:
+    """Reorder a string column by row indices, all gathers (no scatter —
+    arbitrary scatters serialize on TPU, gathers don't).
 
-    Output offsets are the cumulative gathered lengths; chars are moved via
-    a windowed copy loop (static shapes, O(total_bytes))."""
+    Output offsets = cumulative gathered lengths (f64 prefix sum: integer
+    cumsum also serializes on TPU). For each output char position, the
+    owning row comes from one searchsorted over the offsets, then the byte
+    is a single gather from the source. out_live (if given) zeroes the
+    lengths of dead output rows so padding can't inflate the offsets."""
+    n = indices.shape[0]
     lens = string_lengths(col)
     new_lens = lens[indices]
-    new_offsets = jnp.concatenate([
-        jnp.zeros((1,), jnp.int32),
-        jnp.cumsum(new_lens, dtype=jnp.int32)])
+    if out_live is not None:
+        new_lens = jnp.where(out_live, new_lens, 0)
+    csum = jnp.cumsum(new_lens.astype(jnp.float64))
+    new_offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), csum.astype(jnp.int32)])
     src_starts = col.offsets[:-1][indices]
-    n = indices.shape[0]
 
-    # Copy loop: for each window step, move up to _WINDOW bytes of each row.
-    steps = max(1, -(-char_capacity // _WINDOW))
-
-    def body(chunk, out):
-        pos = chunk * _WINDOW + jnp.arange(_WINDOW, dtype=jnp.int32)[None, :]
-        in_range = pos < new_lens[:, None]
-        src_idx = jnp.clip(src_starts[:, None] + pos, 0,
-                           max(col.chars.shape[0] - 1, 0))
-        vals = col.chars[src_idx] if col.chars.shape[0] else \
-            jnp.zeros((n, _WINDOW), jnp.uint8)
-        dst_idx = jnp.where(in_range, new_offsets[:-1][:, None] + pos,
-                            char_capacity)
-        return out.at[dst_idx.reshape(-1)].set(
-            vals.reshape(-1), mode="drop")
-
-    max_chunks = jnp.int32(-(-jnp.max(new_lens, initial=0) // _WINDOW))
-
-    def cond_body(state):
-        chunk, out = state
-        return chunk < max_chunks
-
-    def loop_body(state):
-        chunk, out = state
-        return chunk + 1, body(chunk, out)
-
-    out = jnp.zeros((char_capacity,), jnp.uint8)
-    _, out = jax.lax.while_loop(cond_body, loop_body, (jnp.int32(0), out))
+    c = jnp.arange(char_capacity, dtype=jnp.int32)
+    row = jnp.searchsorted(new_offsets[1:], c, side="right")
+    row = jnp.clip(row, 0, n - 1).astype(jnp.int32)
+    within = c - new_offsets[row]
+    src = src_starts[row] + within
+    total = new_offsets[-1]
+    valid_pos = c < total
+    if col.chars.shape[0]:
+        limit = col.chars.shape[0] - 1
+        out = jnp.where(valid_pos,
+                        col.chars[jnp.clip(src, 0, limit)],
+                        jnp.uint8(0))
+    else:
+        out = jnp.zeros((char_capacity,), jnp.uint8)
     validity = col.validity[indices]
-    return TpuColumnVector(col.dtype, validity=validity, offsets=new_offsets,
-                           chars=out)
+    return TpuColumnVector(col.dtype, validity=validity,
+                           offsets=new_offsets, chars=out)
 
 
 def substring_tpu(col: TpuColumnVector, start: jax.Array, length: jax.Array,

@@ -1,0 +1,4 @@
+from .partitioner import (HashPartitioning, RangePartitioning,
+                          RoundRobinPartitioning, SinglePartitioning)
+from .transport import (LocalShuffleTransport, ShuffleTransport,
+                        ShuffleWriteHandle)

@@ -1,0 +1,100 @@
+"""ICI SPMD shuffle exchange.
+
+TPU-native replacement for the reference's UCX peer-to-peer shuffle
+transport (SURVEY.md §2.2-D, §3.4, §5.8; reference mount empty): instead
+of an asynchronous pull protocol (metadata requests, bounce buffers,
+windowed transfers), an epoch-synchronized stage enters one collective —
+`jax.lax.all_to_all` over the device mesh — and every chip's partitioned
+rows land on their owners in a single SPMD step. Cross-slice traffic rides
+DCN through the same collective; the host/local transport remains the
+fallback when the mesh isn't whole (SURVEY.md §7.3.2).
+
+The kernel is fixed-width-column based (strings ride the host fallback
+until byte-matrix exchange lands). Data layout per device: padded row
+blocks of static capacity with a live row count — same discipline as
+TpuBatch.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_ici_all_to_all", "ici_exchange_batches"]
+
+
+def _local_exchange(ndev: int, axis: str, datas, valids, pids, row_count):
+    """Per-device body (runs under shard_map). datas/valids: tuples of
+    (cap,) arrays; pids: (cap,) int32; row_count: () int32."""
+    cap = pids.shape[0]
+    live = jnp.arange(cap, dtype=jnp.int32) < row_count
+    pid_key = jnp.where(live, pids, ndev)  # padding sorts last
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    _, perm = jax.lax.sort((pid_key, idx), num_keys=2)
+    counts = jax.ops.segment_sum(live.astype(jnp.int32),
+                                 jnp.where(live, pids, ndev - 1),
+                                 num_segments=ndev)
+    starts = jnp.cumsum(counts) - counts
+
+    # send matrix slots: send[p, r] = row r of partition p
+    r = jnp.arange(cap, dtype=jnp.int32)[None, :]
+    slot_valid = r < counts[:, None]                       # (ndev, cap)
+    src = jnp.clip(starts[:, None] + r, 0, cap - 1)
+    gather_idx = perm[src]                                 # (ndev, cap)
+
+    recv_counts = jax.lax.all_to_all(counts[:, None], axis, 0, 0)[:, 0]
+    out_rc = jnp.sum(recv_counts)
+    out_live = (jnp.arange(cap, dtype=jnp.int32)[None, :]
+                < recv_counts[:, None]).reshape(-1)
+
+    out_datas = []
+    out_valids = []
+    for d, v in zip(datas, valids):
+        send = jnp.where(slot_valid, d[gather_idx],
+                         jnp.zeros((), d.dtype))
+        recv = jax.lax.all_to_all(send, axis, 0, 0)        # (ndev, cap)
+        out_datas.append(recv.reshape(-1))
+        sendv = jnp.where(slot_valid, v[gather_idx], False)
+        recvv = jax.lax.all_to_all(sendv, axis, 0, 0)
+        out_valids.append(recvv.reshape(-1) & out_live)
+    return tuple(out_datas), tuple(out_valids), out_live, out_rc
+
+
+def make_ici_all_to_all(mesh: Mesh, axis: str = "x"):
+    """Build the jitted SPMD exchange: global arrays have a leading device
+    axis of size mesh.shape[axis]; each device's rows are routed to the
+    device named by their partition id in one all_to_all epoch.
+
+    Returns fn(datas, valids, pids, row_counts) ->
+      (out_datas, out_valids, out_live, out_row_counts)
+    with shapes (D, cap) -> (D, D*cap); out_live marks slots holding rows.
+    """
+    ndev = mesh.shape[axis]
+
+    def spmd(datas, valids, pids, row_counts):
+        body = partial(_local_exchange, ndev, axis)
+        sq = lambda a: a.reshape(a.shape[1:])  # (1, cap) -> (cap,)
+        d = tuple(sq(x) for x in datas)
+        v = tuple(sq(x) for x in valids)
+        od, ov, ol, orc = body(d, v, sq(pids), sq(row_counts))
+        ex = lambda a: a.reshape((1,) + a.shape)
+        return (tuple(ex(x) for x in od), tuple(ex(x) for x in ov),
+                ex(ol), ex(orc))
+
+    spec_in = P(axis, None)
+    spec_scalar = P(axis)
+    mapped = jax.shard_map(
+        spmd, mesh=mesh,
+        in_specs=(spec_in, spec_in, spec_in, spec_scalar),
+        out_specs=(spec_in, spec_in, spec_in, spec_scalar))
+    return jax.jit(mapped)
+
+
+def ici_exchange_batches(mesh: Mesh, datas, valids, pids, row_counts,
+                         axis: str = "x"):
+    """Convenience wrapper: one exchange over already-stacked arrays."""
+    fn = make_ici_all_to_all(mesh, axis)
+    return fn(tuple(datas), tuple(valids), pids, row_counts)

@@ -1,0 +1,94 @@
+"""Shuffle transport interface — the testability seam.
+
+TPU analog of the reference's `RapidsShuffleTransport` abstraction
+(SURVEY.md §2.2-D, §4.3; reference mount empty): the client/server state
+machines there are mockable because the transport is an interface; here
+the same seam separates partition routing from how bytes move. Three
+planned implementations mirroring the reference's fallback ladder
+(SURVEY.md §5.8):
+
+1. `LocalShuffleTransport` — in-process store; the unit-test fake AND the
+   single-process engine path.
+2. host Arrow shuffle — serialized batches through host memory / files
+   (works on any topology).
+3. ICI SPMD exchange — jax.lax.all_to_all over the device mesh for
+   epoch-synchronized stages (shuffle/ici.py).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..columnar.batch import TpuBatch
+
+__all__ = ["ShuffleTransport", "ShuffleWriteHandle",
+           "LocalShuffleTransport"]
+
+
+class ShuffleWriteHandle:
+    """Writer for one map task's output."""
+
+    def write(self, partition_id: int, batch: TpuBatch) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class ShuffleTransport:
+    """Moves per-partition batches between map and reduce sides."""
+
+    def register_shuffle(self, shuffle_id: int, num_partitions: int) -> None:
+        raise NotImplementedError
+
+    def writer(self, shuffle_id: int, map_id: int) -> ShuffleWriteHandle:
+        raise NotImplementedError
+
+    def read_partition(self, shuffle_id: int,
+                       partition_id: int) -> Iterator[TpuBatch]:
+        raise NotImplementedError
+
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        raise NotImplementedError
+
+
+class _LocalWriter(ShuffleWriteHandle):
+    def __init__(self, store, shuffle_id, map_id):
+        self._store = store
+        self._sid = shuffle_id
+        self._mid = map_id
+
+    def write(self, partition_id: int, batch: TpuBatch) -> None:
+        self._store.setdefault(partition_id, []).append(
+            (self._mid, batch))
+
+
+class LocalShuffleTransport(ShuffleTransport):
+    """In-process shuffle store: device batches stay resident, keyed by
+    (shuffle, partition). Doubles as the unit-test mock (SURVEY.md §4.3)
+    and the single-process engine path. Reads return batches ordered by
+    map id (deterministic, mirroring Spark's fetch-in-map-order within a
+    reduce task for our tests)."""
+
+    def __init__(self):
+        self._shuffles: Dict[int, Dict[int, List[Tuple[int, TpuBatch]]]] = {}
+        self._lock = threading.Lock()
+
+    def register_shuffle(self, shuffle_id: int, num_partitions: int):
+        with self._lock:
+            self._shuffles.setdefault(shuffle_id, {})
+
+    def writer(self, shuffle_id: int, map_id: int) -> ShuffleWriteHandle:
+        with self._lock:
+            store = self._shuffles.setdefault(shuffle_id, {})
+        return _LocalWriter(store, shuffle_id, map_id)
+
+    def read_partition(self, shuffle_id: int, partition_id: int):
+        store = self._shuffles.get(shuffle_id, {})
+        entries = sorted(store.get(partition_id, []), key=lambda e: e[0])
+        for _, batch in entries:
+            yield batch
+
+    def unregister_shuffle(self, shuffle_id: int):
+        with self._lock:
+            self._shuffles.pop(shuffle_id, None)
