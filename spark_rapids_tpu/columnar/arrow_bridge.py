@@ -186,6 +186,8 @@ def device_to_arrow(batch: TpuBatch) -> pa.RecordBatch:
     a tunneled device dwarfs the extra padding bytes, so every buffer
     (plus the row count) rides a single device_get."""
     import jax
+    from ..ops.gather import ensure_compacted
+    batch = ensure_compacted(batch)  # arrow slices the live prefix
     leaves = [batch.row_count]
     spans = []
     for c in batch.columns:

@@ -47,14 +47,25 @@ def row_mask(capacity: int, row_count) -> jax.Array:
 
 
 class TpuBatch:
-    __slots__ = ("columns", "schema", "row_count", "_num_rows_cache")
+    """Device batch. Live rows are the prefix below ``row_count`` further
+    restricted by the optional ``selection`` mask — the lazy-filter
+    representation: `TpuFilterExec` attaches a selection instead of paying
+    a full sort-based compaction, and only consumers that need prefix
+    layout (concat, sort gather, arrow download, exchange split) compact
+    (`ops.gather.ensure_compacted`). Mask-aware consumers (aggregate,
+    join, any `live_mask()` user) read through it for free."""
+
+    __slots__ = ("columns", "schema", "row_count", "selection",
+                 "_num_rows_cache")
 
     def __init__(self, columns: List[TpuColumnVector], schema: Schema,
-                 row_count):
+                 row_count, selection=None):
         self.columns = list(columns)
         self.schema = schema
+        self.selection = selection
         if isinstance(row_count, (int, np.integer)):
-            self._num_rows_cache = int(row_count)
+            self._num_rows_cache = int(row_count) if selection is None \
+                else None
             # np scalar, NOT jnp: an eager device op here costs a full
             # host->device dispatch round-trip per batch construction
             row_count = np.int32(row_count)
@@ -70,9 +81,13 @@ class TpuBatch:
 
     @property
     def num_rows(self) -> int:
-        """Actual row count; syncs device->host once and caches."""
+        """Actual live row count; syncs device->host once and caches."""
         if self._num_rows_cache is None:
-            self._num_rows_cache = int(jax.device_get(self.row_count))
+            if self.selection is None:
+                self._num_rows_cache = int(jax.device_get(self.row_count))
+            else:
+                self._num_rows_cache = int(jax.device_get(
+                    _live_count(self)))
         return self._num_rows_cache
 
     @property
@@ -83,7 +98,17 @@ class TpuBatch:
         return self.columns[i]
 
     def live_mask(self) -> jax.Array:
-        return row_mask(self.capacity, self.row_count)
+        m = row_mask(self.capacity, self.row_count)
+        if self.selection is not None:
+            m = m & self.selection
+        return m
+
+    def with_selection(self, keep: jax.Array) -> "TpuBatch":
+        """Restrict live rows by a bool mask (ANDed with any existing
+        selection) without moving data."""
+        sel = keep if self.selection is None else self.selection & keep
+        return TpuBatch(self.columns, self.schema, self.row_count,
+                        selection=sel)
 
     def device_size_bytes(self) -> int:
         return sum(c.device_size_bytes() for c in self.columns)
@@ -91,7 +116,8 @@ class TpuBatch:
     def with_columns(self, columns, schema=None, row_count=None):
         return TpuBatch(columns,
                         self.schema if schema is None else schema,
-                        self.row_count if row_count is None else row_count)
+                        self.row_count if row_count is None else row_count,
+                        selection=self.selection)
 
     def block_until_ready(self):
         for c in self.columns:
@@ -104,13 +130,18 @@ class TpuBatch:
                 f"cols={len(self.columns)}, schema={self.schema})")
 
 
+def _live_count(b: TpuBatch):
+    import jax.numpy as jnp
+    return jnp.sum(b.live_mask().astype(jnp.int32))
+
+
 def _flatten_batch(b: TpuBatch):
-    return (b.columns, b.row_count), b.schema
+    return (b.columns, b.row_count, b.selection), b.schema
 
 
 def _unflatten_batch(schema, children):
-    columns, row_count = children
-    return TpuBatch(columns, schema, row_count)
+    columns, row_count, selection = children
+    return TpuBatch(columns, schema, row_count, selection=selection)
 
 
 jax.tree_util.register_pytree_node(TpuBatch, _flatten_batch, _unflatten_batch)

@@ -99,6 +99,13 @@ SESSION_TZ = register(
     "Session time zone; the TPU path supports UTC only (like early "
     "spark-rapids), other zones fall back per-expression.")
 
+STAGE_FUSION = register(
+    "spark.rapids.sql.stageFusion.enabled", True,
+    "Compose chains of per-batch operators (project/filter/aggregate "
+    "partial) into one XLA program per batch — the whole-stage-codegen "
+    "analog. Filters stay as lazy selection masks inside a fused stage "
+    "instead of paying stream compaction.")
+
 # --- Batching / memory ----------------------------------------------------
 BATCH_SIZE_BYTES = register(
     "spark.rapids.sql.batchSizeBytes", 1 << 30,

@@ -27,7 +27,7 @@ from ..expr.base import Alias, Expression, bind_expr
 from ..ops.concat import concat_batches
 from ..ops.gather import gather_column
 from ..ops.sort_keys import segment_ids_for_keys
-from .base import ExecCtx, TpuExec, UnaryExec
+from .base import ExecCtx, TpuExec, UnaryExec, fused_batches
 from .basic import bind_all
 
 __all__ = ["TpuHashAggregateExec"]
@@ -183,11 +183,10 @@ class TpuHashAggregateExec(UnaryExec):
             self._jit_partial = jax.jit(self._partial, static_argnums=1)
             self._jit_final = jax.jit(self._final, static_argnums=1)
         op_time = ctx.metric(self, "opTime")
-        partials = []
-        for b in self.child.execute(ctx):
-            t0 = time.perf_counter()
-            partials.append(self._jit_partial(b, ctx.eval_ctx))
-            op_time.value += time.perf_counter() - t0
+        # the partial phase fuses with the project/filter chain feeding it
+        # into one XLA program per batch (fused_batches)
+        partials = list(fused_batches(self, ctx, tail_fn=self._partial,
+                                      metric=op_time))
         t0 = time.perf_counter()
         if not partials:
             if self.group_exprs:
@@ -252,7 +251,7 @@ class TpuHashAggregateExec(UnaryExec):
         out_rows_aggs = []
         for key, buckets in groups.items():
             out_rows_keys.append(key_values[key])
-            out_rows_aggs.append([a.cpu_agg(vals)
+            out_rows_aggs.append([a.cpu_agg(vals, ctx.eval_ctx)
                                   for a, vals in zip(self.aggs, buckets)])
         arrays = []
         for i, f in enumerate(self._schema.fields):

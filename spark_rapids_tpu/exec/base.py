@@ -29,7 +29,8 @@ from ..config import RapidsConf
 from ..expr.base import EvalCtx
 
 __all__ = ["ExecCtx", "TpuMetric", "TpuExec", "LeafExec", "UnaryExec",
-           "HostBatchSourceExec", "collect_arrow", "collect_arrow_cpu"]
+           "HostBatchSourceExec", "collect_arrow", "collect_arrow_cpu",
+           "fused_batches"]
 
 
 class TpuMetric:
@@ -66,6 +67,8 @@ class ExecCtx:
         # (cheap, pipelining preserved).
         self.sync_metrics = \
             self.conf.get("spark.rapids.sql.metrics.level") == "DEBUG"
+        from ..config import STAGE_FUSION
+        self.stage_fusion = self.conf.get(STAGE_FUSION)
 
     def metric(self, node: "TpuExec", name: str) -> TpuMetric:
         m = self.metrics.setdefault(node.node_label(), {})
@@ -102,6 +105,13 @@ class TpuExec:
         """None if runnable on TPU, else the willNotWorkOnTpu reason."""
         return None
 
+    def device_fn(self):
+        """Pure per-batch device function `(TpuBatch, EvalCtx) -> TpuBatch`
+        when this operator is a row-wise map over one batch (project,
+        filter-as-selection-mask) — the unit of stage fusion. None for
+        barriers (sort, aggregate, exchange) and multi-batch operators."""
+        return None
+
     # --- execution --------------------------------------------------------
     def execute(self, ctx: ExecCtx) -> Iterator[TpuBatch]:
         raise NotImplementedError(type(self).__name__)
@@ -121,6 +131,52 @@ class TpuExec:
 
     def __repr__(self):
         return self.tree_string()
+
+
+def fused_batches(consumer: TpuExec, ctx: ExecCtx, tail_fn=None,
+                  metric: Optional[TpuMetric] = None) -> Iterator[TpuBatch]:
+    """Stream the device batches feeding `consumer`, composing the chain of
+    fusable operators below it — plus the consumer's own per-batch
+    `tail_fn` — into ONE jitted XLA program per batch: the
+    whole-stage-codegen analog (reference: operator-at-a-time cudf calls;
+    here XLA fuses the chain into one kernel schedule, eliding intermediate
+    HBM materialization). Falls back to per-op execution when
+    `spark.rapids.sql.stageFusion.enabled` is off."""
+    import jax
+
+    node = consumer.children[0]
+    fns = []
+    if ctx.stage_fusion:
+        while isinstance(node, UnaryExec) and node.device_fn() is not None:
+            fns.append(node.device_fn())
+            node = node.children[0]
+        fns.reverse()
+    if tail_fn is not None:
+        fns.append(tail_fn)
+    if not fns:
+        yield from node.execute(ctx)
+        return
+    cache = consumer.__dict__.setdefault("_fused_jit_cache", {})
+    key = len(fns)
+    jitted = cache.get(key)
+    if jitted is None:
+        def composed(b, ectx):
+            for f in fns:
+                b = f(b, ectx)
+            return b
+        jitted = jax.jit(composed, static_argnums=1)
+        cache[key] = jitted
+    rows = ctx.metric(consumer, "numOutputRows") if ctx.sync_metrics \
+        else None
+    for b in node.execute(ctx):
+        t0 = time.perf_counter()
+        out = jitted(b, ctx.eval_ctx)
+        if ctx.sync_metrics:
+            out.block_until_ready()
+            rows += out.num_rows  # syncs; DEBUG metrics mode only
+        if metric is not None:
+            metric.value += time.perf_counter() - t0
+        yield out
 
 
 class LeafExec(TpuExec):

@@ -2,15 +2,15 @@
 
 TPU analog of the reference's `basicPhysicalOperators.scala`
 (`GpuProjectExec`, `GpuFilterExec`, `GpuRangeExec` — SURVEY.md §2.2-B;
-reference mount empty). Filter is prefix-sum + gather compaction into the
-same static capacity (SURVEY.md §7.1.3, §7.3.1).
+reference mount empty). Filter is LAZY: it attaches a selection mask to
+the batch (columnar/batch.py) instead of paying stream compaction; prefix
+layout is restored by ensure_compacted only at consumers that need it
+(SURVEY.md §7.1.3, §7.3.1).
 """
 from __future__ import annotations
 
-import time
 from typing import List, Optional, Sequence
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
@@ -20,8 +20,7 @@ from .. import datatypes as dt
 from ..columnar.batch import TpuBatch, bucket_rows
 from ..columnar.column import TpuColumnVector
 from ..expr.base import Alias, Expression, bind_expr
-from ..ops.gather import compact_batch
-from .base import ExecCtx, LeafExec, TpuExec, UnaryExec
+from .base import ExecCtx, LeafExec, TpuExec, UnaryExec, fused_batches
 
 __all__ = ["TpuProjectExec", "TpuFilterExec", "TpuRangeExec",
            "output_schema_for", "bind_all"]
@@ -47,7 +46,6 @@ class TpuProjectExec(UnaryExec):
         super().__init__(child)
         self.exprs = bind_all(exprs, child.output_schema)
         self._schema = output_schema_for(self.exprs)
-        self._jitted = None
 
     @property
     def output_schema(self):
@@ -58,21 +56,16 @@ class TpuProjectExec(UnaryExec):
 
     def _run(self, batch: TpuBatch, ectx) -> TpuBatch:
         cols = [e.eval_tpu(batch, ectx) for e in self.exprs]
-        return TpuBatch(cols, self._schema, batch.row_count)
+        return TpuBatch(cols, self._schema, batch.row_count,
+                        selection=batch.selection)
+
+    def device_fn(self):
+        return self._run
 
     def execute(self, ctx: ExecCtx):
-        if self._jitted is None:
-            self._jitted = jax.jit(self._run, static_argnums=1)
         op_time = ctx.metric(self, "opTime")
-        rows = ctx.metric(self, "numOutputRows")
-        for batch in self.child.execute(ctx):
-            t0 = time.perf_counter()
-            out = self._jitted(batch, ctx.eval_ctx)
-            if ctx.sync_metrics:
-                out.block_until_ready()
-                rows += out.num_rows  # syncs; only in DEBUG metrics mode
-            op_time.value += time.perf_counter() - t0
-            yield out
+        yield from fused_batches(self, ctx, tail_fn=self._run,
+                                 metric=op_time)
 
     def execute_cpu(self, ctx: ExecCtx):
         from ..columnar.arrow_bridge import arrow_schema
@@ -94,7 +87,6 @@ class TpuFilterExec(UnaryExec):
             raise TypeError(
                 f"filter condition must be boolean, got "
                 f"{self.condition.dtype.simple_string()}")
-        self._jitted = None
 
     def describe(self):
         return f"FilterExec [{self.condition!r}]"
@@ -103,19 +95,23 @@ class TpuFilterExec(UnaryExec):
         pred = self.condition.eval_tpu(batch, ectx)
         # SQL filter keeps only rows where the predicate is TRUE (not null).
         keep = pred.data & pred.validity
-        return compact_batch(batch, keep)
+        # Lazy filter: attach a selection mask instead of paying sort-based
+        # stream compaction; consumers that need prefix layout compact via
+        # ops.gather.ensure_compacted. Dead rows also become invalid so
+        # every null-aware kernel (and any validity-gated ANSI error
+        # check) skips them exactly as if they were gone.
+        out = batch.with_selection(keep)
+        out.columns = [c.with_arrays(validity=c.validity & keep)
+                       for c in out.columns]
+        return out
+
+    def device_fn(self):
+        return self._run
 
     def execute(self, ctx: ExecCtx):
-        if self._jitted is None:
-            self._jitted = jax.jit(self._run, static_argnums=1)
         op_time = ctx.metric(self, "opTime")
-        for batch in self.child.execute(ctx):
-            t0 = time.perf_counter()
-            out = self._jitted(batch, ctx.eval_ctx)
-            if ctx.sync_metrics:
-                out.block_until_ready()
-            op_time.value += time.perf_counter() - t0
-            yield out
+        yield from fused_batches(self, ctx, tail_fn=self._run,
+                                 metric=op_time)
 
     def execute_cpu(self, ctx: ExecCtx):
         for rb in self.child.execute_cpu(ctx):

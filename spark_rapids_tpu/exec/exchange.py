@@ -3,9 +3,9 @@
 TPU analog of the reference's `GpuShuffleExchangeExecBase`,
 `GpuBroadcastExchangeExec`, `GpuCoalesceBatches`, `GpuShuffleCoalesceExec`
 (SURVEY.md §2.2-A/B/D; reference mount empty). The single-process engine
-uses the LocalShuffleTransport seam; partition split is per-partition
-stream compaction (the contiguous_split analog). The ICI SPMD all-to-all
-path plugs in behind the same seam (shuffle/ici.py).
+uses the LocalShuffleTransport seam; partition split emits selection-mask
+views sharing the input's buffers (lazy contiguous_split analog). The ICI
+SPMD all-to-all path plugs in behind the same seam (shuffle/ici.py).
 """
 from __future__ import annotations
 
@@ -21,7 +21,6 @@ import pyarrow as pa
 from .. import datatypes as dt
 from ..columnar.batch import TpuBatch
 from ..ops.concat import concat_batches
-from ..ops.gather import compact_batch
 from ..shuffle.partitioner import Partitioning, SinglePartitioning
 from ..shuffle.transport import LocalShuffleTransport, ShuffleTransport
 from .base import ExecCtx, TpuExec, UnaryExec
@@ -48,14 +47,19 @@ class TpuShuffleExchangeExec(UnaryExec):
         return (f"ShuffleExchangeExec [{type(self.partitioning).__name__} "
                 f"n={self.partitioning.num_partitions}]")
 
-    def _split(self, batch: TpuBatch, part: int, ectx) -> TpuBatch:
+    def _split(self, batch: TpuBatch, ectx):
+        """All partitions in ONE traced call: compute pids once, emit one
+        selection-masked view per partition. The views share the input's
+        device buffers — an n-way split costs one pids kernel and n bool
+        masks, not n stream compactions holding n full copies (the
+        contiguous_split analog, lazy edition)."""
         pids = self.partitioning.partition_ids_device(batch, ectx)
-        return compact_batch(batch, pids == part)
+        return tuple(batch.with_selection(pids == jnp.int32(p))
+                     for p in range(self.partitioning.num_partitions))
 
     def execute(self, ctx: ExecCtx):
         if self._jit_split is None:
-            self._jit_split = jax.jit(self._split,
-                                      static_argnums=(1, 2))
+            self._jit_split = jax.jit(self._split, static_argnums=1)
         n = self.partitioning.num_partitions
         sid = next(_shuffle_ids)
         self.transport.register_shuffle(sid, n)
@@ -68,8 +72,9 @@ class TpuShuffleExchangeExec(UnaryExec):
             if n == 1:
                 writer.write(0, batch)
             else:
+                parts = self._jit_split(batch, ctx.eval_ctx)
                 for p in range(n):
-                    writer.write(p, self._jit_split(batch, p, ctx.eval_ctx))
+                    writer.write(p, parts[p])
             op_time.value += time.perf_counter() - t0
             writer.close()
         try:

@@ -199,6 +199,13 @@ class _BaseJoinExec(TpuExec):
         return arrow_to_device(rb, schema)
 
     def execute(self, ctx: ExecCtx):
+        if self.tpu_supported() is not None:
+            # device post-filtering is wrong for outer joins and
+            # out-of-range for semi/anti (left-only output vs left+right
+            # cond schema); fail loudly on the DEVICE path instead of
+            # trusting the planner to honor tpu_supported(). The CPU
+            # oracle (execute_cpu) handles these correctly.
+            raise NotImplementedError(self.tpu_supported())
         op_time = ctx.metric(self, "opTime")
         t0 = time.perf_counter()
         rbatch = self._build_right(ctx)

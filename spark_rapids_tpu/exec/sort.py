@@ -54,9 +54,14 @@ def sort_batch_by(batch: TpuBatch, orders: Sequence[SortOrder],
     dispatch round-trip per batch)."""
     import jax.numpy as jnp
     key_cols = [o.child.eval_tpu(batch, ectx) for o in orders]
-    perm = sort_permutation(key_cols, [o.spec for o in orders],
-                            batch.live_mask())
-    rc = batch.row_count
+    live = batch.live_mask()
+    perm = sort_permutation(key_cols, [o.spec for o in orders], live)
+    if batch.selection is None:
+        rc = batch.row_count
+    else:
+        # lazy-filter batch: dead rows sort last (live-rank lane), so the
+        # live count is the new prefix length — sort absorbs compaction
+        rc = jnp.sum(live.astype(jnp.int32))
     if limit is not None:
         rc = jnp.minimum(rc, jnp.int32(limit))
     return gather_batch(batch, perm, rc)
@@ -158,10 +163,12 @@ class TpuLocalLimitExec(UnaryExec):
         return f"LocalLimitExec [{self.limit}]"
 
     def execute(self, ctx: ExecCtx):
+        from ..ops.gather import ensure_compacted
         remaining = self.limit
         for batch in self.child.execute(ctx):
             if remaining <= 0:
                 return
+            batch = ensure_compacted(batch)  # truncation needs prefix rows
             n = batch.num_rows
             if n <= remaining:
                 remaining -= n

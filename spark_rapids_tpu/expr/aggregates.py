@@ -101,7 +101,7 @@ class AggregateFunction(Expression):
             -> TpuColumnVector:
         raise NotImplementedError
 
-    def cpu_agg(self, values: List):
+    def cpu_agg(self, values: List, ectx=None):
         raise NotImplementedError
 
 
@@ -154,33 +154,60 @@ class Sum(AggregateFunction):
     def _acc(self):
         return _F64 if dt.is_floating(self.dtype) else _I64
 
+    def _null_overflowed(self, s, valid):
+        """Decimal sum overflow -> NULL (Spark non-ANSI): null groups whose
+        unscaled |sum| exceeds the result precision's max. Detectable up to
+        int64 wrap (|sum| < 2^63); beyond that the accumulator itself
+        wrapped — same bound as a 128-bit cudf accumulator overflowing."""
+        t = self.dtype
+        if not isinstance(t, dt.DecimalType):
+            return valid
+        max_unscaled = 10 ** min(t.precision,
+                                 dt.DecimalType.MAX_INT64_PRECISION) - 1
+        return valid & (jnp.abs(s) <= max_unscaled)
+
     def update_device(self, vals, seg, sorted_live, out_live):
         cap = _out_cap(seg)
         s, cnt = _sum_lanes(vals[0], seg, sorted_live, cap, self._acc())
-        return [TpuColumnVector(self.dtype, data=s,
-                                validity=(cnt > 0) & out_live)]
+        valid = self._null_overflowed(s, (cnt > 0) & out_live)
+        return [TpuColumnVector(self.dtype, data=s, validity=valid)]
 
     def merge_device(self, bufs, seg, sorted_live, out_live):
         cap = _out_cap(seg)
         s, cnt = _sum_lanes(bufs[0], seg, sorted_live, cap, self._acc())
-        return [TpuColumnVector(self.dtype, data=s,
-                                validity=(cnt > 0) & out_live)]
+        valid = self._null_overflowed(s, (cnt > 0) & out_live)
+        return [TpuColumnVector(self.dtype, data=s, validity=valid)]
 
     def evaluate_device(self, bufs):
         return bufs[0]
 
-    def cpu_agg(self, values):
+    def cpu_agg(self, values, ectx=None):
         vals = [v for v in values if v is not None]
         if not vals:
             return None
         t = self.dtype
         if isinstance(t, dt.DecimalType):
             total = sum(vals, decimal.Decimal(0))
+            unscaled = int(total.scaleb(t.scale))
+            # Spark semantics: overflow past the RESULT precision (p+10,
+            # up to 38) -> NULL (non-ANSI) / error (ANSI). The device cap
+            # of 18 digits does not leak into the oracle; result types
+            # wider than 18 are device-unsupported (tpu_supported) and
+            # run through this CPU path only.
+            if abs(unscaled) > 10 ** t.precision - 1:
+                if ectx is not None and ectx.ansi:
+                    from .base import ExprError
+                    raise ExprError("decimal sum overflow (ANSI mode)")
+                return None  # Spark non-ANSI: overflow -> NULL
             return total.quantize(decimal.Decimal(1).scaleb(-t.scale))
         if dt.is_floating(t):
             return float(sum(float(v) for v in vals))
         total = sum(int(v) for v in vals)
-        total &= (1 << 64) - 1  # java long wrap-around
+        if ectx is not None and ectx.ansi and not (
+                -(1 << 63) <= total < (1 << 63)):
+            from .base import ExprError
+            raise ExprError("long sum overflow (ANSI mode)")
+        total &= (1 << 64) - 1  # java long wrap-around (non-ANSI)
         return total - (1 << 64) if total >= (1 << 63) else total
 
 
@@ -220,7 +247,7 @@ class Count(AggregateFunction):
     def evaluate_device(self, bufs):
         return bufs[0]
 
-    def cpu_agg(self, values):
+    def cpu_agg(self, values, ectx=None):
         if not self.children:
             return len(values)
         return sum(1 for v in values if v is not None)
@@ -290,7 +317,7 @@ class _MinMax(AggregateFunction):
     def evaluate_device(self, bufs):
         return bufs[0]
 
-    def cpu_agg(self, values):
+    def cpu_agg(self, values, ectx=None):
         vals = [v for v in values if v is not None]
         if not vals:
             return None
@@ -387,7 +414,7 @@ class Average(AggregateFunction):
         return TpuColumnVector(dt.FLOAT64, data=s.data / den,
                                validity=valid)
 
-    def cpu_agg(self, values):
+    def cpu_agg(self, values, ectx=None):
         vals = [v for v in values if v is not None]
         if not vals:
             return None
@@ -454,7 +481,7 @@ class _FirstLast(AggregateFunction):
     def evaluate_device(self, bufs):
         return bufs[0]
 
-    def cpu_agg(self, values):
+    def cpu_agg(self, values, ectx=None):
         seq = values if not self.take_last else list(reversed(values))
         for v in seq:
             if v is not None or not self.ignore_nulls:
@@ -525,15 +552,17 @@ class _CentralMoment(AggregateFunction):
         n, _, m2 = (b.data for b in bufs)
         m2 = jnp.maximum(m2, 0.0)
         if self.sample:
-            var = jnp.where(n > 1, m2 / jnp.where(n > 1, n - 1, 1.0),
-                            jnp.nan)
+            # Spark 3.1+ (spark.sql.legacy.statisticalAggregate=false):
+            # sample variance of a single value is NULL, not NaN
+            var = m2 / jnp.where(n > 1, n - 1, 1.0)
+            valid = bufs[0].validity & (n > 1)
         else:
-            var = jnp.where(n > 0, m2 / jnp.where(n > 0, n, 1.0), jnp.nan)
+            var = m2 / jnp.where(n > 0, n, 1.0)
+            valid = bufs[0].validity & (n > 0)
         out = jnp.sqrt(var) if self.take_sqrt else var
-        return TpuColumnVector(dt.FLOAT64, data=out,
-                               validity=bufs[0].validity & (n > 0))
+        return TpuColumnVector(dt.FLOAT64, data=out, validity=valid)
 
-    def cpu_agg(self, values):
+    def cpu_agg(self, values, ectx=None):
         vals = [float(v) for v in values if v is not None]
         n = len(vals)
         if n == 0:
@@ -541,11 +570,12 @@ class _CentralMoment(AggregateFunction):
         mean = sum(vals) / n
         m2 = sum((v - mean) ** 2 for v in vals)
         if self.sample:
-            var = m2 / (n - 1) if n > 1 else float("nan")
+            if n <= 1:
+                return None  # nullOnDivideByZero (Spark 3.1+ default)
+            var = m2 / (n - 1)
         else:
             var = m2 / n
-        return math.sqrt(var) if self.take_sqrt and not math.isnan(var) \
-            else (float("nan") if math.isnan(var) else var)
+        return math.sqrt(var) if self.take_sqrt else var
 
 
 class VarianceSamp(_CentralMoment):

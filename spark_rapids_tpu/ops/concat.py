@@ -113,6 +113,8 @@ def concat_batches_bounded(batches: List[TpuBatch]) -> TpuBatch:
     needed — one RPC saved per merge, at the cost of up to 2x padding.
     Use when capacities are already tight (e.g. shrunk aggregate
     partials); use concat_batches when exact sizing matters."""
+    from .gather import ensure_compacted
+    batches = [ensure_compacted(b) for b in batches]
     if len(batches) == 1:
         return batches[0]
     ncols = len(batches[0].schema)
@@ -138,6 +140,8 @@ def concat_batches(batches: List[TpuBatch]) -> TpuBatch:
     """Host wrapper: sync row counts, size the output, run the jitted
     concat. One compiled program per (input capacities, output capacity)
     combination — bounded by the power-of-two bucketing."""
+    from .gather import ensure_compacted
+    batches = [ensure_compacted(b) for b in batches]
     if len(batches) == 1:
         return batches[0]
     ncols = len(batches[0].schema)

@@ -16,7 +16,8 @@ from ..columnar.column import TpuColumnVector
 from .strings import gather_strings
 
 __all__ = ["compaction_indices", "exclusive_cumsum", "invert_permutation",
-           "gather_column", "gather_batch", "compact_batch"]
+           "gather_column", "gather_batch", "compact_batch",
+           "ensure_compacted"]
 
 
 def exclusive_cumsum(x: jax.Array) -> jax.Array:
@@ -152,4 +153,19 @@ def compact_batch(batch: TpuBatch, keep: jax.Array) -> TpuBatch:
     """Stream compaction: keep rows where `keep` (padding excluded here)."""
     keep = keep & batch.live_mask()
     indices, count = compaction_indices(keep)
-    return gather_batch(batch, indices, count)
+    return gather_batch(batch, indices, count)  # prefix layout, no selection
+
+
+@jax.jit
+def _compact_selection(batch: TpuBatch) -> TpuBatch:
+    return compact_batch(batch, batch.live_mask())
+
+
+def ensure_compacted(batch: TpuBatch) -> TpuBatch:
+    """Materialize a lazy selection mask (TpuBatch docstring) into prefix
+    layout; no-op (and no dispatch) when the batch has no selection.
+    Callable from host code or inside traced code (the selection check is
+    static; nested jit inlines)."""
+    if batch.selection is None:
+        return batch
+    return _compact_selection(batch)

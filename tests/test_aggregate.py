@@ -215,3 +215,59 @@ def test_stddev_large_mean_no_cancellation():
                      Alias(StddevSamp(col("v")), "ss")])
     assert_tpu_and_cpu_plan_equal(plan, ignore_order=True,
                                   approx_float=True)
+
+
+def test_stddev_samp_single_element_group_is_null():
+    """Spark 3.1+ (legacy.statisticalAggregate=false): sample stddev/var
+    of a single value is NULL, not NaN (advisor round-1 medium)."""
+    rb = pa.RecordBatch.from_arrays(
+        [pa.array([1, 2, 2], pa.int32()),
+         pa.array([5.0, 7.0, 9.0], pa.float64())], names=["c0", "c1"])
+    for fn in (StddevSamp, VarianceSamp):
+        plan = agg_plan(HostBatchSourceExec([rb]), [col("c0")],
+                        [Alias(fn(col("c1")), "v")])
+        assert_tpu_and_cpu_plan_equal(plan, ignore_order=True,
+                                      approx_float=True)
+        from spark_rapids_tpu.exec.base import ExecCtx, collect_arrow
+        out = collect_arrow(plan)
+        by_key = dict(zip(out.column(0).to_pylist(),
+                          out.column(1).to_pylist()))
+        assert by_key[1] is None  # single-element group
+        assert by_key[2] is not None
+
+
+def test_decimal_sum_overflow_semantics():
+    """Sum over wide decimals: the oracle follows Spark (overflow vs the
+    REAL result precision p+10, up to 38), the device caps at 18 digits
+    and flags itself unsupported for wider results (advisor round-1)."""
+    import decimal
+    from spark_rapids_tpu.expr.base import BoundReference, EvalCtx, ExprError
+    # result decimal(28,0): device-unsupported, oracle returns true sum
+    big = decimal.Decimal("900000000000000000")  # 9e17, precision 18
+    rb = pa.RecordBatch.from_arrays(
+        [pa.array([1, 1], pa.int32()),
+         pa.array([big, big], pa.decimal128(18, 0))], names=["c0", "c1"])
+    plan = agg_plan(HostBatchSourceExec([rb]), [col("c0")],
+                    [Alias(Sum(col("c1")), "s")])
+    assert plan.tpu_supported() is not None  # falls back, oracle rules
+    from spark_rapids_tpu.exec.base import collect_arrow_cpu
+    out = collect_arrow_cpu(plan)
+    assert out.column(1).to_pylist() == [decimal.Decimal(2) * big]
+    # direct oracle: overflow past precision 38 -> NULL / ANSI error
+    s38 = Sum(BoundReference(0, dt.DecimalType(28, 0), True))
+    huge = decimal.Decimal(10) ** 37 * 9  # 9e37; two of them pass 10^38
+    assert s38.cpu_agg([huge, huge]) is None
+    try:
+        s38.cpu_agg([huge, huge], EvalCtx(ansi=True))
+        assert False, "expected ExprError"
+    except ExprError:
+        pass
+    # long sum ANSI overflow -> error; non-ANSI wraps like java
+    slong = Sum(BoundReference(0, dt.INT64, True))
+    wrap = slong.cpu_agg([2 ** 62, 2 ** 62])
+    assert wrap == -(2 ** 63)
+    try:
+        slong.cpu_agg([2 ** 62, 2 ** 62], EvalCtx(ansi=True))
+        assert False, "expected ExprError"
+    except ExprError:
+        pass

@@ -178,3 +178,23 @@ def test_join_strings_payload():
         plan = TpuShuffledHashJoinExec([col("lk")], [col("rk")], jt, left,
                                        right)
         assert_tpu_and_cpu_plan_equal(plan, label=jt)
+
+
+def test_non_equi_condition_rejected_on_non_inner_join():
+    """Device execute refuses conditions on join types where post-filtering
+    is semantically wrong; the CPU oracle still runs them (advisor
+    round-1)."""
+    from data_gen import gen_table
+    from spark_rapids_tpu.exec.base import ExecCtx, collect_arrow_cpu
+    left = HostBatchSourceExec(
+        [gen_table([IntegerGen(min_val=0, max_val=5), IntegerGen()], 32, 1)])
+    right = HostBatchSourceExec(
+        [gen_table([IntegerGen(min_val=0, max_val=5), IntegerGen()], 32, 2,
+                   names=["k", "v"])])
+    j = TpuShuffledHashJoinExec([col("c0")], [col("k")], "left_outer",
+                                left, right,
+                                condition=GreaterThan(col("c1"), col("v")))
+    assert j.tpu_supported() is not None
+    with pytest.raises(NotImplementedError):
+        list(j.execute(ExecCtx()))
+    assert collect_arrow_cpu(j).num_rows >= 32  # oracle path works
