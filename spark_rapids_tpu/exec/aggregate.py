@@ -110,6 +110,9 @@ class TpuHashAggregateExec(UnaryExec):
                 return r
         return None
 
+    def expressions(self):
+        return list(self.group_exprs) + list(self.aggs)
+
     # --- device phases ----------------------------------------------------
 
     def _group_and_gather(self, key_cols, extra_cols, live):

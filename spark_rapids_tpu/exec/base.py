@@ -112,6 +112,26 @@ class TpuExec:
         barriers (sort, aggregate, exchange) and multi-batch operators."""
         return None
 
+    def expressions(self) -> Sequence["object"]:
+        """The expression trees this operator evaluates — walked by the
+        planner for per-expression eligibility tagging (the RapidsMeta
+        childExprs analog)."""
+        return ()
+
+    def with_new_children(self, children: Sequence["TpuExec"]) -> "TpuExec":
+        """Rebuild this node over new children (planner transition
+        insertion). Default: shallow copy with the children tuple swapped —
+        valid because transitions preserve the child's output schema, so
+        bound expression ordinals stay correct. Nodes with internal wiring
+        (TopN) override."""
+        import copy as _copy
+        if len(children) == len(self.children) and \
+                all(c is o for c, o in zip(children, self.children)):
+            return self
+        clone = _copy.copy(self)
+        clone.children = tuple(children)
+        return clone
+
     # --- execution --------------------------------------------------------
     def execute(self, ctx: ExecCtx) -> Iterator[TpuBatch]:
         raise NotImplementedError(type(self).__name__)
@@ -157,7 +177,9 @@ def fused_batches(consumer: TpuExec, ctx: ExecCtx, tail_fn=None,
         yield from node.execute(ctx)
         return
     cache = consumer.__dict__.setdefault("_fused_jit_cache", {})
-    key = len(fns)
+    # key on the identity of each fn's owning op: chains can be rebuilt
+    # (planner transitions) without changing length
+    key = tuple(id(getattr(f, "__self__", f)) for f in fns)
     jitted = cache.get(key)
     if jitted is None:
         def composed(b, ectx):

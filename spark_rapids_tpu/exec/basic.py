@@ -54,6 +54,9 @@ class TpuProjectExec(UnaryExec):
     def describe(self):
         return f"ProjectExec [{', '.join(map(repr, self.exprs))}]"
 
+    def expressions(self):
+        return self.exprs
+
     def _run(self, batch: TpuBatch, ectx) -> TpuBatch:
         cols = [e.eval_tpu(batch, ectx) for e in self.exprs]
         return TpuBatch(cols, self._schema, batch.row_count,
@@ -90,6 +93,9 @@ class TpuFilterExec(UnaryExec):
 
     def describe(self):
         return f"FilterExec [{self.condition!r}]"
+
+    def expressions(self):
+        return (self.condition,)
 
     def _run(self, batch: TpuBatch, ectx) -> TpuBatch:
         pred = self.condition.eval_tpu(batch, ectx)

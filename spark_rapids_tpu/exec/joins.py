@@ -100,6 +100,12 @@ class _BaseJoinExec(TpuExec):
                     "on device")
         return None
 
+    def expressions(self):
+        out = list(self.left_keys) + list(self.right_keys)
+        if self.condition is not None:
+            out.append(self.condition)
+        return out
+
     def describe(self):
         c = f" cond={self.condition!r}" if self.condition is not None \
             else ""

@@ -112,6 +112,9 @@ class TpuSortExec(UnaryExec):
             f"{'FIRST' if o.nulls_first else 'LAST'}" for o in self.orders)
         return f"SortExec [{keys}] global={self.global_sort}"
 
+    def expressions(self):
+        return [o.child for o in self.orders]
+
     def execute(self, ctx: ExecCtx):
         if self._jitted is None:
             self._jitted = jax.jit(sort_batch_by, static_argnums=(1, 2))
@@ -238,6 +241,8 @@ class TpuTopNExec(UnaryExec):
                  project: Optional[Sequence[Expression]] = None):
         super().__init__(child)
         self.limit = limit
+        self._ctor_orders = list(orders)
+        self._ctor_project = list(project) if project is not None else None
         bound = [dataclasses.replace(
             o, child=bind_expr(o.child, child.output_schema))
             for o in orders]
@@ -256,6 +261,20 @@ class TpuTopNExec(UnaryExec):
 
     def describe(self):
         return f"TopNExec [{self.limit}] {self._sort.describe()}"
+
+    def expressions(self):
+        out = [o.child for o in self._sort.orders]
+        if self._ctor_project is not None:
+            out.extend(self._out.exprs)
+        return out
+
+    def with_new_children(self, children):
+        if children[0] is self.child:
+            return self
+        # internal pipeline (pre-topN -> sort -> limit -> project) is wired
+        # to the child at construction; rebuild it over the new child
+        return TpuTopNExec(self.limit, self._ctor_orders, children[0],
+                           project=self._ctor_project)
 
     def execute(self, ctx: ExecCtx):
         return self._out.execute(ctx)

@@ -1,0 +1,54 @@
+"""Host <-> device transition operators.
+
+TPU analog of the reference's `GpuRowToColumnarExec` / `GpuColumnarToRowExec`
+(SURVEY.md §2.2-A "Row<->columnar transitions"; reference mount empty built
+from capability description). The planner (planner.py) inserts these at the
+boundaries between device subtrees and CPU-fallback islands, exactly where
+the reference's GpuTransitionOverrides inserts its transitions.
+
+The host currency is pyarrow RecordBatches (the Arrow C Data boundary the
+JVM side would speak); the device currency is TpuBatch.
+"""
+from __future__ import annotations
+
+import time
+
+from ..columnar.arrow_bridge import arrow_to_device, device_to_arrow
+from .base import ExecCtx, TpuExec, UnaryExec
+
+__all__ = ["DeviceToHostExec", "HostToDeviceExec"]
+
+
+class DeviceToHostExec(UnaryExec):
+    """Bridge a device child into a CPU island: ``execute_cpu`` downloads
+    the child's device batches as Arrow (GpuColumnarToRowExec analog)."""
+
+    def execute(self, ctx: ExecCtx):
+        # transparent on the device side (planner only uses the cpu path,
+        # but a no-op passthrough keeps the tree runnable either way)
+        yield from self.child.execute(ctx)
+
+    def execute_cpu(self, ctx: ExecCtx):
+        t = ctx.metric(self, "downloadTime")
+        for b in self.child.execute(ctx):
+            t0 = time.perf_counter()
+            rb = device_to_arrow(b)
+            t.value += time.perf_counter() - t0
+            yield rb
+
+
+class HostToDeviceExec(UnaryExec):
+    """Bridge a CPU-island child back onto the device: ``execute`` uploads
+    the child's Arrow batches (GpuRowToColumnarExec analog)."""
+
+    def execute(self, ctx: ExecCtx):
+        t = ctx.metric(self, "uploadTime")
+        schema = self.child.output_schema
+        for rb in self.child.execute_cpu(ctx):
+            t0 = time.perf_counter()
+            b = arrow_to_device(rb, schema)
+            t.value += time.perf_counter() - t0
+            yield b
+
+    def execute_cpu(self, ctx: ExecCtx):
+        yield from self.child.execute_cpu(ctx)

@@ -187,19 +187,21 @@ class Sum(AggregateFunction):
             return None
         t = self.dtype
         if isinstance(t, dt.DecimalType):
-            total = sum(vals, decimal.Decimal(0))
-            unscaled = int(total.scaleb(t.scale))
-            # Spark semantics: overflow past the RESULT precision (p+10,
-            # up to 38) -> NULL (non-ANSI) / error (ANSI). The device cap
-            # of 18 digits does not leak into the oracle; result types
-            # wider than 18 are device-unsupported (tpu_supported) and
-            # run through this CPU path only.
-            if abs(unscaled) > 10 ** t.precision - 1:
-                if ectx is not None and ectx.ansi:
-                    from .base import ExprError
-                    raise ExprError("decimal sum overflow (ANSI mode)")
-                return None  # Spark non-ANSI: overflow -> NULL
-            return total.quantize(decimal.Decimal(1).scaleb(-t.scale))
+            with decimal.localcontext() as dctx:
+                dctx.prec = 60  # default 28 rounds/overflows wide sums
+                total = sum(vals, decimal.Decimal(0))
+                unscaled = int(total.scaleb(t.scale))
+                # Spark semantics: overflow past the RESULT precision
+                # (p+10, up to 38) -> NULL (non-ANSI) / error (ANSI). The
+                # device cap of 18 digits does not leak into the oracle;
+                # result types wider than 18 are device-unsupported
+                # (tpu_supported) and run through this CPU path only.
+                if abs(unscaled) > 10 ** t.precision - 1:
+                    if ectx is not None and ectx.ansi:
+                        from .base import ExprError
+                        raise ExprError("decimal sum overflow (ANSI mode)")
+                    return None  # Spark non-ANSI: overflow -> NULL
+                return total.quantize(decimal.Decimal(1).scaleb(-t.scale))
         if dt.is_floating(t):
             return float(sum(float(v) for v in vals))
         total = sum(int(v) for v in vals)
@@ -420,9 +422,10 @@ class Average(AggregateFunction):
             return None
         if self._is_decimal():
             t = self.dtype
-            total = sum(vals, decimal.Decimal(0))
             with decimal.localcontext() as ctx2:
+                ctx2.prec = 60  # default 28 rounds wide totals
                 ctx2.rounding = decimal.ROUND_HALF_UP
+                total = sum(vals, decimal.Decimal(0))
                 return (total / len(vals)).quantize(
                     decimal.Decimal(1).scaleb(-t.scale),
                     rounding=decimal.ROUND_HALF_UP)

@@ -1,0 +1,202 @@
+"""Planner / override engine (L5).
+
+TPU analog of the reference's `GpuOverrides.scala` + `RapidsMeta.scala` +
+`GpuTransitionOverrides.scala` (SURVEY.md §2.2-A "Override engine" /
+"Transition optimizer", §3.2; reference mount empty — built from the
+capability description). The input plan is an exec tree whose every node
+carries BOTH a device path (`execute`) and a Spark-semantics CPU path
+(`execute_cpu`); the planner
+
+1. wraps each node in a `NodeMeta` (the SparkPlanMeta analog),
+2. tags TPU eligibility bottom-up: master kill switch, per-op and
+   per-expression conf kill switches (`spark.rapids.sql.exec.<Name>` /
+   `.expression.<Name>`), `tpu_supported()` hooks on operators and every
+   expression tree node (`willNotWorkOnTpu` reasons accumulate),
+3. rebuilds the tree with `DeviceToHostExec` / `HostToDeviceExec`
+   transitions at every device<->CPU boundary (CPU islands execute via
+   their Spark-semantics `execute_cpu` path),
+4. renders `spark.rapids.sql.explain` = ALL | NOT_ON_GPU output.
+
+`PhysicalPlan.collect()` is the runner: it picks `execute` or
+`execute_cpu` at the root according to the final placement.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import pyarrow as pa
+
+from .config import EXPLAIN, RapidsConf, SQL_ENABLED
+from .exec.base import ExecCtx, TpuExec
+from .exec.transitions import DeviceToHostExec, HostToDeviceExec
+
+__all__ = ["NodeMeta", "PhysicalPlan", "TpuOverrides", "overrides"]
+
+
+def _walk_expr(expr) -> List[object]:
+    """Flatten an expression tree (incl. the root) in pre-order."""
+    out = [expr]
+    for c in getattr(expr, "children", ()):
+        out.extend(_walk_expr(c))
+    return out
+
+
+class NodeMeta:
+    """Per-node planning state (SparkPlanMeta analog): the wrapped exec,
+    child metas, and the accumulated cannot-run-on-TPU reasons."""
+
+    def __init__(self, node: TpuExec, children: Sequence["NodeMeta"]):
+        self.node = node
+        self.children = list(children)
+        self.reasons: List[str] = []
+        self.on_device = True  # decided by tag()
+
+    def will_not_work(self, reason: str):
+        self.reasons.append(reason)
+
+    def tag(self, conf: RapidsConf):
+        """Eligibility checks for this node (children tagged separately)."""
+        name = self.node.pretty_name()
+        if not conf.get(SQL_ENABLED):
+            self.will_not_work("spark.rapids.sql.enabled is false")
+        if not conf.is_op_enabled("exec", name):
+            self.will_not_work(
+                f"the operator has been disabled by "
+                f"spark.rapids.sql.exec.{name}")
+        r = self.node.tpu_supported()
+        if r:
+            self.will_not_work(r)
+        for root in self.node.expressions():
+            for e in _walk_expr(root):
+                ename = e.pretty_name()
+                if not conf.is_op_enabled("expression", ename):
+                    self.will_not_work(
+                        f"expression {e!r} has been disabled by "
+                        f"spark.rapids.sql.expression.{ename}")
+                    continue
+                er = e.tpu_supported()
+                if er:
+                    self.will_not_work(f"expression {e!r}: {er}")
+        self.on_device = not self.reasons
+
+    # --- explain ---------------------------------------------------------
+
+    def explain_lines(self, mode: str, depth: int = 0) -> List[str]:
+        out = []
+        pad = "  " * depth
+        desc = self.node.describe()
+        if self.on_device:
+            if mode == "ALL":
+                out.append(f"{pad}*Exec* {desc} will run on TPU")
+        else:
+            why = "; ".join(self.reasons)
+            out.append(f"{pad}!Exec! {desc} cannot run on TPU because "
+                       f"{why}")
+        for c in self.children:
+            out.extend(c.explain_lines(mode, depth + 1))
+        return out
+
+
+
+
+class PhysicalPlan:
+    """Planner output: the rebuilt tree + placement + explain report."""
+
+    def __init__(self, root: TpuExec, root_on_device: bool,
+                 meta: NodeMeta, conf: RapidsConf):
+        self.root = root
+        self.root_on_device = root_on_device
+        self.meta = meta
+        self.conf = conf
+
+    @property
+    def output_schema(self):
+        return self.root.output_schema
+
+    def fallback_nodes(self) -> List[str]:
+        """pretty names of every operator that fell back to CPU (the
+        assert_gpu_fallback_collect hook)."""
+        out = []
+
+        def rec(m: NodeMeta):
+            if not m.on_device:
+                out.append(m.node.pretty_name())
+            for c in m.children:
+                rec(c)
+
+        rec(self.meta)
+        return out
+
+    def explain(self, mode: Optional[str] = None) -> str:
+        mode = mode or self.conf.get(EXPLAIN)
+        if mode == "NONE":
+            return ""
+        return "\n".join(self.meta.explain_lines(mode))
+
+    def collect(self, ctx: Optional[ExecCtx] = None) -> pa.Table:
+        ctx = ctx or ExecCtx(self.conf)
+        from .columnar.arrow_bridge import arrow_schema, device_to_arrow
+        schema = arrow_schema(self.root.output_schema)
+        if self.root_on_device:
+            rbs = [device_to_arrow(b) for b in self.root.execute(ctx)]
+        else:
+            rbs = list(self.root.execute_cpu(ctx))
+        return pa.Table.from_batches(rbs, schema=schema)
+
+
+class TpuOverrides:
+    """The override rule: wrap -> tag -> convert (SURVEY.md §3.2)."""
+
+    def __init__(self, conf: Optional[RapidsConf] = None):
+        self.conf = conf or RapidsConf()
+
+    def _wrap(self, node: TpuExec) -> NodeMeta:
+        return NodeMeta(node, [self._wrap(c) for c in node.children])
+
+    def _tag(self, meta: NodeMeta):
+        for c in meta.children:
+            self._tag(c)
+        meta.tag(self.conf)
+
+    def _convert(self, meta: NodeMeta) -> TpuExec:
+        """Rebuild with transitions: a device parent over a CPU child gets
+        HostToDeviceExec; a CPU parent over a device child gets
+        DeviceToHostExec (GpuTransitionOverrides analog). Batch-size-
+        sensitive device ops re-entering from a CPU island additionally get
+        a coalesce so they see full batches, not CPU-island crumbs."""
+        from .config import BATCH_SIZE_ROWS
+        from .exec.aggregate import TpuHashAggregateExec
+        from .exec.exchange import TpuCoalesceBatchesExec
+        from .exec.joins import _BaseJoinExec
+        from .exec.sort import TpuSortExec
+        batch_sensitive = (TpuHashAggregateExec, _BaseJoinExec, TpuSortExec)
+        new_children = []
+        for c in meta.children:
+            built = self._convert(c)
+            if meta.on_device and not c.on_device:
+                built = HostToDeviceExec(built)
+                if isinstance(meta.node, batch_sensitive):
+                    built = TpuCoalesceBatchesExec(
+                        built, target_rows=self.conf.get(BATCH_SIZE_ROWS))
+            elif not meta.on_device and c.on_device:
+                built = DeviceToHostExec(built)
+            new_children.append(built)
+        return meta.node.with_new_children(new_children)
+
+    def apply(self, plan: TpuExec) -> PhysicalPlan:
+        meta = self._wrap(plan)
+        self._tag(meta)
+        root = self._convert(meta)
+        pp = PhysicalPlan(root, meta.on_device, meta, self.conf)
+        mode = self.conf.get(EXPLAIN)
+        if mode in ("ALL", "NOT_ON_GPU"):
+            text = pp.explain(mode)
+            if text:
+                print(text)
+        return pp
+
+
+def overrides(plan: TpuExec,
+              conf: Optional[RapidsConf] = None) -> PhysicalPlan:
+    """Convenience: run the override pass over an exec tree."""
+    return TpuOverrides(conf).apply(plan)

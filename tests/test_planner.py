@@ -1,0 +1,136 @@
+"""Planner / override-engine tests (reference: GpuOverrides +
+assert_gpu_fallback_collect — SURVEY.md §2.2-A, §3.2, §4.1)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import datatypes as dt
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.exec import HostBatchSourceExec
+from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+from spark_rapids_tpu.exec.base import ExecCtx, collect_arrow_cpu
+from spark_rapids_tpu.exec.basic import TpuFilterExec, TpuProjectExec
+from spark_rapids_tpu.exec.joins import TpuShuffledHashJoinExec
+from spark_rapids_tpu.exec.sort import SortOrder, TpuSortExec
+from spark_rapids_tpu.exec.transitions import (DeviceToHostExec,
+                                               HostToDeviceExec)
+from spark_rapids_tpu.expr import (Alias, GreaterThan, Literal, Multiply,
+                                   UnresolvedColumn as col)
+from spark_rapids_tpu.expr.aggregates import Count, Sum
+from spark_rapids_tpu.planner import overrides
+
+from data_gen import IntegerGen, LongGen, StringGen, gen_table
+
+
+def _source(n=300, seed=5):
+    return HostBatchSourceExec(
+        [gen_table([IntegerGen(min_val=0, max_val=9), LongGen()], n, seed)])
+
+
+def _pipeline(src=None):
+    src = src or _source()
+    f = TpuFilterExec(GreaterThan(col("c1"), Literal(0, dt.INT64)), src)
+    p = TpuProjectExec([Alias(col("c0"), "k"),
+                        Alias(Multiply(col("c1"), Literal(3, dt.INT64)),
+                              "v")], f)
+    return TpuHashAggregateExec([col("k")],
+                                [Alias(Sum(col("v")), "s"),
+                                 Alias(Count(), "c")], p)
+
+
+def _sorted_rows(table):
+    rows = zip(*[table.column(i).to_pylist()
+                 for i in range(table.num_columns)])
+    return sorted(rows, key=lambda r: tuple(
+        (v is None, str(type(v)), v if v is not None else 0) for v in r))
+
+
+def assert_planner_matches_cpu(plan, conf=None, expect_fallback=()):
+    """Dual-run through the planner: collect() vs the pure-CPU oracle,
+    plus fallback assertions (assert_gpu_fallback_collect analog)."""
+    pp = overrides(plan, conf)
+    got = pp.fallback_nodes()
+    for name in expect_fallback:
+        assert name in got, f"expected {name} to fall back, got {got}"
+    result = pp.collect()
+    oracle = collect_arrow_cpu(plan)
+    assert _sorted_rows(result) == _sorted_rows(oracle)
+    return pp
+
+
+def test_all_device_plan_no_fallback():
+    pp = assert_planner_matches_cpu(_pipeline())
+    assert pp.fallback_nodes() == []
+    assert pp.root_on_device
+    text = pp.explain("ALL")
+    assert "HashAggregateExec" in text and "will run on TPU" in text
+    assert pp.explain("NOT_ON_GPU") == ""
+
+
+def test_exec_kill_switch_falls_back():
+    conf = RapidsConf({"spark.rapids.sql.exec.FilterExec": "false"})
+    pp = assert_planner_matches_cpu(_pipeline(), conf,
+                                    expect_fallback=["FilterExec"])
+    assert pp.fallback_nodes() == ["FilterExec"]
+    # transitions around the CPU island
+    agg = pp.root
+    proj = agg.children[0]
+    h2d = proj.children[0]
+    assert isinstance(h2d, HostToDeviceExec)
+    filt = h2d.children[0]
+    assert isinstance(filt, TpuFilterExec)
+    assert isinstance(filt.children[0], DeviceToHostExec)
+    text = pp.explain("NOT_ON_GPU")
+    assert "FilterExec" in text and "disabled" in text
+
+
+def test_expression_kill_switch_falls_back():
+    conf = RapidsConf({"spark.rapids.sql.expression.Multiply": "false"})
+    pp = assert_planner_matches_cpu(_pipeline(), conf,
+                                    expect_fallback=["ProjectExec"])
+    assert "Multiply" in pp.explain("NOT_ON_GPU")
+
+
+def test_master_kill_switch_everything_cpu():
+    conf = RapidsConf({"spark.rapids.sql.enabled": "false"})
+    pp = assert_planner_matches_cpu(
+        _pipeline(), conf,
+        expect_fallback=["HashAggregateExec", "ProjectExec", "FilterExec"])
+    assert not pp.root_on_device
+
+
+def test_tpu_supported_auto_fallback_conditional_outer_join():
+    """The planner honors tpu_supported(): a non-equi left_outer join runs
+    through the CPU path automatically (no exec-level raise)."""
+    left = HostBatchSourceExec(
+        [gen_table([IntegerGen(min_val=0, max_val=5), IntegerGen()], 64, 1)])
+    right = HostBatchSourceExec(
+        [gen_table([IntegerGen(min_val=0, max_val=5), IntegerGen()], 64, 2,
+                   names=["k", "v"])])
+    j = TpuShuffledHashJoinExec([col("c0")], [col("k")], "left_outer",
+                                left, right,
+                                condition=GreaterThan(col("c1"), col("v")))
+    pp = assert_planner_matches_cpu(
+        j, expect_fallback=["ShuffledHashJoinExec"])
+    assert "non-equi condition" in pp.explain("NOT_ON_GPU")
+
+
+def test_mixed_islands_roundtrip():
+    """device source -> CPU filter -> device sort: two transitions."""
+    conf = RapidsConf({"spark.rapids.sql.exec.FilterExec": "false"})
+    src = _source()
+    f = TpuFilterExec(GreaterThan(col("c1"), Literal(0, dt.INT64)), src)
+    s = TpuSortExec([SortOrder(col("c1"))], f)
+    pp = assert_planner_matches_cpu(s, conf,
+                                    expect_fallback=["FilterExec"])
+    # sort is batch-size sensitive: coalesce inserted above the upload
+    from spark_rapids_tpu.exec.exchange import TpuCoalesceBatchesExec
+    coal = pp.root.children[0]
+    assert isinstance(coal, TpuCoalesceBatchesExec)
+    assert isinstance(coal.children[0], HostToDeviceExec)
+
+
+def test_string_plan_through_planner():
+    src = HostBatchSourceExec(
+        [gen_table([StringGen(max_len=8), IntegerGen()], 128, 3)])
+    agg = TpuHashAggregateExec([col("c0")], [Alias(Count(), "n")], src)
+    assert_planner_matches_cpu(agg)
