@@ -146,15 +146,18 @@ def _host_column_to_arrow(col: TpuColumnVector, host, n: int) -> pa.Array:
     if col.is_string_like:
         offsets = offsets_h[: n + 1]
         chars = chars_h
+        start = int(offsets[0]) if n else 0
         end = int(offsets[-1]) if n else 0
         # Rebuild via Arrow buffers (zero-copy from the host numpy views).
-        if offsets[0] != 0:
-            offsets = offsets - offsets[0]
+        # Offsets may be absolute into a shared chars buffer (split
+        # batches): rebase them AND slice chars from the same start.
+        if start != 0:
+            offsets = offsets - start
         null_buf = None if mask is None else _null_buffer(valid)
         arr = pa.Array.from_buffers(
             pa.string() if isinstance(t, dt.StringType) else pa.binary(), n,
             [null_buf, pa.py_buffer(np.ascontiguousarray(offsets)),
-             pa.py_buffer(np.ascontiguousarray(chars[:end]))],
+             pa.py_buffer(np.ascontiguousarray(chars[start:end]))],
             null_count=-1)
         return arr
     values = np.asarray(data)[:n]

@@ -92,6 +92,7 @@ class TpuHashAggregateExec(UnaryExec):
         self._partial_schema = dt.Schema(bfields)
         self._jit_partial = None
         self._jit_final = None
+        self._jit_merge = None
 
     @property
     def output_schema(self):
@@ -174,6 +175,54 @@ class TpuHashAggregateExec(UnaryExec):
             out_cols.append(a.evaluate_device(merged))
         return TpuBatch(out_cols, self._schema, ng)
 
+    def _merge_only(self, pbatch: TpuBatch, ectx) -> TpuBatch:
+        """Merge partial buffers WITHOUT the final evaluate — the rolling
+        reduction step of the bounded out-of-core merge (output stays in
+        the partial-buffer schema and can be merged again)."""
+        live = pbatch.live_mask()
+        nkeys = len(self.group_exprs)
+        key_cols = pbatch.columns[:nkeys]
+        buf_cols = [[pbatch.columns[i] for i in range(lo, hi)]
+                    for lo, hi in self._buf_slices]
+        skeys, sbufs, seg, sorted_live, ng, out_live = \
+            self._group_and_gather(key_cols, buf_cols, live)
+        out_cols = []
+        if skeys:
+            starts = _segment_starts(seg)
+            out_cols = [gather_column(k, starts, out_live) for k in skeys]
+        for a, sb in zip(self.aggs, sbufs):
+            out_cols.extend(a.merge_device(sb, seg, sorted_live, out_live))
+        return TpuBatch(out_cols, self._partial_schema, ng)
+
+    def _merge_bounded(self, partials, ctx: ExecCtx):
+        """Reduce the partials list under the HBM budget: concat+merge in
+        groups whose bytes fit the merge window, shrink each result to its
+        live group count, repeat until one remains (the reference's
+        'iterative partial->merge loop concatenates ... when over target
+        batch size' — SURVEY.md §3.3; no unbounded concat)."""
+        from ..columnar.batch import bucket_rows
+        from ..ops.gather import shrink_batch
+        if self._jit_merge is None:
+            self._jit_merge = jax.jit(self._merge_only, static_argnums=1)
+        window = max(1, ctx.mm.budget // 4)
+        spill = ctx.metric(self, "spillTime")
+        while len(partials) > 1:
+            t0 = time.perf_counter()
+            group = [partials.pop(0)]
+            gbytes = group[0].device_size_bytes()
+            while partials:
+                nb = partials[0].device_size_bytes()
+                if len(group) >= 2 and gbytes + nb > window:
+                    break
+                group.append(partials.pop(0))
+                gbytes += nb
+            merged = self._jit_merge(concat_batches(group), ctx.eval_ctx)
+            ng = merged.num_rows  # sync: shrink to live groups
+            merged = shrink_batch(merged, bucket_rows(max(ng, 128)))
+            partials.append(merged)
+            spill.value += time.perf_counter() - t0
+        return partials[0]
+
     def _empty_child_batch(self) -> TpuBatch:
         cschema = self.child.output_schema
         rb = pa.RecordBatch.from_arrays(
@@ -200,6 +249,9 @@ class TpuHashAggregateExec(UnaryExec):
         if not self.group_exprs:
             from ..ops.concat import concat_batches_bounded
             merged = concat_batches_bounded(partials)
+        elif sum(p.device_size_bytes() for p in partials) \
+                > ctx.mm.budget // 4:
+            merged = self._merge_bounded(partials, ctx)
         else:
             merged = concat_batches(partials)
         out = self._jit_final(merged, ctx.eval_ctx)

@@ -69,6 +69,8 @@ class ExecCtx:
             self.conf.get("spark.rapids.sql.metrics.level") == "DEBUG"
         from ..config import STAGE_FUSION
         self.stage_fusion = self.conf.get(STAGE_FUSION)
+        from ..memory import DeviceMemoryManager
+        self.mm = DeviceMemoryManager(self.conf)
 
     def metric(self, node: "TpuExec", name: str) -> TpuMetric:
         m = self.metrics.setdefault(node.node_label(), {})
@@ -192,13 +194,16 @@ def fused_batches(consumer: TpuExec, ctx: ExecCtx, tail_fn=None,
         else None
     for b in node.execute(ctx):
         t0 = time.perf_counter()
-        out = jitted(b, ctx.eval_ctx)
+        # split-and-retry on device OOM: the fused stage re-runs over
+        # batch halves (memory.py; SURVEY.md §5.3 layer 3)
+        outs = ctx.mm.with_retry(b, lambda bb: jitted(bb, ctx.eval_ctx))
         if ctx.sync_metrics:
-            out.block_until_ready()
-            rows += out.num_rows  # syncs; DEBUG metrics mode only
+            for out in outs:
+                out.block_until_ready()
+                rows += out.num_rows  # syncs; DEBUG metrics mode only
         if metric is not None:
             metric.value += time.perf_counter() - t0
-        yield out
+        yield from outs
 
 
 class LeafExec(TpuExec):
@@ -288,7 +293,8 @@ class DeviceBatchSourceExec(LeafExec):
 def collect_arrow(plan: TpuExec, ctx: Optional[ExecCtx] = None) -> pa.Table:
     """Run the TPU path and download results as one Arrow table."""
     ctx = ctx or ExecCtx()
-    batches = [device_to_arrow(b) for b in plan.execute(ctx)]
+    with ctx.mm.task_slot():  # admission control (GpuSemaphore analog)
+        batches = [device_to_arrow(b) for b in plan.execute(ctx)]
     from ..columnar.arrow_bridge import arrow_schema
     return pa.Table.from_batches(batches, schema=arrow_schema(
         plan.output_schema))

@@ -1,0 +1,255 @@
+"""Device memory runtime (L2).
+
+TPU analog of the reference's memory/scheduling stack (SURVEY.md §2.2-A:
+GpuDeviceManager / GpuSemaphore / RapidsBufferCatalog +
+RapidsDeviceMemoryStore / RapidsHostMemoryStore / SpillableColumnarBatch /
+RmmRapidsRetryIterator; §5.3 layered OOM defense; reference mount empty —
+built from the capability description). OOM on TPU is a hard crash
+(SURVEY.md §7.3.5), so the defense is:
+
+1. admission control — a task semaphore
+   (``spark.rapids.sql.concurrentGpuTasks``),
+2. a byte ledger against the HBM budget; registered batches are
+   *spillable*: under pressure the catalog downloads them to host Arrow
+   (device buffers dropped, XLA frees) and re-uploads on access,
+3. split-and-retry — ``with_retry`` halves the input batch on device OOM
+   (real RESOURCE_EXHAUSTED or injected via
+   ``spark.rapids.sql.test.injectRetryOOM``) and processes the halves
+   sequentially, up to ``spark.rapids.sql.oomRetry.maxSplits`` times.
+
+Operators opt in at their memory cliffs (sort's global merge, aggregate's
+partial merge) — the same integration points the reference uses.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, List, Optional
+
+from .config import (ALLOC_FRACTION, CONCURRENT_TPU_TASKS, OOM_MAX_SPLITS,
+                     OOM_RETRY_ENABLED, RapidsConf, TEST_RETRY_OOM_INJECT,
+                     register, _bytes_conv)
+
+__all__ = ["DeviceMemoryManager", "SpillableBatch", "TpuRetryOOM",
+           "split_batch"]
+
+DEVICE_BUDGET = register(
+    "spark.rapids.memory.device.budgetBytes", 0,
+    "Device HBM byte budget for the spillable-batch catalog; 0 = auto "
+    "(allocFraction x the device's reported memory, 6GiB fallback). "
+    "Tests set this low to force spill.", conv=_bytes_conv)
+
+
+class TpuRetryOOM(RuntimeError):
+    """Device OOM surfaced to the retry framework (GpuRetryOOM analog)."""
+
+
+def _is_oom_error(e: BaseException) -> bool:
+    s = str(e)
+    return ("RESOURCE_EXHAUSTED" in s or "out of memory" in s.lower()
+            or isinstance(e, TpuRetryOOM))
+
+
+def split_batch(batch):
+    """Split a device batch at the capacity midpoint into two half-capacity
+    batches (the GpuSplitAndRetryOOM halving). Fixed-width lanes are static
+    slices; string chars/offsets stay shared (offsets are absolute), so the
+    split itself allocates only the halved fixed-width lanes."""
+    from .columnar.batch import TpuBatch
+    import jax.numpy as jnp
+    cap = batch.capacity
+    if cap < 2:
+        raise TpuRetryOOM("cannot split a 1-row batch further")
+    h = cap // 2
+    rc = batch.row_count
+
+    def halves(c):
+        if c.data is not None:
+            return (c.with_arrays(data=c.data[:h], validity=c.validity[:h]),
+                    c.with_arrays(data=c.data[h:], validity=c.validity[h:]))
+        if c.offsets is not None:
+            return (c.with_arrays(offsets=c.offsets[:h + 1],
+                                  validity=c.validity[:h]),
+                    c.with_arrays(offsets=c.offsets[h:],
+                                  validity=c.validity[h:]))
+        return (c.with_arrays(validity=c.validity[:h]),
+                c.with_arrays(validity=c.validity[h:]))
+
+    pairs = [halves(c) for c in batch.columns]
+    rc1 = jnp.minimum(rc, jnp.int32(h))
+    rc2 = jnp.maximum(rc - h, 0)
+    sel1 = batch.selection[:h] if batch.selection is not None else None
+    sel2 = batch.selection[h:] if batch.selection is not None else None
+    b1 = TpuBatch([p[0] for p in pairs], batch.schema, rc1, selection=sel1)
+    b2 = TpuBatch([p[1] for p in pairs], batch.schema, rc2, selection=sel2)
+    return b1, b2
+
+
+class SpillableBatch:
+    """A catalog-registered device batch that can round-trip to host Arrow
+    (SpillableColumnarBatch analog)."""
+
+    def __init__(self, mgr: "DeviceMemoryManager", batch):
+        self._mgr = mgr
+        self._device = batch
+        self._host = None
+        self._schema = batch.schema
+        self.nbytes = batch.device_size_bytes()
+        self.spill_count = 0
+
+    @property
+    def on_device(self) -> bool:
+        return self._device is not None
+
+    def spill(self):
+        """Download to host Arrow, drop the device buffers (XLA frees),
+        and credit the ledger."""
+        if self._device is None:
+            return
+        from .columnar.arrow_bridge import device_to_arrow
+        self._host = device_to_arrow(self._device)
+        self._device = None
+        self.spill_count += 1
+        with self._mgr._lock:
+            if id(self) in self._mgr._catalog:
+                self._mgr.device_bytes -= self.nbytes
+                self._mgr.spill_bytes += self.nbytes
+
+    def get_host(self):
+        """Host Arrow view (spills if still on device)."""
+        if self._host is None:
+            from .columnar.arrow_bridge import device_to_arrow
+            self._host = device_to_arrow(self._device)
+        return self._host
+
+    def get(self):
+        """The device batch, re-uploading (and re-charging the ledger) if
+        spilled."""
+        if self._device is None:
+            from .columnar.arrow_bridge import arrow_to_device
+            self._mgr._charge(self, self.nbytes)
+            self._device = arrow_to_device(self._host, self._schema)
+            self._host = None
+        self._mgr._touch(self)
+        return self._device
+
+    def release(self):
+        self._mgr._release(self)
+        self._device = None
+        self._host = None
+
+
+class DeviceMemoryManager:
+    """Budget ledger + spill catalog + task semaphore + retry framework."""
+
+    def __init__(self, conf: Optional[RapidsConf] = None):
+        self.conf = conf or RapidsConf()
+        budget = self.conf.get(DEVICE_BUDGET)
+        if not budget:
+            budget = int(self._device_memory()
+                         * self.conf.get(ALLOC_FRACTION))
+        self.budget = budget
+        self._lock = threading.RLock()
+        self._catalog: "OrderedDict[int, SpillableBatch]" = OrderedDict()
+        self._pinned: set = set()
+        self.device_bytes = 0
+        self.spill_bytes = 0  # total bytes ever spilled (metric)
+        self.semaphore = threading.BoundedSemaphore(
+            self.conf.get(CONCURRENT_TPU_TASKS))
+        self._retry_enabled = self.conf.get(OOM_RETRY_ENABLED)
+        self.max_splits = self.conf.get(OOM_MAX_SPLITS)
+        self._inject_after = self.conf.get(TEST_RETRY_OOM_INJECT)
+        self._op_count = 0
+
+    @staticmethod
+    def _device_memory() -> int:
+        try:
+            import jax
+            stats = jax.devices()[0].memory_stats() or {}
+            if stats.get("bytes_limit"):
+                return int(stats["bytes_limit"])
+        except Exception:
+            pass
+        return 6 << 30
+
+    # --- catalog / ledger -------------------------------------------------
+
+    def register(self, batch) -> SpillableBatch:
+        sb = SpillableBatch(self, batch)
+        with self._lock:
+            self._catalog[id(sb)] = sb
+            self.device_bytes += sb.nbytes
+            self._evict_to_fit()
+        return sb
+
+    def _charge(self, sb: SpillableBatch, nbytes: int):
+        with self._lock:
+            self.device_bytes += nbytes
+            self._catalog[id(sb)] = sb
+            self._evict_to_fit(exclude=id(sb))
+
+    def _touch(self, sb: SpillableBatch):
+        with self._lock:
+            if id(sb) in self._catalog:
+                self._catalog.move_to_end(id(sb))
+
+    def _release(self, sb: SpillableBatch):
+        with self._lock:
+            if self._catalog.pop(id(sb), None) is not None \
+                    and sb.on_device:
+                self.device_bytes -= sb.nbytes
+            self._pinned.discard(id(sb))
+
+    def _evict_to_fit(self, exclude: Optional[int] = None):
+        """LRU device->host spill until under budget (the
+        DeviceMemoryEventHandler synchronous-spill analog)."""
+        if self.device_bytes <= self.budget:
+            return
+        for key in list(self._catalog):
+            if self.device_bytes <= self.budget:
+                break
+            if key == exclude or key in self._pinned:
+                continue
+            self._catalog[key].spill()  # adjusts the ledger itself
+
+    def pin(self, sb: SpillableBatch):
+        with self._lock:
+            self._pinned.add(id(sb))
+
+    def unpin(self, sb: SpillableBatch):
+        with self._lock:
+            self._pinned.discard(id(sb))
+
+    # --- semaphore --------------------------------------------------------
+
+    def task_slot(self):
+        """Context manager gating concurrent device work (GpuSemaphore)."""
+        return self.semaphore
+
+    # --- OOM retry --------------------------------------------------------
+
+    def _maybe_inject_oom(self):
+        if self._inject_after:
+            with self._lock:
+                self._op_count += 1
+                if self._op_count == self._inject_after:
+                    raise TpuRetryOOM(
+                        f"injected OOM at op {self._op_count} "
+                        "(spark.rapids.sql.test.injectRetryOOM)")
+
+    def with_retry(self, batch, fn: Callable, depth: int = 0) -> List:
+        """Run ``fn(batch) -> result`` with split-and-retry on device OOM:
+        on failure the batch is halved and both halves processed
+        sequentially (results concatenated as a list), recursively up to
+        ``maxSplits`` (RmmRapidsRetryIterator.withRetry analog)."""
+        try:
+            self._maybe_inject_oom()
+            return [fn(batch)]
+        except Exception as e:  # noqa: BLE001 — filtered below
+            if not self._retry_enabled or depth >= self.max_splits \
+                    or not _is_oom_error(e):
+                raise
+            b1, b2 = split_batch(batch)
+            out = self.with_retry(b1, fn, depth + 1)
+            out.extend(self.with_retry(b2, fn, depth + 1))
+            return out

@@ -17,7 +17,7 @@ from .strings import gather_strings
 
 __all__ = ["compaction_indices", "exclusive_cumsum", "invert_permutation",
            "gather_column", "gather_batch", "compact_batch",
-           "ensure_compacted"]
+           "ensure_compacted", "shrink_batch"]
 
 
 def exclusive_cumsum(x: jax.Array) -> jax.Array:
@@ -159,6 +159,26 @@ def compact_batch(batch: TpuBatch, keep: jax.Array) -> TpuBatch:
 @jax.jit
 def _compact_selection(batch: TpuBatch) -> TpuBatch:
     return compact_batch(batch, batch.live_mask())
+
+
+def shrink_batch(batch: TpuBatch, new_cap: int) -> TpuBatch:
+    """Slice a prefix-layout batch down to a smaller static capacity
+    (row_count must be <= new_cap). Fixed-width lanes are static slices;
+    string chars stay shared (offsets are absolute)."""
+    assert batch.selection is None, "compact before shrinking"
+    if new_cap >= batch.capacity:
+        return batch
+    cols = []
+    for c in batch.columns:
+        if c.data is not None:
+            cols.append(c.with_arrays(data=c.data[:new_cap],
+                                      validity=c.validity[:new_cap]))
+        elif c.offsets is not None:
+            cols.append(c.with_arrays(offsets=c.offsets[:new_cap + 1],
+                                      validity=c.validity[:new_cap]))
+        else:
+            cols.append(c.with_arrays(validity=c.validity[:new_cap]))
+    return TpuBatch(cols, batch.schema, batch.row_count)
 
 
 def ensure_compacted(batch: TpuBatch) -> TpuBatch:

@@ -22,7 +22,8 @@ from .strings import gather_window
 
 __all__ = ["SortSpec", "orderable_int", "canonicalize_floats",
            "string_order_ranks", "string_order_ranks_multi",
-           "sort_permutation", "segment_ids_for_keys"]
+           "sort_permutation", "segment_ids_for_keys", "key_lanes",
+           "lex_leq", "lex_min_tuple"]
 
 _RANK_WINDOW = 7  # bytes per refinement pass: 7 x 9 bits = 63 bits / int64
 
@@ -172,6 +173,45 @@ def _key_lanes(key_cols: Sequence[TpuColumnVector],
         lanes.append(null_rank)
         lanes.append(vals)
     return lanes
+
+
+def key_lanes(key_cols, specs, live):
+    """Public name for the orderable lane stack (out-of-core merge uses it
+    to compare rows against run boundaries in the same rank space)."""
+    return _key_lanes(key_cols, specs, live)
+
+
+def lex_leq(lanes: Sequence[jax.Array],
+            boundary: Sequence[jax.Array]) -> jax.Array:
+    """Per-row mask: lane tuple <= boundary scalar tuple, lexicographic in
+    lane order (= the sort order, since lanes encode direction and null
+    placement)."""
+    n = lanes[0].shape[0]
+    lt = jnp.zeros((n,), jnp.bool_)
+    eq = jnp.ones((n,), jnp.bool_)
+    for lane, b in zip(lanes, boundary):
+        lt = lt | (eq & (lane < b))
+        eq = eq & (lane == b)
+    return lt | eq
+
+
+def lex_min_tuple(blanes: Sequence[jax.Array], bvalid: jax.Array):
+    """Lexicographic minimum among k boundary tuples (blanes: each lane is
+    shape (k,)); invalid entries never win. k is static and small."""
+    k = bvalid.shape[0]
+    best = [lane[0] for lane in blanes]
+    best_valid = bvalid[0]
+    for i in range(1, k):
+        cand = [lane[i] for lane in blanes]
+        lt = jnp.asarray(False)
+        eq = jnp.asarray(True)
+        for c, b in zip(cand, best):
+            lt = lt | (eq & (c < b))
+            eq = eq & (c == b)
+        take = bvalid[i] & (lt | ~best_valid)
+        best = [jnp.where(take, c, b) for c, b in zip(cand, best)]
+        best_valid = best_valid | bvalid[i]
+    return best
 
 
 def sort_permutation(key_cols: Sequence[TpuColumnVector],

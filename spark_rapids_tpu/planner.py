@@ -138,7 +138,8 @@ class PhysicalPlan:
         from .columnar.arrow_bridge import arrow_schema, device_to_arrow
         schema = arrow_schema(self.root.output_schema)
         if self.root_on_device:
-            rbs = [device_to_arrow(b) for b in self.root.execute(ctx)]
+            with ctx.mm.task_slot():  # GpuSemaphore admission control
+                rbs = [device_to_arrow(b) for b in self.root.execute(ctx)]
         else:
             rbs = list(self.root.execute_cpu(ctx))
         return pa.Table.from_batches(rbs, schema=schema)

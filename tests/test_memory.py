@@ -1,0 +1,194 @@
+"""Memory runtime tests: budget ledger, spill, semaphore, split-retry,
+out-of-core sort and aggregate merge (reference:
+RapidsDeviceMemoryStoreSuite / RmmSparkRetrySuiteBase / out-of-core sort —
+SURVEY.md §4.2, §5.3, §5.7)."""
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import datatypes as dt
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.columnar.arrow_bridge import arrow_to_device
+from spark_rapids_tpu.exec import HostBatchSourceExec
+from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+from spark_rapids_tpu.exec.base import ExecCtx, collect_arrow, \
+    collect_arrow_cpu
+from spark_rapids_tpu.exec.sort import SortOrder, TpuSortExec
+from spark_rapids_tpu.expr import Alias, UnresolvedColumn as col
+from spark_rapids_tpu.expr.aggregates import Count, Max, Min, Sum
+from spark_rapids_tpu.memory import (DeviceMemoryManager, TpuRetryOOM,
+                                     split_batch)
+
+from data_gen import (DoubleGen, IntegerGen, LongGen, StringGen, gen_table)
+
+
+def _rb(n, seed=1, gens=None, names=None):
+    gens = gens or [IntegerGen(min_val=0, max_val=50), LongGen()]
+    return gen_table(gens, n, seed, names)
+
+
+def _norm(table):
+    """NaN-safe pydict for exact-order comparison."""
+    import math
+    out = {}
+    for name, colvals in table.to_pydict().items():
+        out[name] = ["NaN" if isinstance(v, float) and math.isnan(v) else v
+                     for v in colvals]
+    return out
+
+
+def _sorted_rows(table):
+    rows = zip(*[table.column(i).to_pylist()
+                 for i in range(table.num_columns)])
+    return sorted(rows, key=lambda r: tuple(
+        (v is None, str(type(v)), v if v is not None else 0) for v in r))
+
+
+# --- ledger / spill -------------------------------------------------------
+
+def test_catalog_spills_lru_under_budget():
+    conf = RapidsConf({"spark.rapids.memory.device.budgetBytes": 1 << 14})
+    mm = DeviceMemoryManager(conf)
+    sbs = []
+    for i in range(8):
+        b = arrow_to_device(_rb(256, seed=i))
+        sbs.append(mm.register(b))
+    assert mm.device_bytes <= mm.budget
+    assert any(not sb.on_device for sb in sbs)  # older ones spilled
+    assert mm.spill_bytes > 0
+    # spilled batch round-trips through host Arrow intact
+    spilled = next(sb for sb in sbs if not sb.on_device)
+    again = spilled.get()
+    assert again.num_rows == 256
+    for sb in sbs:
+        sb.release()
+    assert mm.device_bytes == 0
+
+
+def test_spillable_roundtrip_preserves_strings():
+    conf = RapidsConf({"spark.rapids.memory.device.budgetBytes": 1})
+    mm = DeviceMemoryManager(conf)
+    rb = _rb(64, gens=[StringGen(max_len=10), IntegerGen()])
+    sb = mm.register(arrow_to_device(rb))
+    assert not sb.on_device or mm.device_bytes > mm.budget
+    mm._evict_to_fit()
+    from spark_rapids_tpu.columnar.arrow_bridge import device_to_arrow
+    assert device_to_arrow(sb.get()).equals(rb)
+
+
+# --- semaphore ------------------------------------------------------------
+
+def test_semaphore_limits_concurrency():
+    conf = RapidsConf({"spark.rapids.sql.concurrentGpuTasks": 1})
+    mm = DeviceMemoryManager(conf)
+    active = []
+    peak = []
+
+    def task():
+        with mm.task_slot():
+            active.append(1)
+            peak.append(len(active))
+            time.sleep(0.02)
+            active.pop()
+
+    threads = [threading.Thread(target=task) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert max(peak) == 1
+
+
+# --- split-and-retry ------------------------------------------------------
+
+def test_split_batch_halves_rows():
+    rb = _rb(300, gens=[IntegerGen(), StringGen(max_len=6)])
+    b = arrow_to_device(rb)
+    b1, b2 = split_batch(b)
+    from spark_rapids_tpu.columnar.arrow_bridge import device_to_arrow
+    t = pa.Table.from_batches([device_to_arrow(b1), device_to_arrow(b2)])
+    assert t.to_pydict() == pa.Table.from_batches([rb]).to_pydict()
+
+
+def test_injected_oom_split_retry_aggregate():
+    """spark.rapids.sql.test.injectRetryOOM forces an OOM inside the fused
+    stage; split-and-retry halves the batch and the result is unchanged."""
+    rb = _rb(512, seed=3)
+    plan = TpuHashAggregateExec(
+        [col("c0")], [Alias(Sum(col("c1")), "s"), Alias(Count(), "n")],
+        HostBatchSourceExec([rb]))
+    want = _sorted_rows(collect_arrow_cpu(plan))
+    ctx = ExecCtx(RapidsConf({"spark.rapids.sql.test.injectRetryOOM": 1}))
+    got = _sorted_rows(collect_arrow(plan, ctx))
+    assert got == want
+
+
+def test_injected_oom_exhausts_splits():
+    conf = RapidsConf({"spark.rapids.sql.oomRetry.enabled": False})
+    mm = DeviceMemoryManager(conf)
+
+    def boom(_):
+        raise TpuRetryOOM("RESOURCE_EXHAUSTED: fake")
+
+    b = arrow_to_device(_rb(64))
+    with pytest.raises(TpuRetryOOM):
+        mm.with_retry(b, boom)
+
+
+def test_non_oom_errors_not_retried():
+    mm = DeviceMemoryManager(RapidsConf())
+    calls = []
+
+    def boom(_):
+        calls.append(1)
+        raise ValueError("not an oom")
+
+    b = arrow_to_device(_rb(64))
+    with pytest.raises(ValueError):
+        mm.with_retry(b, boom)
+    assert len(calls) == 1
+
+
+# --- out-of-core sort and aggregate --------------------------------------
+
+@pytest.mark.parametrize("gens,names", [
+    ([LongGen(), DoubleGen(null_frac=0.1)], None),
+    ([StringGen(max_len=8), IntegerGen(null_frac=0.1)], None),
+])
+def test_out_of_core_sort_forced_spill(gens, names):
+    """Sort at data size >> device budget: external merge with host spill
+    produces exactly the oracle's ordering."""
+    rbs = [_rb(500, seed=s, gens=gens, names=names) for s in range(6)]
+    plan = TpuSortExec([SortOrder(col("c0")), SortOrder(col("c1"))],
+                       HostBatchSourceExec(rbs))
+    conf = RapidsConf({"spark.rapids.memory.device.budgetBytes": 1 << 13})
+    ctx = ExecCtx(conf)
+    got = collect_arrow(plan, ctx)
+    want = collect_arrow_cpu(plan)
+    assert _norm(got) == _norm(want)
+    assert ctx.mm.spill_bytes > 0  # really went out-of-core
+
+
+def test_out_of_core_aggregate_bounded_merge():
+    rbs = [_rb(400, seed=s) for s in range(8)]
+    plan = TpuHashAggregateExec(
+        [col("c0")],
+        [Alias(Sum(col("c1")), "s"), Alias(Min(col("c1")), "lo"),
+         Alias(Max(col("c1")), "hi"), Alias(Count(), "n")],
+        HostBatchSourceExec(rbs))
+    conf = RapidsConf({"spark.rapids.memory.device.budgetBytes": 1 << 13})
+    got = _sorted_rows(collect_arrow(plan, ExecCtx(conf)))
+    want = _sorted_rows(collect_arrow_cpu(plan))
+    assert got == want
+
+
+def test_sort_small_input_stays_in_core():
+    rbs = [_rb(100, seed=s) for s in range(2)]
+    plan = TpuSortExec([SortOrder(col("c1"))], HostBatchSourceExec(rbs))
+    ctx = ExecCtx()
+    got = collect_arrow(plan, ctx)
+    want = collect_arrow_cpu(plan)
+    assert _norm(got) == _norm(want)
+    assert ctx.mm.spill_bytes == 0
