@@ -139,6 +139,14 @@ OOM_RETRY_ENABLED = register(
 OOM_MAX_SPLITS = register(
     "spark.rapids.sql.oomRetry.maxSplits", 8,
     "Max times an input batch may be split in half under OOM retry.")
+OOM_RETRY_BLOCKING = register(
+    "spark.rapids.sql.oomRetry.blocking", True,
+    "Block on each stage's device result inside the retry scope. XLA "
+    "dispatch is asynchronous, so without this a real device "
+    "RESOURCE_EXHAUSTED surfaces at a later sync point outside the "
+    "retry and split-and-retry never engages; with it, the stage result "
+    "completes (or fails) inside the scope at the cost of cross-batch "
+    "dispatch overlap.")
 
 # --- Shuffle --------------------------------------------------------------
 SHUFFLE_MODE = register(

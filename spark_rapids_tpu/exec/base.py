@@ -70,7 +70,9 @@ class ExecCtx:
         from ..config import STAGE_FUSION
         self.stage_fusion = self.conf.get(STAGE_FUSION)
         from ..memory import DeviceMemoryManager
-        self.mm = DeviceMemoryManager(self.conf)
+        # process-level: concurrent queries share one semaphore + ledger
+        # (the reference's GpuSemaphore/RapidsBufferCatalog are singletons)
+        self.mm = DeviceMemoryManager.shared(self.conf)
 
     def metric(self, node: "TpuExec", name: str) -> TpuMetric:
         m = self.metrics.setdefault(node.node_label(), {})
@@ -179,17 +181,27 @@ def fused_batches(consumer: TpuExec, ctx: ExecCtx, tail_fn=None,
         yield from node.execute(ctx)
         return
     cache = consumer.__dict__.setdefault("_fused_jit_cache", {})
-    # key on the identity of each fn's owning op: chains can be rebuilt
-    # (planner transitions) without changing length
-    key = tuple(id(getattr(f, "__self__", f)) for f in fns)
-    jitted = cache.get(key)
-    if jitted is None:
+    # key on stable content (op class + bound-expression describe), not
+    # id(): after a planner rebuild a recycled id could silently hit a
+    # stale program with different semantics. Identical keys imply
+    # identical per-batch semantics, so sharing the program is correct.
+    def _fn_key(f):
+        owner = getattr(f, "__self__", None)
+        if owner is None:
+            return getattr(f, "__qualname__", repr(f))
+        return (type(owner).__qualname__, owner.describe())
+    key = tuple(_fn_key(f) for f in fns)
+    entry = cache.get(key)
+    if entry is None:
         def composed(b, ectx):
             for f in fns:
                 b = f(b, ectx)
             return b
-        jitted = jax.jit(composed, static_argnums=1)
-        cache[key] = jitted
+        # hold the fns alongside the program: the key is content-based,
+        # but the compiled program closes over these exact callables
+        entry = (jax.jit(composed, static_argnums=1), fns)
+        cache[key] = entry
+    jitted = entry[0]
     rows = ctx.metric(consumer, "numOutputRows") if ctx.sync_metrics \
         else None
     for b in node.execute(ctx):

@@ -27,8 +27,8 @@ from collections import OrderedDict
 from typing import Callable, List, Optional
 
 from .config import (ALLOC_FRACTION, CONCURRENT_TPU_TASKS, OOM_MAX_SPLITS,
-                     OOM_RETRY_ENABLED, RapidsConf, TEST_RETRY_OOM_INJECT,
-                     register, _bytes_conv)
+                     OOM_RETRY_BLOCKING, OOM_RETRY_ENABLED, RapidsConf,
+                     TEST_RETRY_OOM_INJECT, register, _bytes_conv)
 
 __all__ = ["DeviceMemoryManager", "SpillableBatch", "TpuRetryOOM",
            "split_batch"]
@@ -45,9 +45,19 @@ class TpuRetryOOM(RuntimeError):
 
 
 def _is_oom_error(e: BaseException) -> bool:
+    """Only the runtime's own error type counts as device OOM — arbitrary
+    exceptions whose message happens to contain the markers must not be
+    silently split-and-retried (they'd mask the real failure)."""
+    if isinstance(e, TpuRetryOOM):
+        return True
+    try:
+        from jax.errors import JaxRuntimeError
+    except ImportError:  # pragma: no cover - old jax
+        return False
+    if not isinstance(e, JaxRuntimeError):
+        return False
     s = str(e)
-    return ("RESOURCE_EXHAUSTED" in s or "out of memory" in s.lower()
-            or isinstance(e, TpuRetryOOM))
+    return "RESOURCE_EXHAUSTED" in s or "out of memory" in s.lower()
 
 
 def split_batch(batch):
@@ -140,7 +150,36 @@ class SpillableBatch:
 
 
 class DeviceMemoryManager:
-    """Budget ledger + spill catalog + task semaphore + retry framework."""
+    """Budget ledger + spill catalog + task semaphore + retry framework.
+
+    Use ``DeviceMemoryManager.shared(conf)`` in execution paths: the
+    reference's GpuSemaphore/RapidsBufferCatalog are process-wide
+    singletons, so concurrent queries must draw admission slots and HBM
+    budget from ONE ledger. Direct construction is for tests that need an
+    isolated manager."""
+
+    _shared: dict = {}
+    _shared_lock = threading.Lock()
+
+    @classmethod
+    def shared(cls, conf: Optional[RapidsConf] = None) \
+            -> "DeviceMemoryManager":
+        """Process-level manager keyed by the memory-relevant conf values
+        (one per distinct memory configuration; all default-conf queries
+        share one instance). OOM-injection confs always get a fresh
+        instance — the injection counter is per-test state."""
+        conf = conf or RapidsConf()
+        if conf.get(TEST_RETRY_OOM_INJECT):
+            return cls(conf)
+        key = (conf.get(DEVICE_BUDGET), conf.get(ALLOC_FRACTION),
+               conf.get(CONCURRENT_TPU_TASKS), conf.get(OOM_RETRY_ENABLED),
+               conf.get(OOM_MAX_SPLITS), conf.get(OOM_RETRY_BLOCKING))
+        with cls._shared_lock:
+            mgr = cls._shared.get(key)
+            if mgr is None:
+                mgr = cls(conf)
+                cls._shared[key] = mgr
+            return mgr
 
     def __init__(self, conf: Optional[RapidsConf] = None):
         self.conf = conf or RapidsConf()
@@ -157,6 +196,7 @@ class DeviceMemoryManager:
         self.semaphore = threading.BoundedSemaphore(
             self.conf.get(CONCURRENT_TPU_TASKS))
         self._retry_enabled = self.conf.get(OOM_RETRY_ENABLED)
+        self._retry_blocking = self.conf.get(OOM_RETRY_BLOCKING)
         self.max_splits = self.conf.get(OOM_MAX_SPLITS)
         self._inject_after = self.conf.get(TEST_RETRY_OOM_INJECT)
         self._op_count = 0
@@ -241,10 +281,19 @@ class DeviceMemoryManager:
         """Run ``fn(batch) -> result`` with split-and-retry on device OOM:
         on failure the batch is halved and both halves processed
         sequentially (results concatenated as a list), recursively up to
-        ``maxSplits`` (RmmRapidsRetryIterator.withRetry analog)."""
+        ``maxSplits`` (RmmRapidsRetryIterator.withRetry analog).
+
+        When ``oomRetry.blocking`` is on (default) the result is forced to
+        completion inside the try: dispatch is async, so otherwise a real
+        device RESOURCE_EXHAUSTED would surface at a later sync point
+        outside any retry scope."""
         try:
             self._maybe_inject_oom()
-            return [fn(batch)]
+            out = fn(batch)
+            if self._retry_enabled and self._retry_blocking:
+                import jax
+                jax.block_until_ready(out)
+            return [out]
         except Exception as e:  # noqa: BLE001 — filtered below
             if not self._retry_enabled or depth >= self.max_splits \
                     or not _is_oom_error(e):

@@ -22,6 +22,7 @@ carries BOTH a device path (`execute`) and a Spark-semantics CPU path
 """
 from __future__ import annotations
 
+import sys
 from typing import List, Optional, Sequence
 
 import pyarrow as pa
@@ -193,7 +194,9 @@ class TpuOverrides:
         if mode in ("ALL", "NOT_ON_GPU"):
             text = pp.explain(mode)
             if text:
-                print(text)
+                # stderr, never stdout: driver scripts (bench.py) speak a
+                # machine-readable JSON-line protocol on stdout
+                print(text, file=sys.stderr)
         return pp
 
 
