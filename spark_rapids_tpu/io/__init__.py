@@ -1,0 +1,16 @@
+"""File IO subsystem: scans and writes.
+
+TPU analog of the reference's GPU-aware readers/writers
+(`GpuParquetScan.scala`, `GpuMultiFileReader.scala`,
+`GpuParquetFileFormat.scala`, `ColumnarOutputWriter.scala` — SURVEY.md
+§2.2-B "Scans"/"Writes"; reference mount empty, built from the capability
+description). Decode happens on host (Arrow C++), upload to device follows
+— the TPU has no cuIO analog, so the host decode + pinned-transfer
+pipeline IS the idiomatic design, with the MULTITHREADED reader
+overlapping host decode of split N+1 with device compute on split N.
+"""
+from .scan import FileSplit, TpuFileScanExec, plan_splits
+from .write import TpuFileWriteExec, write_files
+
+__all__ = ["FileSplit", "TpuFileScanExec", "plan_splits",
+           "TpuFileWriteExec", "write_files"]

@@ -1,0 +1,151 @@
+"""Columnar file writes: device batches -> Parquet / ORC / CSV part files.
+
+TPU analog of the reference's `GpuParquetFileFormat` / `GpuOrcFileFormat`
+/ `ColumnarOutputWriter` / `GpuFileFormatWriter` pipeline with
+`GpuDataWritingCommandExec` on top (SURVEY.md §2.2-B "Writes"; reference
+mount empty). Encode happens on host Arrow after a single device->host
+download per batch; dynamic partitioning writes hive-style
+``key=value/part-*.parquet`` directories.
+"""
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Iterator, List, Optional, Sequence
+
+import pyarrow as pa
+import pyarrow.dataset as pads
+
+from .. import datatypes as dt
+from ..columnar.arrow_bridge import arrow_schema, device_to_arrow
+from ..config import RapidsConf, register
+from ..exec.base import ExecCtx, TpuExec, UnaryExec
+
+__all__ = ["TpuFileWriteExec", "write_files"]
+
+PARQUET_COMPRESSION = register(
+    "spark.sql.parquet.compression.codec", "snappy",
+    "Compression codec for Parquet writes: none, snappy, zstd, lz4, gzip.")
+
+_FMT_EXT = {"parquet": "parquet", "orc": "orc", "csv": "csv"}
+
+
+def _write_one(table: pa.Table, path: str, fmt: str, compression: str):
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+        pq.write_table(table, path,
+                       compression=None if compression == "none"
+                       else compression)
+    elif fmt == "orc":
+        from pyarrow import orc
+        orc.write_table(table, path)
+    elif fmt == "csv":
+        from pyarrow import csv
+        csv.write_csv(table, path)
+    else:
+        raise ValueError(f"unknown write format {fmt!r}")
+
+
+def write_files(batches: Iterator[pa.RecordBatch], schema: pa.Schema,
+                path: str, fmt: str = "parquet",
+                partition_by: Optional[Sequence[str]] = None,
+                compression: str = "snappy",
+                rows_per_file: int = 1 << 22,
+                task_id: str = "00000") -> List[str]:
+    """Write host batches as part files under `path`; returns the files
+    written. Partitioned writes produce hive-style directories."""
+    os.makedirs(path, exist_ok=True)
+    ext = _FMT_EXT[fmt]
+    written: List[str] = []
+    if partition_by:
+        if fmt != "parquet":
+            raise ValueError("partitioned writes support parquet only")
+        table = pa.Table.from_batches(list(batches), schema=schema)
+        fmt_obj = pads.ParquetFileFormat()
+        opts = fmt_obj.make_write_options(
+            compression=None if compression == "none" else compression)
+        pads.write_dataset(
+            table, path, format=fmt_obj, file_options=opts,
+            partitioning=pads.partitioning(
+                pa.schema([schema.field(c) for c in partition_by]),
+                flavor="hive"),
+            basename_template=f"part-{task_id}-{{i}}.{ext}",
+            existing_data_behavior="overwrite_or_ignore")
+        for root, _dirs, files in os.walk(path):
+            written.extend(os.path.join(root, f) for f in files
+                           if f.startswith(f"part-{task_id}-"))
+        return sorted(written)
+    pending: List[pa.RecordBatch] = []
+    pending_rows = 0
+    part = 0
+
+    def flush():
+        nonlocal pending, pending_rows, part
+        table = pa.Table.from_batches(pending, schema=schema)
+        f = os.path.join(path, f"part-{task_id}-{part:05d}.{ext}")
+        _write_one(table, f, fmt, compression)
+        written.append(f)
+        part += 1
+        pending, pending_rows = [], 0
+
+    for rb in batches:
+        pending.append(rb)
+        pending_rows += rb.num_rows
+        if pending_rows >= rows_per_file:
+            flush()
+    if pending or not written:
+        flush()  # always produce at least one (possibly empty) part file
+    return written
+
+
+class TpuFileWriteExec(UnaryExec):
+    """Write the child's output to files (GpuDataWritingCommandExec
+    analog). Yields no batches — like Spark's write command, the result is
+    the side effect; `written_files` records what was produced."""
+
+    def __init__(self, child: TpuExec, path: str, fmt: str = "parquet",
+                 partition_by: Optional[Sequence[str]] = None,
+                 conf: Optional[RapidsConf] = None):
+        super().__init__(child)
+        self.path = path
+        self.fmt = fmt
+        self.partition_by = list(partition_by) if partition_by else None
+        conf = conf or RapidsConf()
+        self.compression = conf.get(PARQUET_COMPRESSION)
+        self.written_files: List[str] = []
+
+    def describe(self):
+        part = f" partitionBy={self.partition_by}" if self.partition_by \
+            else ""
+        return f"FileWriteExec [{self.fmt} -> {self.path}{part}]"
+
+    def pretty_name(self):
+        return "FileWriteExec"
+
+    def tpu_supported(self):
+        if self.fmt not in _FMT_EXT:
+            return f"write format {self.fmt} not supported"
+        return None
+
+    def _task_id(self):
+        return uuid.uuid4().hex[:8]
+
+    def execute(self, ctx: ExecCtx):
+        t = ctx.metric(self, "writeTime")
+        t0 = time.perf_counter()
+        schema = arrow_schema(self.child.output_schema)
+        self.written_files = write_files(
+            (device_to_arrow(b) for b in self.child.execute(ctx)),
+            schema, self.path, self.fmt, self.partition_by,
+            self.compression, task_id=self._task_id())
+        t.value += time.perf_counter() - t0
+        return iter(())
+
+    def execute_cpu(self, ctx: ExecCtx):
+        schema = arrow_schema(self.child.output_schema)
+        self.written_files = write_files(
+            iter(self.child.execute_cpu(ctx)),
+            schema, self.path, self.fmt, self.partition_by,
+            self.compression, task_id=self._task_id())
+        return iter(())

@@ -1,0 +1,222 @@
+"""File scan + write tests (reference: integration_tests parquet_test.py /
+orc_test.py / csv_test.py / *_write_test.py — SURVEY.md §4.1; reader
+modes + round-trip shapes from §2.2-B Scans/Writes)."""
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from asserts import assert_tpu_and_cpu_plan_equal
+from data_gen import (all_basic_gens, gen_table, DateGen, DecimalGen,
+                      IntegerGen, LongGen, FloatGen, StringGen)
+
+from spark_rapids_tpu import datatypes as dt
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.exec.base import ExecCtx, collect_arrow, \
+    collect_arrow_cpu
+from spark_rapids_tpu.exec.basic import TpuFilterExec, TpuProjectExec
+from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+from spark_rapids_tpu.expr import (Alias, And, GreaterThanOrEqual, LessThan,
+                                   Literal, Multiply,
+                                   UnresolvedColumn as col)
+from spark_rapids_tpu.expr.aggregates import Sum
+from spark_rapids_tpu.io import (FileSplit, TpuFileScanExec,
+                                 TpuFileWriteExec, plan_splits)
+from spark_rapids_tpu.planner import overrides
+
+
+def _canon(table):
+    """to_pydict with NaN mapped to a comparable token (NaN != NaN)."""
+    import math
+    return {name: ["NaN" if isinstance(v, float) and math.isnan(v) else v
+                   for v in vals]
+            for name, vals in table.to_pydict().items()}
+
+
+def _write_parquet(tmp_path, rb, name="data.parquet", row_group_size=None):
+    p = os.path.join(str(tmp_path), name)
+    pq.write_table(pa.Table.from_batches([rb]), p,
+                   row_group_size=row_group_size)
+    return p
+
+
+def test_parquet_scan_all_basic_types(tmp_path):
+    rb = gen_table(all_basic_gens, n=500)
+    p = _write_parquet(tmp_path, rb)
+    assert_tpu_and_cpu_plan_equal(TpuFileScanExec([p]))
+
+
+def test_parquet_scan_multi_file_reader_modes(tmp_path):
+    paths = []
+    for i in range(6):
+        rb = gen_table([IntegerGen(), StringGen(), FloatGen(dt.FLOAT64)],
+                       n=200 + i, seed=100 + i)
+        paths.append(_write_parquet(tmp_path, rb, f"f{i}.parquet"))
+    results = {}
+    for mode in ("PERFILE", "MULTITHREADED", "COALESCING"):
+        conf = RapidsConf({
+            "spark.rapids.sql.format.parquet.reader.type": mode})
+        scan = TpuFileScanExec(paths, conf=conf)
+        results[mode] = assert_tpu_and_cpu_plan_equal(scan, conf=conf)
+    # all reader modes agree (same rows, same order: split-ordered)
+    assert _canon(results["PERFILE"]) == _canon(results["MULTITHREADED"])
+    assert sorted(map(tuple, results["PERFILE"].to_pylist()[0:0])) == []
+    assert results["COALESCING"].num_rows == results["PERFILE"].num_rows
+
+
+def test_parquet_row_group_splits(tmp_path):
+    rb = gen_table([LongGen(null_frac=0)], n=4000)
+    p = _write_parquet(tmp_path, rb, row_group_size=256)
+    splits = plan_splits([p], "parquet", max_partition_bytes=8 << 10)
+    assert len(splits) > 1
+    covered = [g for s in splits for g in s.row_groups]
+    assert covered == sorted(set(covered))  # disjoint + complete
+    assert_tpu_and_cpu_plan_equal(TpuFileScanExec([p]))
+
+
+def test_parquet_column_projection(tmp_path):
+    rb = gen_table([IntegerGen(), StringGen(), DateGen()],
+                   names=["a", "b", "c"])
+    p = _write_parquet(tmp_path, rb)
+    scan = TpuFileScanExec([p], columns=["c", "a"])
+    assert scan.output_schema.names == ["c", "a"]
+    assert_tpu_and_cpu_plan_equal(scan)
+
+
+def test_parquet_predicate_pushdown_prunes_and_stays_correct(tmp_path):
+    # ascending key -> row group stats are tight -> pruning provable
+    n = 4096
+    key = pa.array(np.arange(n, dtype=np.int64))
+    val = pa.array(np.arange(n, dtype=np.float64) * 0.5)
+    rb = pa.record_batch({"k": key, "v": val})
+    p = _write_parquet(tmp_path, rb, row_group_size=512)
+    cond = And(GreaterThanOrEqual(col("k"), Literal(1000, dt.INT64)),
+               LessThan(col("k"), Literal(1500, dt.INT64)))
+    scan = TpuFileScanExec([p], pushdown=cond)
+    plan = TpuFilterExec(cond, scan)
+    out = assert_tpu_and_cpu_plan_equal(plan)
+    assert out.num_rows == 500
+    # pruning really skipped groups: decode only touches 2 of 8
+    from spark_rapids_tpu.io.scan import _decode_split, _simple_conjuncts
+    rbs = _decode_split(FileSplit(p), "parquet", None, 1 << 20,
+                        _simple_conjuncts(cond))
+    assert sum(r.num_rows for r in rbs) <= 1024
+
+
+def test_csv_scan(tmp_path):
+    rb = gen_table([IntegerGen(), FloatGen(dt.FLOAT64),
+                    StringGen(ascii_only=True,
+                              charset="abcdefgh123")], n=300)
+    import pyarrow.csv as pcsv
+    p = os.path.join(str(tmp_path), "data.csv")
+    pcsv.write_csv(pa.Table.from_batches([rb]), p)
+    assert_tpu_and_cpu_plan_equal(TpuFileScanExec([p], fmt="csv"))
+
+
+def test_json_scan(tmp_path):
+    rb = gen_table([IntegerGen(), LongGen(), StringGen(ascii_only=True)],
+                   n=200)
+    p = os.path.join(str(tmp_path), "data.json")
+    with open(p, "w") as f:
+        for row in pa.Table.from_batches([rb]).to_pylist():
+            import json
+            f.write(json.dumps(row) + "\n")
+    assert_tpu_and_cpu_plan_equal(TpuFileScanExec([p], fmt="json"))
+
+
+def test_orc_scan(tmp_path):
+    from pyarrow import orc
+    rb = gen_table([IntegerGen(), LongGen(), FloatGen(dt.FLOAT64),
+                    StringGen()], n=300)
+    p = os.path.join(str(tmp_path), "data.orc")
+    orc.write_table(pa.Table.from_batches([rb]), p)
+    assert_tpu_and_cpu_plan_equal(TpuFileScanExec([p], fmt="orc"))
+
+
+@pytest.mark.parametrize("fmt", ["parquet", "orc", "csv"])
+def test_write_round_trip(tmp_path, fmt):
+    """BASELINE config-5 shape: write via device path and CPU path, read
+    both back, results equal (write dual-run)."""
+    gens = [IntegerGen(), LongGen(), FloatGen(dt.FLOAT64)]
+    if fmt != "csv":
+        gens += [StringGen(), DateGen()]
+    rb = gen_table(gens, n=700)
+    from spark_rapids_tpu.exec.base import HostBatchSourceExec
+    src = HostBatchSourceExec([rb])
+    dev_dir = os.path.join(str(tmp_path), "dev")
+    cpu_dir = os.path.join(str(tmp_path), "cpu")
+
+    w = TpuFileWriteExec(src, dev_dir, fmt=fmt)
+    list(w.execute(ExecCtx()))
+    assert w.written_files
+    w2 = TpuFileWriteExec(src, cpu_dir, fmt=fmt)
+    list(w2.execute_cpu(ExecCtx()))
+
+    back_dev = collect_arrow_cpu(TpuFileScanExec(w.written_files, fmt=fmt))
+    back_cpu = collect_arrow_cpu(TpuFileScanExec(w2.written_files, fmt=fmt))
+    assert _canon(back_dev) == _canon(back_cpu)
+    # and the device-read of what the device wrote matches the source
+    again = collect_arrow(TpuFileScanExec(w.written_files, fmt=fmt))
+    assert again.num_rows == rb.num_rows
+
+
+def test_partitioned_write(tmp_path):
+    rb = gen_table([IntegerGen(min_val=0, max_val=3, null_frac=0),
+                    LongGen(), StringGen()], names=["part", "v", "s"])
+    from spark_rapids_tpu.exec.base import HostBatchSourceExec
+    src = HostBatchSourceExec([rb])
+    out = os.path.join(str(tmp_path), "out")
+    w = TpuFileWriteExec(src, out, fmt="parquet", partition_by=["part"])
+    list(w.execute(ExecCtx()))
+    assert any("part=" in f for f in w.written_files)
+    import pyarrow.dataset as pads
+    back = pads.dataset(out, format="parquet",
+                        partitioning="hive").to_table()
+    assert back.num_rows == rb.num_rows
+    assert sorted(back.column("v").to_pylist(), key=lambda x: (x is None, x)) \
+        == sorted(rb.column(1).to_pylist(), key=lambda x: (x is None, x))
+
+
+def test_scan_q6_pipeline_through_planner(tmp_path):
+    """Scan -> filter -> project -> agg, planned via TpuOverrides: the full
+    BASELINE config-1 pipeline starting at real files."""
+    n = 5000
+    rng = np.random.default_rng(3)
+    rb = pa.record_batch({
+        "l_quantity": pa.array(rng.uniform(1, 50, n).astype(np.float32)),
+        "l_extendedprice": pa.array(
+            rng.uniform(900, 105000, n).astype(np.float32)),
+        "l_discount": pa.array(
+            (rng.integers(0, 11, n) / 100).astype(np.float32)),
+        "l_shipdate": pa.array(
+            rng.integers(8000, 10600, n).astype(np.int32)),
+    })
+    p = _write_parquet(tmp_path, rb)
+    d = lambda v: Literal(np.float32(v), dt.FLOAT32)
+    cond = And(And(GreaterThanOrEqual(col("l_shipdate"),
+                                      Literal(8766, dt.INT32)),
+                   LessThan(col("l_shipdate"), Literal(9131, dt.INT32))),
+               LessThan(col("l_quantity"), d(24.0)))
+    scan = TpuFileScanExec([p], pushdown=cond)
+    filt = TpuFilterExec(cond, scan)
+    proj = TpuProjectExec([Alias(Multiply(col("l_extendedprice"),
+                                          col("l_discount")), "rev")], filt)
+    agg = TpuHashAggregateExec([], [Alias(Sum(col("rev")), "revenue")], proj)
+    pp = overrides(agg)
+    assert pp.fallback_nodes() == []
+    got = pp.collect()
+    exp = collect_arrow_cpu(agg)
+    assert abs(got.column(0)[0].as_py() - exp.column(0)[0].as_py()) \
+        <= 1e-6 * abs(exp.column(0)[0].as_py())
+
+
+def test_scan_falls_back_when_format_disabled(tmp_path):
+    rb = gen_table([IntegerGen()], n=50)
+    p = _write_parquet(tmp_path, rb)
+    conf = RapidsConf({"spark.rapids.sql.exec.FileScanExec": "false"})
+    pp = overrides(TpuFileScanExec([p]), conf)
+    assert "FileScanExec" in pp.fallback_nodes()
+    got = pp.collect()
+    assert got.num_rows == 50
