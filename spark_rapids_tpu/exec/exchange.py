@@ -57,9 +57,14 @@ class TpuShuffleExchangeExec(UnaryExec):
         return tuple(batch.with_selection(pids == jnp.int32(p))
                      for p in range(self.partitioning.num_partitions))
 
+    def _pids(self, batch: TpuBatch, ectx):
+        return self.partitioning.partition_ids_device(batch, ectx)
+
     def execute(self, ctx: ExecCtx):
+        unsplit = getattr(self.transport, "supports_unsplit", False)
         if self._jit_split is None:
-            self._jit_split = jax.jit(self._split, static_argnums=1)
+            fn = self._pids if unsplit else self._split
+            self._jit_split = jax.jit(fn, static_argnums=1)
         n = self.partitioning.num_partitions
         sid = next(_shuffle_ids)
         self.transport.register_shuffle(sid, n)
@@ -69,7 +74,10 @@ class TpuShuffleExchangeExec(UnaryExec):
         for map_id, batch in enumerate(self.child.execute(ctx)):
             writer = self.transport.writer(sid, map_id)
             t0 = time.perf_counter()
-            if n == 1:
+            if unsplit:
+                writer.write_unsplit(batch,
+                                     self._jit_split(batch, ctx.eval_ctx))
+            elif n == 1:
                 writer.write(0, batch)
             else:
                 parts = self._jit_split(batch, ctx.eval_ctx)

@@ -9,29 +9,42 @@ rows land on their owners in a single SPMD step. Cross-slice traffic rides
 DCN through the same collective; the host/local transport remains the
 fallback when the mesh isn't whole (SURVEY.md §7.3.2).
 
-The kernel is fixed-width-column based (strings ride the host fallback
-until byte-matrix exchange lands). Data layout per device: padded row
-blocks of static capacity with a live row count — same discipline as
-TpuBatch.
+Two layers:
+
+- `make_ici_all_to_all` — the raw SPMD kernel over padded row blocks.
+  Lanes may be 1-D ``(cap,)`` fixed-width columns or 2-D ``(cap, B)``
+  byte matrices (how strings ride the collective).
+- `IciShuffleTransport` — plugs the kernel in behind the engine's
+  `ShuffleTransport` seam (shuffle/transport.py), so
+  `TpuShuffleExchangeExec` drives the mesh exactly like it drives the
+  local store. Strings are exchanged as (byte-matrix, length) lane pairs
+  and reassembled into (offsets, chars) on the receive side.
 """
 from __future__ import annotations
 
+import threading
 from functools import partial
-from typing import List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["make_ici_all_to_all", "ici_exchange_batches"]
+from ..columnar.batch import TpuBatch, bucket_bytes
+from ..columnar.column import TpuColumnVector
+from .transport import ShuffleTransport, ShuffleWriteHandle
+
+__all__ = ["make_ici_all_to_all", "IciShuffleTransport"]
 
 
-def _local_exchange(ndev: int, axis: str, datas, valids, pids, row_count):
-    """Per-device body (runs under shard_map). datas/valids: tuples of
-    (cap,) arrays; pids: (cap,) int32; row_count: () int32."""
+def _local_exchange(ndev: int, axis: str, datas, valids, pids, live):
+    """Per-device body (runs under shard_map). datas: tuple of (cap,) or
+    (cap, B) lanes; valids: tuple of (cap,) bool; pids: (cap,) int32;
+    live: (cap,) bool marking rows that participate (selection-mask
+    aware — live rows need NOT be a prefix)."""
     cap = pids.shape[0]
-    live = jnp.arange(cap, dtype=jnp.int32) < row_count
-    pid_key = jnp.where(live, pids, ndev)  # padding sorts last
+    pid_key = jnp.where(live, pids, ndev)  # dead rows sort last
     idx = jnp.arange(cap, dtype=jnp.int32)
     _, perm = jax.lax.sort((pid_key, idx), num_keys=2)
     counts = jax.ops.segment_sum(live.astype(jnp.int32),
@@ -39,62 +52,301 @@ def _local_exchange(ndev: int, axis: str, datas, valids, pids, row_count):
                                  num_segments=ndev)
     starts = jnp.cumsum(counts) - counts
 
-    # send matrix slots: send[p, r] = row r of partition p
+    # send matrix slots: send[p, r] = r'th live row of partition p
     r = jnp.arange(cap, dtype=jnp.int32)[None, :]
     slot_valid = r < counts[:, None]                       # (ndev, cap)
     src = jnp.clip(starts[:, None] + r, 0, cap - 1)
     gather_idx = perm[src]                                 # (ndev, cap)
 
     recv_counts = jax.lax.all_to_all(counts[:, None], axis, 0, 0)[:, 0]
-    out_rc = jnp.sum(recv_counts)
     out_live = (jnp.arange(cap, dtype=jnp.int32)[None, :]
                 < recv_counts[:, None]).reshape(-1)
 
     out_datas = []
     out_valids = []
     for d, v in zip(datas, valids):
-        send = jnp.where(slot_valid, d[gather_idx],
-                         jnp.zeros((), d.dtype))
-        recv = jax.lax.all_to_all(send, axis, 0, 0)        # (ndev, cap)
-        out_datas.append(recv.reshape(-1))
+        g = d[gather_idx]                                  # (ndev, cap, ...)
+        sv = slot_valid if d.ndim == 1 else slot_valid[..., None]
+        send = jnp.where(sv, g, jnp.zeros((), d.dtype))
+        recv = jax.lax.all_to_all(send, axis, 0, 0)
+        out_datas.append(recv.reshape((ndev * cap,) + d.shape[1:]))
         sendv = jnp.where(slot_valid, v[gather_idx], False)
         recvv = jax.lax.all_to_all(sendv, axis, 0, 0)
         out_valids.append(recvv.reshape(-1) & out_live)
-    return tuple(out_datas), tuple(out_valids), out_live, out_rc
+    return tuple(out_datas), tuple(out_valids), out_live, \
+        jnp.sum(recv_counts)
 
 
 def make_ici_all_to_all(mesh: Mesh, axis: str = "x"):
     """Build the jitted SPMD exchange: global arrays have a leading device
-    axis of size mesh.shape[axis]; each device's rows are routed to the
-    device named by their partition id in one all_to_all epoch.
+    axis of size mesh.shape[axis]; each device's live rows are routed to
+    the device named by their partition id in one all_to_all epoch.
 
-    Returns fn(datas, valids, pids, row_counts) ->
+    Returns fn(datas, valids, pids, live) ->
       (out_datas, out_valids, out_live, out_row_counts)
-    with shapes (D, cap) -> (D, D*cap); out_live marks slots holding rows.
-    """
+    with shapes (D, cap[, B]) -> (D, D*cap[, B]); out_live marks slots
+    holding rows; out_row_counts is (D,)."""
     ndev = mesh.shape[axis]
+    cache: Dict[Tuple[int, ...], object] = {}
 
-    def spmd(datas, valids, pids, row_counts):
-        body = partial(_local_exchange, ndev, axis)
-        sq = lambda a: a.reshape(a.shape[1:])  # (1, cap) -> (cap,)
-        d = tuple(sq(x) for x in datas)
-        v = tuple(sq(x) for x in valids)
-        od, ov, ol, orc = body(d, v, sq(pids), sq(row_counts))
-        ex = lambda a: a.reshape((1,) + a.shape)
-        return (tuple(ex(x) for x in od), tuple(ex(x) for x in ov),
-                ex(ol), ex(orc))
+    def build(ndims: Tuple[int, ...]):
+        def spmd(datas, valids, pids, live):
+            body = partial(_local_exchange, ndev, axis)
+            sq = lambda a: a.reshape(a.shape[1:])  # drop leading dev dim
+            d = tuple(sq(x) for x in datas)
+            v = tuple(sq(x) for x in valids)
+            od, ov, ol, orc = body(d, v, sq(pids), sq(live))
+            ex = lambda a: a.reshape((1,) + a.shape)
+            return (tuple(ex(x) for x in od), tuple(ex(x) for x in ov),
+                    ex(ol), orc.reshape((1,)))
 
-    spec_in = P(axis, None)
-    spec_scalar = P(axis)
-    mapped = jax.shard_map(
-        spmd, mesh=mesh,
-        in_specs=(spec_in, spec_in, spec_in, spec_scalar),
-        out_specs=(spec_in, spec_in, spec_in, spec_scalar))
-    return jax.jit(mapped)
+        lane = lambda nd: P(axis, *([None] * (nd - 1)))
+        in_specs = (tuple(lane(nd) for nd in ndims),
+                    tuple(P(axis, None) for _ in ndims),
+                    P(axis, None), P(axis, None))
+        out_specs = (tuple(lane(nd) for nd in ndims),
+                     tuple(P(axis, None) for _ in ndims),
+                     P(axis, None), P(axis))
+        return jax.jit(jax.shard_map(spmd, mesh=mesh, in_specs=in_specs,
+                                     out_specs=out_specs))
+
+    def fn(datas, valids, pids, live):
+        datas = tuple(datas)
+        key = tuple(d.ndim for d in datas)
+        if key not in cache:
+            cache[key] = build(key)
+        return cache[key](datas, tuple(valids), pids, live)
+
+    return fn
 
 
-def ici_exchange_batches(mesh: Mesh, datas, valids, pids, row_counts,
-                         axis: str = "x"):
-    """Convenience wrapper: one exchange over already-stacked arrays."""
-    fn = make_ici_all_to_all(mesh, axis)
-    return fn(tuple(datas), tuple(valids), pids, row_counts)
+# --------------------------------------------------------------------------
+# Transport-seam integration
+# --------------------------------------------------------------------------
+
+def _string_to_matrix(col: TpuColumnVector, cap: int, width: int):
+    """(offsets, chars) -> ((cap, width) byte matrix, (cap,) lengths)."""
+    offs = col.offsets
+    lengths = (offs[1:] - offs[:-1]).astype(jnp.int32)
+    j = jnp.arange(width, dtype=jnp.int32)[None, :]
+    src = jnp.clip(offs[:-1, None] + j, 0, max(col.chars.shape[0] - 1, 0))
+    if col.chars.shape[0] == 0:
+        mat = jnp.zeros((cap, width), jnp.uint8)
+    else:
+        mat = jnp.where(j < lengths[:, None], col.chars[src], jnp.uint8(0))
+    return mat, lengths
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _matrix_to_string(mat, lengths, live, char_cap: int):
+    """Inverse: ((n, B), (n,), (n,)) -> (offsets (n+1,), chars)."""
+    n = lengths.shape[0]
+    ll = jnp.where(live, lengths, 0)
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(ll).astype(jnp.int32)])
+    total = offsets[-1]
+    k = jnp.arange(char_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(offsets, k, side="right") - 1, 0, n - 1)
+    colk = jnp.clip(k - offsets[row], 0, mat.shape[1] - 1)
+    chars = jnp.where(k < total, mat[row, colk], jnp.uint8(0))
+    return offsets, chars
+
+
+class _IciWriter(ShuffleWriteHandle):
+    def __init__(self, transport: "IciShuffleTransport", sid: int,
+                 map_id: int):
+        self._t = transport
+        self._sid = sid
+        self._mid = map_id
+
+    def write(self, partition_id: int, batch: TpuBatch) -> None:
+        raise RuntimeError(
+            "IciShuffleTransport exchanges whole batches (write_unsplit); "
+            "the per-partition write path belongs to host transports")
+
+    def write_unsplit(self, batch: TpuBatch, pids) -> None:
+        with self._t._lock:
+            self._t._pending[self._sid].append((self._mid, batch, pids))
+
+
+class IciShuffleTransport(ShuffleTransport):
+    """SPMD exchange over a device mesh behind the ShuffleTransport seam.
+
+    Map tasks are device-resident row blocks (one per mesh position, in
+    map-id order); `read_partition(p)` serves the rows the collective
+    landed on device p. The whole shuffle is ONE all_to_all epoch — the
+    reference's client/server pull machinery (SURVEY.md §3.4) collapses
+    into a single XLA collective. Requires num_partitions == mesh size;
+    strings ride as (byte-matrix, lengths) lane pairs."""
+
+    supports_unsplit = True
+
+    def __init__(self, mesh: Mesh, axis: str = "x"):
+        self.mesh = mesh
+        self.axis = axis
+        self.ndev = mesh.shape[axis]
+        self._exchange = make_ici_all_to_all(mesh, axis)
+        self._pending: Dict[int, List[Tuple[int, TpuBatch, object]]] = {}
+        self._results: Dict[int, List[List[TpuBatch]]] = {}
+        self._lock = threading.Lock()
+
+    def register_shuffle(self, shuffle_id: int, num_partitions: int):
+        if num_partitions != self.ndev:
+            raise ValueError(
+                f"ICI exchange requires num_partitions == mesh size "
+                f"({self.ndev}), got {num_partitions}")
+        with self._lock:
+            self._pending.setdefault(shuffle_id, [])
+
+    def writer(self, shuffle_id: int, map_id: int) -> ShuffleWriteHandle:
+        return _IciWriter(self, shuffle_id, map_id)
+
+    def read_partition(self, shuffle_id: int, partition_id: int):
+        self._realize(shuffle_id)
+        for b in self._results.get(shuffle_id, [[]] * self.ndev)[
+                partition_id]:
+            yield b
+
+    def unregister_shuffle(self, shuffle_id: int):
+        with self._lock:
+            self._pending.pop(shuffle_id, None)
+            self._results.pop(shuffle_id, None)
+
+    # -- the collective epoch ---------------------------------------------
+    def _realize(self, sid: int):
+        with self._lock:
+            if sid in self._results:
+                return
+            maps = sorted(self._pending.get(sid, []), key=lambda e: e[0])
+        if not maps:
+            self._results[sid] = [[] for _ in range(self.ndev)]
+            return
+        if len(maps) > self.ndev:
+            raise ValueError(
+                f"{len(maps)} map blocks > mesh size {self.ndev}; "
+                f"coalesce map output or fall back to the host transport")
+        schema = maps[0][1].schema
+        ndev = self.ndev
+        cap = max(b.capacity for _, b, _ in maps)
+
+        # static byte width per string column: max live row length
+        widths: Dict[int, int] = {}
+        for ci, f in enumerate(schema.fields):
+            if maps[0][1].column(ci).is_string_like:
+                w = 1
+                for _, b, _ in maps:
+                    c = b.column(ci)
+                    lens = np.asarray(jax.device_get(
+                        c.offsets[1:] - c.offsets[:-1]))
+                    if lens.size:
+                        w = max(w, int(lens.max()))
+                widths[ci] = bucket_bytes(w, minimum=8)
+
+        # stack lanes across map blocks (missing blocks = dead rows)
+        lane_datas: List[List[jax.Array]] = []
+        lane_valids: List[List[jax.Array]] = []
+        lane_meta: List[Tuple[int, str]] = []  # (col idx, kind)
+        for ci, f in enumerate(schema.fields):
+            if ci in widths:
+                lane_meta.append((ci, "str_mat"))
+                lane_meta.append((ci, "str_len"))
+                lane_datas.extend(([], []))
+                lane_valids.extend(([], []))
+            else:
+                lane_meta.append((ci, "fixed"))
+                lane_datas.append([])
+                lane_valids.append([])
+
+        pids_all, live_all = [], []
+        by_mid = {m: (b, p) for m, b, p in maps}
+        for dev in range(ndev):
+            if dev in by_mid:
+                b, pids = by_mid[dev]
+                live = b.live_mask()
+                pids = _pad1(pids.astype(jnp.int32), cap)
+                live = _pad1(live, cap)
+            else:
+                b = None
+                pids = jnp.zeros((cap,), jnp.int32)
+                live = jnp.zeros((cap,), jnp.bool_)
+            pids_all.append(pids)
+            live_all.append(live)
+            li = 0
+            for ci, f in enumerate(schema.fields):
+                if b is None:
+                    col = TpuColumnVector.nulls(f.dtype, cap)
+                else:
+                    col = b.column(ci)
+                valid = _pad1(col.validity, cap)
+                if ci in widths:
+                    w = widths[ci]
+                    mat, lens = _string_to_matrix(col, col.capacity, w)
+                    lane_datas[li].append(_pad2(mat, cap, w))
+                    lane_valids[li].append(valid)
+                    lane_datas[li + 1].append(_pad1(lens, cap))
+                    lane_valids[li + 1].append(valid)
+                    li += 2
+                else:
+                    lane_datas[li].append(_pad1(col.data, cap))
+                    lane_valids[li].append(valid)
+                    li += 1
+
+        shard = lambda a: jax.device_put(a, NamedSharding(
+            self.mesh, P(self.axis, *([None] * (a.ndim - 1)))))
+        datas = tuple(shard(jnp.stack(ls)) for ls in lane_datas)
+        valids = tuple(shard(jnp.stack(ls)) for ls in lane_valids)
+        pids_g = shard(jnp.stack(pids_all))
+        live_g = shard(jnp.stack(live_all))
+
+        out_datas, out_valids, out_live, out_rc = self._exchange(
+            datas, valids, pids_g, live_g)
+        out_rc_host = np.asarray(jax.device_get(out_rc))
+
+        results: List[List[TpuBatch]] = []
+        for p in range(ndev):
+            if out_rc_host[p] == 0:
+                results.append([])
+                continue
+            live_p = out_live[p]
+            cols: List[Optional[TpuColumnVector]] = [None] * len(
+                schema.fields)
+            li = 0
+            while li < len(lane_meta):
+                ci, kind = lane_meta[li]
+                f = schema.fields[ci]
+                if kind == "str_mat":
+                    mat = out_datas[li][p]
+                    lens = out_datas[li + 1][p]
+                    valid = out_valids[li][p]
+                    total = int(jax.device_get(jnp.sum(
+                        jnp.where(live_p, lens, 0))))
+                    ccap = bucket_bytes(max(total, 1), minimum=16)
+                    offs, chars = _matrix_to_string(mat, lens, live_p,
+                                                    ccap)
+                    cols[ci] = TpuColumnVector(f.dtype, validity=valid,
+                                               offsets=offs, chars=chars)
+                    li += 2
+                else:
+                    cols[ci] = TpuColumnVector(
+                        f.dtype, data=out_datas[li][p],
+                        validity=out_valids[li][p])
+                    li += 1
+            results.append([TpuBatch(cols, schema, ndev * cap,
+                                     selection=live_p)])
+        with self._lock:
+            self._results[sid] = results
+            self._pending.pop(sid, None)
+
+
+def _pad1(a, cap: int):
+    if a.shape[0] == cap:
+        return a
+    return jnp.pad(a, (0, cap - a.shape[0]))
+
+
+def _pad2(a, cap: int, width: int):
+    pr = cap - a.shape[0]
+    pc = width - a.shape[1]
+    if pr == 0 and pc == 0:
+        return a
+    return jnp.pad(a, ((0, pr), (0, pc)))

@@ -31,12 +31,23 @@ class ShuffleWriteHandle:
     def write(self, partition_id: int, batch: TpuBatch) -> None:
         raise NotImplementedError
 
+    def write_unsplit(self, batch: TpuBatch, pids) -> None:
+        """Hand the transport the WHOLE batch plus per-row partition ids —
+        the path SPMD transports take (the collective routes rows itself;
+        a host-side per-partition split would defeat it). Only called when
+        the transport declares `supports_unsplit`."""
+        raise NotImplementedError
+
     def close(self) -> None:
         pass
 
 
 class ShuffleTransport:
     """Moves per-partition batches between map and reduce sides."""
+
+    #: True when writers take (batch, pids) whole via write_unsplit
+    #: instead of pre-split per-partition batches.
+    supports_unsplit = False
 
     def register_shuffle(self, shuffle_id: int, num_partitions: int) -> None:
         raise NotImplementedError
