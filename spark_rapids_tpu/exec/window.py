@@ -1,0 +1,654 @@
+"""Window operator.
+
+TPU analog of the reference's `GpuWindowExec` (+ the rolling-window cudf
+kernels behind it — SURVEY.md §2.2-B "Window", ~3k-LoC reference
+component; mount empty, built from the capability inventory), designed
+the TPU way (SURVEY.md §7.1.3): one sorted, segmented device pass per
+window spec instead of per-row frame loops.
+
+  1. rows are sorted once by (partition keys, order keys) with the same
+     lane machinery as sort/aggregate (`ops.sort_keys`);
+  2. partition / peer-group boundaries come from lane-change flags;
+     segment starts/ends are log-depth `associative_scan` max/min — no
+     serial loops, no scatters;
+  3. per function:
+     - ranking (row_number/rank/dense_rank/percent_rank/ntile) is pure
+       index arithmetic over the boundary scans;
+     - sum/count/avg over ANY rows/peer frame is an inclusive prefix
+       scan + two clamped gathers (prefix difference) — O(n) for every
+       frame width;
+     - min/max and ignore-nulls first/last use an argmin machine: a
+       segmented (lane, position) scan for frames unbounded on one side,
+       or an (n, width) windowed-gather reduce for bounded rows frames
+       (width <= expr.window.MAX_GATHER_FRAME, else CPU fallback);
+     - lag/lead/first/last are clamped gathers.
+
+All window expressions of one spec are computed in ONE jitted program
+over the concatenated input (like the reference computing all window
+columns per projected batch).
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import pyarrow as pa
+
+from .. import datatypes as dt
+from ..columnar.arrow_bridge import arrow_schema
+from ..columnar.batch import TpuBatch
+from ..columnar.column import TpuColumnVector
+from ..expr.aggregates import (AggregateFunction, Average, Count, Max, Min,
+                               Sum, _FirstLast)
+from ..expr.base import Alias, Expression, bind_expr
+from ..expr.window import (DenseRank, Lag, Lead, NTile, PercentRank, Rank,
+                           RowNumber, WindowExpression, _OffsetFunction)
+from ..ops.concat import concat_batches
+from ..ops.gather import gather_batch, gather_column
+from ..ops.sort_keys import (SortSpec, key_lanes, normalize_float_key_col,
+                             orderable_int)
+from .base import ExecCtx, TpuExec, UnaryExec
+from .sort import SortOrder, cpu_sort_table
+
+__all__ = ["TpuWindowExec"]
+
+_I64 = jnp.int64
+_SENTINEL = jnp.iinfo(jnp.int64).max
+
+
+def _scan_max(x):
+    return jax.lax.associative_scan(jnp.maximum, x)
+
+
+def _scan_min_rev(x):
+    return jax.lax.associative_scan(jnp.minimum, x, reverse=True)
+
+
+def _scan_add(x):
+    return jax.lax.associative_scan(jnp.add, x)
+
+
+def _lex_lt(a, b):
+    """Elementwise lexicographic a < b over tuples of arrays."""
+    lt = jnp.zeros(a[0].shape, jnp.bool_)
+    eq = jnp.ones(a[0].shape, jnp.bool_)
+    for x, y in zip(a, b):
+        lt = lt | (eq & (x < y))
+        eq = eq & (x == y)
+    return lt
+
+
+def _argmin_scan(keys, reset, reverse=False):
+    """Segmented running lexicographic-min over a tuple of key lanes: the
+    run restarts where `reset` is True (in scan direction — pass
+    segment-END flags with reverse=True). Log-depth associative_scan;
+    returns the running value of every key lane. The first lane is an
+    explicit invalid flag (0 = candidate), NOT a sentinel folded into the
+    value lane — a sentinel would collide with legitimate extreme values
+    (e.g. min over all-Long.MaxValue frames)."""
+
+    def comb(a, b):
+        af, ak = a[0], a[1:]
+        bf, bk = b[0], b[1:]
+        take_a = _lex_lt(ak, bk)
+        out = tuple(jnp.where(bf, y, jnp.where(take_a, x, y))
+                    for x, y in zip(ak, bk))
+        return (af | bf,) + out
+
+    res = jax.lax.associative_scan(comb, (reset,) + tuple(keys),
+                                   reverse=reverse)
+    return res[1:]
+
+
+class TpuWindowExec(UnaryExec):
+    """Computes a list of window expressions sharing one partition/order
+    spec; output = child columns (in sorted order) + one column per
+    window expression."""
+
+    def __init__(self, window_exprs: Sequence[Expression], child: TpuExec):
+        super().__init__(child)
+        self.win_exprs: List[WindowExpression] = []
+        self.win_names: List[str] = []
+        for e in window_exprs:
+            bound = bind_expr(e, child.output_schema)
+            if isinstance(bound, Alias):
+                name, we = bound.name, bound.child
+            else:
+                we = bound
+                name = None
+            if not isinstance(we, WindowExpression):
+                raise TypeError(f"not a window expression: {e!r}")
+            if name is None:
+                name = we.func.pretty_name().lower()
+            self.win_exprs.append(we)
+            self.win_names.append(name)
+        if not self.win_exprs:
+            raise ValueError("window exec needs at least one expression")
+        sig = self.win_exprs[0].spec_signature()
+        for we in self.win_exprs[1:]:
+            if we.spec_signature() != sig:
+                raise ValueError(
+                    "one TpuWindowExec handles one window spec; plan one "
+                    f"exec per spec ({sig!r} vs {we.spec_signature()!r})")
+        self.part_exprs = list(self.win_exprs[0].partition_by)
+        self.orders: List[SortOrder] = self.win_exprs[0].order_by
+        wfields = [dt.StructField(n, we.dtype, we.nullable)
+                   for we, n in zip(self.win_exprs, self.win_names)]
+        self._schema = dt.Schema(list(child.output_schema.fields) + wfields)
+        self._jitted = None
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    def describe(self):
+        ws = "; ".join(f"{we!r} AS {n}"
+                       for we, n in zip(self.win_exprs, self.win_names))
+        return f"WindowExec [{ws}]"
+
+    def expressions(self):
+        return list(self.win_exprs)
+
+    # --- device path ------------------------------------------------------
+
+    def _window_batch(self, batch: TpuBatch, ectx) -> TpuBatch:
+        live = batch.live_mask()
+        cap = batch.capacity
+        pkeys = [normalize_float_key_col(e.eval_tpu(batch, ectx))
+                 for e in self.part_exprs]
+        okeys = [o.child.eval_tpu(batch, ectx) for o in self.orders]
+        specs = [SortSpec()] * len(pkeys) + [o.spec for o in self.orders]
+        lanes = key_lanes(pkeys + okeys, specs, live)
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        sorted_all = jax.lax.sort(tuple(lanes) + (idx,),
+                                  num_keys=len(lanes) + 1)
+        perm = sorted_all[-1]
+        slanes = sorted_all[:-1]
+        n_live = jnp.sum(live.astype(jnp.int32))
+        sorted_live = idx < n_live  # live rows sort first (live-rank lane)
+        npl = 1 + 2 * len(pkeys)  # live lane + (null, value) per part key
+
+        def change_flags(ls):
+            b = jnp.zeros((cap,), jnp.bool_).at[0].set(True)
+            for lane in ls:
+                b = b | jnp.concatenate(
+                    [jnp.zeros((1,), jnp.bool_), lane[1:] != lane[:-1]])
+            return b
+
+        part_flag = change_flags(slanes[:npl])
+        peer_flag = part_flag | change_flags(slanes[npl:]) \
+            if len(slanes) > npl else part_flag
+        end_flag = jnp.concatenate(
+            [part_flag[1:], jnp.ones((1,), jnp.bool_)])
+
+        pos = idx
+        capv = jnp.int32(cap)
+        seg_start = _scan_max(jnp.where(part_flag, pos, -1))
+        seg_end = jnp.concatenate(
+            [_scan_min_rev(jnp.where(part_flag, pos, capv))[1:],
+             jnp.full((1,), capv, jnp.int32)]) - 1
+        peer_start = _scan_max(jnp.where(peer_flag, pos, -1))
+        peer_end = jnp.concatenate(
+            [_scan_min_rev(jnp.where(peer_flag, pos, capv))[1:],
+             jnp.full((1,), capv, jnp.int32)]) - 1
+
+        sbatch = gather_batch(batch, perm, n_live)
+        seg_rows = (seg_end - seg_start + 1).astype(jnp.int32)
+
+        def sgather(expr):
+            col = expr.eval_tpu(batch, ectx)
+            return gather_column(col, perm, sorted_live)
+
+        def frame_bounds(fr):
+            if fr.frame_type == "rows":
+                lo = seg_start if fr.lower is None \
+                    else jnp.maximum(seg_start, pos + fr.lower)
+                hi = seg_end if fr.upper is None \
+                    else jnp.minimum(seg_end, pos + fr.upper)
+            else:  # range: peers at CURRENT ROW bounds (offsets -> CPU)
+                if fr.lower not in (None, 0) or fr.upper not in (None, 0):
+                    # defend in depth: the planner gates this via
+                    # tpu_supported; a direct execute must fail loudly,
+                    # not silently return peer-group results
+                    raise NotImplementedError(
+                        "RANGE frame with literal offsets has no device "
+                        "path (CPU oracle only)")
+                lo = seg_start if fr.lower is None else peer_start
+                hi = seg_end if fr.upper is None else peer_end
+            return lo, hi
+
+        def prefix_frame(contrib, lo, hi, empty):
+            """Frame totals via inclusive prefix difference — valid for
+            any in-segment [lo, hi] because the bounds never cross a
+            partition boundary."""
+            loc = jnp.clip(lo, 0, cap - 1)
+            hic = jnp.clip(hi, 0, cap - 1)
+            p = _scan_add(contrib)
+            total = p[hic] - p[loc] + contrib[loc]
+            return jnp.where(empty, jnp.zeros_like(total), total)
+
+        def argmin_frame(keys, fr, lo, hi):
+            """Running values of every key lane at each row's frame
+            minimum, lexicographic over `keys` (first lane = invalid
+            flag, last lane = position tiebreak).
+
+            Device-supported frames decompose into boundary-aligned
+            scans: every range frame's bounds are peer/segment
+            boundaries, and a rows frame unbounded on one side is a
+            running scan from that side; only bounded-both rows frames
+            need the (n, width) windowed gather."""
+            loc = jnp.clip(lo, 0, cap - 1)
+            hic = jnp.clip(hi, 0, cap - 1)
+            if fr.frame_type == "range":
+                if fr.lower is None:  # [seg_start, hi]
+                    res = _argmin_scan(keys, part_flag)
+                    return tuple(r[hic] for r in res)
+                if fr.upper is None:  # [peer_start, seg_end]
+                    res = _argmin_scan(keys, end_flag, reverse=True)
+                    return tuple(r[loc] for r in res)
+                # (0, 0): the peer group
+                res = _argmin_scan(keys, peer_flag)
+                return tuple(r[hic] for r in res)
+            if fr.lower is None:
+                res = _argmin_scan(keys, part_flag)
+                return tuple(r[hic] for r in res)
+            if fr.upper is None:
+                res = _argmin_scan(keys, end_flag, reverse=True)
+                return tuple(r[loc] for r in res)
+            # bounded rows frame: (n, width) windowed gather, iteratively
+            # narrowing the candidate mask one key lane at a time (packing
+            # lanes into one word would overflow int64)
+            w = fr.upper - fr.lower + 1
+            offs = jnp.arange(w, dtype=jnp.int32)[None, :]
+            src = pos[:, None] + fr.lower + offs
+            sel = (src >= lo[:, None]) & (src <= hi[:, None])
+            srcc = jnp.clip(src, 0, cap - 1)
+            out = []
+            for k in keys:
+                m = k[srcc]
+                bm = jnp.min(jnp.where(sel, m, _SENTINEL), axis=1)
+                sel = sel & (m == bm[:, None])
+                out.append(bm)
+            return tuple(out)
+
+        win_cols: List[TpuColumnVector] = []
+        for we in self.win_exprs:
+            f = we.func
+            fr = we.frame
+            if isinstance(f, RowNumber):
+                win_cols.append(TpuColumnVector(
+                    dt.INT32, data=(pos - seg_start + 1).astype(jnp.int32),
+                    validity=sorted_live))
+                continue
+            if isinstance(f, Rank):
+                win_cols.append(TpuColumnVector(
+                    dt.INT32,
+                    data=(peer_start - seg_start + 1).astype(jnp.int32),
+                    validity=sorted_live))
+                continue
+            if isinstance(f, DenseRank):
+                peer_ord = _scan_add(peer_flag.astype(jnp.int32))
+                dr = peer_ord - peer_ord[jnp.clip(seg_start, 0, cap - 1)] + 1
+                win_cols.append(TpuColumnVector(
+                    dt.INT32, data=dr.astype(jnp.int32),
+                    validity=sorted_live))
+                continue
+            if isinstance(f, PercentRank):
+                rank = (peer_start - seg_start).astype(jnp.float64)
+                den = jnp.maximum(seg_rows - 1, 1).astype(jnp.float64)
+                pr = jnp.where(seg_rows > 1, rank / den, 0.0)
+                win_cols.append(TpuColumnVector(
+                    dt.FLOAT64, data=pr, validity=sorted_live))
+                continue
+            if isinstance(f, NTile):
+                n = jnp.int32(f.buckets)
+                r = (pos - seg_start).astype(jnp.int32)
+                q = seg_rows // n
+                rem = seg_rows % n
+                thr = rem * (q + 1)
+                qd = jnp.maximum(q, 1)
+                bucket = jnp.where(
+                    r < thr, r // jnp.maximum(q + 1, 1),
+                    jnp.where(q > 0, rem + (r - thr) // qd, r))
+                win_cols.append(TpuColumnVector(
+                    dt.INT32, data=(bucket + 1).astype(jnp.int32),
+                    validity=sorted_live))
+                continue
+            if isinstance(f, _OffsetFunction):
+                scol = sgather(f.child)
+                src = pos + f.direction * f.offset
+                ok = (src >= seg_start) & (src <= seg_end) & sorted_live
+                srcc = jnp.clip(src, 0, cap - 1)
+                out = gather_column(scol, srcc, ok)
+                if f.default is not None:
+                    dcol = f.default.eval_tpu(batch, ectx)
+                    out = out.with_arrays(
+                        data=jnp.where(ok, out.data, dcol.data),
+                        validity=jnp.where(ok, out.validity,
+                                           dcol.validity & sorted_live))
+                win_cols.append(out)
+                continue
+            # --- aggregates over the frame -------------------------------
+            lo, hi = frame_bounds(fr)
+            empty = (lo > hi) | ~sorted_live
+            if isinstance(f, Count):
+                if f.children:
+                    vcol = sgather(f.children[0])
+                    contrib = (vcol.validity & sorted_live).astype(_I64)
+                else:
+                    contrib = sorted_live.astype(_I64)
+                cnt = prefix_frame(contrib, lo, hi, empty)
+                win_cols.append(TpuColumnVector(
+                    dt.INT64, data=cnt, validity=sorted_live))
+                continue
+            if isinstance(f, (Sum, Average)):
+                vcol = sgather(f.children[0])
+                valid = vcol.validity & sorted_live
+                floating = dt.is_floating(f.children[0].dtype)
+                if floating:
+                    # prefix differences are poisoned by NaN/inf (NaN-NaN
+                    # = NaN leaks across frames); scan the finite part and
+                    # exact special COUNTS (invertible), and rebuild the
+                    # IEEE result per frame — order-independent, matching
+                    # Spark: any NaN or mixed infs -> NaN, else +-inf.
+                    d = vcol.data.astype(jnp.float64)
+                    isnan = jnp.isnan(d) & valid
+                    ispinf = (d == jnp.inf) & valid
+                    isninf = (d == -jnp.inf) & valid
+                    fin = jnp.where(valid & jnp.isfinite(d), d, 0.0)
+                    s = prefix_frame(fin, lo, hi, empty)
+                    nan_c = prefix_frame(isnan.astype(_I64), lo, hi, empty)
+                    pinf_c = prefix_frame(ispinf.astype(_I64), lo, hi,
+                                          empty)
+                    ninf_c = prefix_frame(isninf.astype(_I64), lo, hi,
+                                          empty)
+                    s = jnp.where(
+                        (nan_c > 0) | ((pinf_c > 0) & (ninf_c > 0)),
+                        jnp.nan,
+                        jnp.where(pinf_c > 0, jnp.inf,
+                                  jnp.where(ninf_c > 0, -jnp.inf, s)))
+                else:
+                    # int64 wrap-around addition is associative AND
+                    # invertible, so prefix differences stay exact even
+                    # through overflow (java long semantics)
+                    contrib = jnp.where(valid, vcol.data.astype(_I64),
+                                        jnp.zeros((), _I64))
+                    s = prefix_frame(contrib, lo, hi, empty)
+                    if isinstance(f, Average):
+                        s = s.astype(jnp.float64)
+                cnt = prefix_frame(valid.astype(_I64), lo, hi, empty)
+                ok = (cnt > 0) & ~empty & sorted_live
+                if isinstance(f, Sum):
+                    if isinstance(f.dtype, dt.DecimalType):
+                        ok = f._null_overflowed(s, ok)
+                    win_cols.append(TpuColumnVector(
+                        f.dtype, data=s.astype(f.dtype.np_dtype),
+                        validity=ok))
+                else:
+                    den = jnp.where(cnt > 0, cnt, 1).astype(jnp.float64)
+                    win_cols.append(TpuColumnVector(
+                        dt.FLOAT64, data=s / den, validity=ok))
+                continue
+            if isinstance(f, (Min, Max)):
+                vcol = sgather(f.children[0])
+                valid = vcol.validity & sorted_live
+                invalid = (~valid).astype(_I64)
+                lane = orderable_int(vcol).astype(_I64)
+                if isinstance(f, Max):
+                    lane = ~lane
+                inv, _, bt = argmin_frame(
+                    (invalid, lane, pos.astype(_I64)), fr, lo, hi)
+                found = (inv == 0) & ~empty & sorted_live
+                bpos = jnp.clip(bt, 0, cap - 1).astype(jnp.int32)
+                win_cols.append(gather_column(vcol, bpos, found))
+                continue
+            if isinstance(f, _FirstLast):
+                vcol = sgather(f.children[0])
+                if f.ignore_nulls:
+                    valid = vcol.validity & sorted_live
+                    invalid = (~valid).astype(_I64)
+                    # Last = latest valid position: flip the tiebreak so
+                    # the lexicographic min picks the largest position
+                    tb = (-pos if f.take_last else pos).astype(_I64)
+                    inv, bt = argmin_frame((invalid, tb), fr, lo, hi)
+                    bpos = -bt if f.take_last else bt
+                    bpos = jnp.clip(bpos, 0, cap - 1).astype(jnp.int32)
+                    found = (inv == 0) & ~empty & sorted_live
+                    win_cols.append(gather_column(vcol, bpos, found))
+                else:
+                    at = hi if f.take_last else lo
+                    atc = jnp.clip(at, 0, cap - 1)
+                    ok = ~empty & sorted_live
+                    win_cols.append(gather_column(vcol, atc, ok))
+                continue
+            raise NotImplementedError(
+                f"device window function {f!r}")  # planner gates this
+
+        return TpuBatch(sbatch.columns + win_cols, self._schema, n_live)
+
+    def execute(self, ctx: ExecCtx):
+        batches = list(self.child.execute(ctx))
+        if not batches:
+            return
+        if self._jitted is None:
+            self._jitted = jax.jit(self._window_batch, static_argnums=1)
+        op_time = ctx.metric(self, "opTime")
+        total = sum(b.device_size_bytes() for b in batches)
+        if self.part_exprs and len(batches) > 1 \
+                and total > ctx.mm.budget // 2:
+            # over-budget: bucket whole partitions by key hash and window
+            # each bucket independently (split-and-retry can't help here —
+            # halving a batch at the row midpoint would cut partitions)
+            yield from self._execute_bucketed(batches, ctx)
+            return
+        t0 = time.perf_counter()
+        merged = concat_batches(batches)
+        out = self._jitted(merged, ctx.eval_ctx)
+        if ctx.sync_metrics:
+            out.block_until_ready()
+        op_time.value += time.perf_counter() - t0
+        yield out
+
+    def _execute_bucketed(self, batches, ctx: ExecCtx):
+        """Out-of-core window: rows are hashed by partition key into
+        enough buckets that each fits the merge window, spilled to host,
+        then each bucket (containing only whole partitions) is windowed
+        on device independently — the single-node shape of the
+        exchange-then-window plan Spark runs distributed."""
+        import math as _math
+        from ..columnar.arrow_bridge import arrow_to_device, device_to_arrow
+        from ..columnar.batch import bucket_rows
+        from ..ops.gather import compact_batch
+        from ..shuffle.partitioner import HashPartitioning
+        spill = ctx.metric(self, "spillTime")
+        total = sum(b.device_size_bytes() for b in batches)
+        window_bytes = max(1, ctx.mm.budget // 4)
+        k = max(2, _math.ceil(total / window_bytes))
+        part = HashPartitioning(self.part_exprs, k)  # exprs already bound
+        hosts: List[List[pa.RecordBatch]] = [[] for _ in range(k)]
+        t0 = time.perf_counter()
+        for b in batches:
+            pids = part.partition_ids_device(b, ctx.eval_ctx)
+            for p in range(k):
+                piece = compact_batch(b, pids == p)
+                if piece.num_rows:  # syncs once per piece
+                    hosts[p].append(device_to_arrow(piece))
+        spill.value += time.perf_counter() - t0
+        schema = self.child.output_schema
+        for p in range(k):
+            if not hosts[p]:
+                continue
+            t0 = time.perf_counter()
+            parts = [arrow_to_device(rb, schema,
+                                     capacity=bucket_rows(rb.num_rows))
+                     for rb in hosts[p]]
+            hosts[p] = []
+            out = self._jitted(concat_batches(parts), ctx.eval_ctx)
+            spill.value += time.perf_counter() - t0
+            yield out
+
+    # --- CPU oracle -------------------------------------------------------
+
+    def execute_cpu(self, ctx: ExecCtx):
+        rbs = list(self.child.execute_cpu(ctx))
+        out_schema = arrow_schema(self._schema)
+        if not rbs:
+            return
+        table = pa.Table.from_batches(rbs).combine_chunks()
+        if table.num_rows == 0:
+            yield pa.RecordBatch.from_arrays(
+                [pa.array([], type=f.type) for f in out_schema],
+                schema=out_schema)
+            return
+        rb = table.to_batches()[0]
+        ectx = ctx.eval_ctx
+        # identical global order to the device pass: partition keys with
+        # default spec, then the order spec
+        orders_all = [SortOrder(e) for e in self.part_exprs] + self.orders
+        if orders_all:
+            keys = [o.child.eval_cpu(rb, ectx) for o in orders_all]
+            st = cpu_sort_table(pa.Table.from_batches([rb]), keys,
+                                orders_all).combine_chunks()
+            rb = st.to_batches()[0]
+        n = rb.num_rows
+
+        def norm(v):
+            if isinstance(v, float):
+                if math.isnan(v):
+                    return "\x00__NaN__"
+                if v == 0.0:
+                    return 0.0
+            return v
+
+        pk = [[norm(v) for v in e.eval_cpu(rb, ectx).to_pylist()]
+              for e in self.part_exprs]
+        ok_raw = [o.child.eval_cpu(rb, ectx).to_pylist()
+                  for o in self.orders]
+        ok_norm = [[norm(v) for v in col] for col in ok_raw]
+
+        part_flag = [i == 0 or any(c[i] != c[i - 1] for c in pk)
+                     for i in range(n)]
+        peer_flag = [part_flag[i]
+                     or any(c[i] != c[i - 1] for c in ok_norm)
+                     for i in range(n)]
+        seg_start = [0] * n
+        peer_start = [0] * n
+        for i in range(n):
+            seg_start[i] = i if part_flag[i] else seg_start[i - 1]
+            peer_start[i] = i if peer_flag[i] else peer_start[i - 1]
+        seg_end = [0] * n
+        peer_end = [0] * n
+        for i in range(n - 1, -1, -1):
+            seg_end[i] = i if (i == n - 1 or part_flag[i + 1]) \
+                else seg_end[i + 1]
+            peer_end[i] = i if (i == n - 1 or peer_flag[i + 1]) \
+                else peer_end[i + 1]
+
+        def frame_range(i, fr, ascending):
+            s, e = seg_start[i], seg_end[i]
+            if fr.frame_type == "rows":
+                lo = s if fr.lower is None else max(s, i + fr.lower)
+                hi = e if fr.upper is None else min(e, i + fr.upper)
+                return lo, hi
+            # range frames
+            def vbound(off, is_lower):
+                v = ok_raw[0][i]
+                if v is None:
+                    # null-ordered rows: frame = the null peer group
+                    return peer_start[i] if is_lower else peer_end[i]
+                sign = 1 if ascending else -1
+                tgt = v + sign * off
+                j = s
+                if is_lower:
+                    j = s
+                    while j <= e:
+                        vj = ok_raw[0][j]
+                        if vj is not None and (
+                                (ascending and vj >= tgt)
+                                or (not ascending and vj <= tgt)):
+                            break
+                        j += 1
+                    return j
+                j = e
+                while j >= s:
+                    vj = ok_raw[0][j]
+                    if vj is not None and (
+                            (ascending and vj <= tgt)
+                            or (not ascending and vj >= tgt)):
+                        break
+                    j -= 1
+                return j
+            if fr.lower is None:
+                lo = s
+            elif fr.lower == 0:
+                lo = peer_start[i]
+            else:
+                lo = vbound(fr.lower, True)
+            if fr.upper is None:
+                hi = e
+            elif fr.upper == 0:
+                hi = peer_end[i]
+            else:
+                hi = vbound(fr.upper, False)
+            return lo, hi
+
+        out_arrays = []
+        for we, name in zip(self.win_exprs, self.win_names):
+            f = we.func
+            fr = we.frame
+            asc = self.orders[0].ascending if self.orders else True
+            vals: List = []
+            if isinstance(f, RowNumber):
+                vals = [i - seg_start[i] + 1 for i in range(n)]
+            elif isinstance(f, Rank):
+                vals = [peer_start[i] - seg_start[i] + 1 for i in range(n)]
+            elif isinstance(f, DenseRank):
+                vals = []
+                for i in range(n):
+                    d = sum(1 for j in range(seg_start[i] + 1, i + 1)
+                            if peer_flag[j])
+                    vals.append(d + 1)
+            elif isinstance(f, PercentRank):
+                for i in range(n):
+                    rows = seg_end[i] - seg_start[i] + 1
+                    r = peer_start[i] - seg_start[i]
+                    vals.append(0.0 if rows <= 1 else r / (rows - 1))
+            elif isinstance(f, NTile):
+                for i in range(n):
+                    rows = seg_end[i] - seg_start[i] + 1
+                    r = i - seg_start[i]
+                    q, rem = divmod(rows, f.buckets)
+                    thr = rem * (q + 1)
+                    if r < thr:
+                        vals.append(r // (q + 1) + 1)
+                    elif q > 0:
+                        vals.append(rem + (r - thr) // q + 1)
+                    else:
+                        vals.append(r + 1)
+            elif isinstance(f, _OffsetFunction):
+                src_vals = f.child.eval_cpu(rb, ectx).to_pylist()
+                dflt = f.default.value if f.default is not None else None
+                for i in range(n):
+                    j = i + f.direction * f.offset
+                    if seg_start[i] <= j <= seg_end[i]:
+                        vals.append(src_vals[j])
+                    else:
+                        vals.append(dflt)
+            elif isinstance(f, AggregateFunction):
+                if f.children:
+                    src_vals = f.children[0].eval_cpu(rb, ectx).to_pylist()
+                else:
+                    src_vals = [True] * n
+                for i in range(n):
+                    lo, hi = frame_range(i, fr, asc)
+                    frame_vals = src_vals[lo:hi + 1] if lo <= hi else []
+                    vals.append(f.cpu_agg(frame_vals, ectx))
+            else:
+                raise NotImplementedError(repr(f))
+            out_arrays.append(pa.array(vals, type=dt.to_arrow(we.dtype)))
+
+        arrays = [rb.column(i) for i in range(rb.num_columns)] + out_arrays
+        yield pa.RecordBatch.from_arrays(arrays, schema=out_schema)

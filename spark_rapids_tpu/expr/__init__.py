@@ -22,3 +22,6 @@ from .strings import (Length, Upper, Lower, Substring, ConcatStrings,
                       RegExpLike, RegExpReplace, RegExpExtract,
                       StringLocate, StringLpad, StringRpad, StringRepeat,
                       Reverse)
+from .window import (WindowFrame, WindowExpression, RowNumber, Rank,
+                     DenseRank, PercentRank, NTile, Lag, Lead,
+                     ROWS_UNBOUNDED, RANGE_CURRENT)

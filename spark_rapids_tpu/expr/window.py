@@ -1,0 +1,282 @@
+"""Window expressions: frames, specs, and ranking functions.
+
+TPU analog of the reference's window expression surface
+(`GpuWindowExpression` / `GpuSpecifiedWindowFrame` + the ranking
+functions rewritten into `GpuWindowExec` — SURVEY.md §2.2-B "Window",
+reference mount empty; built from the capability inventory).
+
+A `WindowExpression` packages a window function (a ranking function from
+this module or an `AggregateFunction`) with its partition spec, order
+spec and frame. It is not independently evaluable — `TpuWindowExec`
+computes all the window expressions of one window spec in a single
+sorted, segmented device pass (exec/window.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from .. import datatypes as dt
+from .aggregates import (AggregateFunction, Average, Count, First, Last,
+                         Max, Min, Sum)
+from .base import Expression, Literal
+
+__all__ = ["WindowFrame", "WindowExpression", "WindowFunction",
+           "RowNumber", "Rank", "DenseRank", "PercentRank", "NTile",
+           "Lag", "Lead", "ROWS_UNBOUNDED", "RANGE_CURRENT"]
+
+# widest bounded-rows frame the device computes via the windowed gather
+# (an (n, width) matrix); wider frames fall back to the CPU oracle
+MAX_GATHER_FRAME = 1024
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowFrame:
+    """Frame boundaries (GpuSpecifiedWindowFrame analog).
+
+    ``lower``/``upper`` are signed offsets relative to the current row
+    (rows frames) or the current order value (range frames); ``None``
+    means UNBOUNDED. Spark's CURRENT ROW is offset 0.
+    """
+    frame_type: str = "range"            # "rows" | "range"
+    lower: Optional[int] = None          # None = UNBOUNDED PRECEDING
+    upper: Optional[int] = 0             # None = UNBOUNDED FOLLOWING
+
+    def __post_init__(self):
+        if self.frame_type not in ("rows", "range"):
+            raise ValueError(f"bad frame type {self.frame_type!r}")
+        if self.lower is not None and self.upper is not None \
+                and self.lower > self.upper:
+            raise ValueError(f"frame lower {self.lower} > upper "
+                             f"{self.upper}")
+
+    @property
+    def unbounded_both(self) -> bool:
+        return self.lower is None and self.upper is None
+
+    def describe(self) -> str:
+        def b(v, side):
+            if v is None:
+                return f"UNBOUNDED {side}"
+            if v == 0:
+                return "CURRENT ROW"
+            return f"{abs(v)} {'PRECEDING' if v < 0 else 'FOLLOWING'}"
+        return (f"{self.frame_type.upper()} BETWEEN "
+                f"{b(self.lower, 'PRECEDING')} AND "
+                f"{b(self.upper, 'FOLLOWING')}")
+
+
+ROWS_UNBOUNDED = WindowFrame("rows", None, None)
+RANGE_CURRENT = WindowFrame("range", None, 0)  # Spark default w/ order
+
+
+class WindowFunction(Expression):
+    """Ranking-family window function: only evaluable inside a window
+    spec (Spark's WindowFunction marker)."""
+
+    is_window_function = True
+
+    @property
+    def nullable(self):
+        return False
+
+
+class RowNumber(WindowFunction):
+    @property
+    def dtype(self):
+        return dt.INT32
+
+
+class Rank(WindowFunction):
+    @property
+    def dtype(self):
+        return dt.INT32
+
+
+class DenseRank(WindowFunction):
+    @property
+    def dtype(self):
+        return dt.INT32
+
+
+class PercentRank(WindowFunction):
+    @property
+    def dtype(self):
+        return dt.FLOAT64
+
+
+class NTile(WindowFunction):
+    """n roughly equal buckets per partition: the first
+    (rows % n) buckets get one extra row (Spark semantics)."""
+
+    def __init__(self, buckets: int):
+        if buckets <= 0:
+            raise ValueError("ntile buckets must be positive")
+        self.buckets = int(buckets)
+
+    @property
+    def dtype(self):
+        return dt.INT32
+
+    def __repr__(self):
+        return f"NTile({self.buckets})"
+
+
+class _OffsetFunction(WindowFunction):
+    """lag/lead: value `offset` rows before/after the current row in the
+    partition's order, or `default` (NULL if absent) past the edge.
+    Frame-agnostic, like Spark's OffsetWindowFunction."""
+
+    direction = -1  # lag looks backward
+
+    def __init__(self, child: Expression, offset: int = 1,
+                 default: Optional[Expression] = None):
+        if offset < 0:
+            raise ValueError("offset must be >= 0")
+        self.offset = int(offset)
+        self.children = (child,) if default is None else (child, default)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def default(self) -> Optional[Expression]:
+        return self.children[1] if len(self.children) > 1 else None
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    def validate(self):
+        d = self.default
+        if d is not None and not isinstance(d, Literal):
+            raise TypeError("lag/lead default must be a literal")
+
+    def __repr__(self):
+        return (f"{self.pretty_name()}({self.children[0]!r}, "
+                f"{self.offset})")
+
+
+class Lag(_OffsetFunction):
+    direction = -1
+
+
+class Lead(_OffsetFunction):
+    direction = 1
+
+
+# aggregates with a device window path (exec/window.py kernels); others
+# (stddev/variance/collect_*) run through the CPU oracle via fallback
+_DEVICE_WINDOW_AGGS = (Sum, Count, Min, Max, Average, First, Last)
+
+
+class WindowExpression(Expression):
+    """func OVER (PARTITION BY ... ORDER BY ... frame)."""
+
+    def __init__(self, func: Expression,
+                 partition_by: Sequence[Expression] = (),
+                 order_by: Sequence["SortOrder"] = (),
+                 frame: Optional[WindowFrame] = None):
+        from ..exec.sort import SortOrder  # circular-safe
+        self.order_specs: Tuple = tuple(
+            (o.ascending, o.nulls_first) for o in order_by)
+        if frame is None:
+            # Spark defaults: RANGE UNBOUNDED..CURRENT with an order spec,
+            # the whole partition without one
+            frame = RANGE_CURRENT if order_by else ROWS_UNBOUNDED
+        self.frame = frame
+        self._n_part = len(partition_by)
+        self._n_order = len(order_by)
+        self.children = (func, *partition_by,
+                         *[o.child for o in order_by])
+
+    # --- structured accessors (children is the flat binding surface) -----
+    @property
+    def func(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def partition_by(self) -> Tuple[Expression, ...]:
+        return self.children[1:1 + self._n_part]
+
+    @property
+    def order_by(self) -> List["SortOrder"]:
+        from ..exec.sort import SortOrder
+        keys = self.children[1 + self._n_part:]
+        return [SortOrder(k, asc, nf) for k, (asc, nf)
+                in zip(keys, self.order_specs)]
+
+    @property
+    def dtype(self):
+        return self.func.dtype
+
+    @property
+    def nullable(self):
+        f = self.func
+        if isinstance(f, WindowFunction):
+            return f.nullable
+        if isinstance(f, Count):
+            return False
+        return True
+
+    def spec_signature(self) -> str:
+        """Partition/order/frame identity — one TpuWindowExec handles one
+        spec (Spark plans one WindowExec per distinct spec)."""
+        return (f"partition=[{', '.join(map(repr, self.partition_by))}] "
+                f"order=[{', '.join(f'{o.child!r} {o.ascending} '
+                                    f'{o.nulls_first}' for o in self.order_by)}]")
+
+    def validate(self):
+        f = self.func
+        if not isinstance(f, (WindowFunction, AggregateFunction)):
+            raise TypeError(f"not a window function: {f!r}")
+        if isinstance(f, (Rank, DenseRank, PercentRank, NTile,
+                          _OffsetFunction)) and not self.order_specs:
+            raise ValueError(f"{f.pretty_name()} requires an ORDER BY")
+        if self.frame.frame_type == "range" and not self.frame.unbounded_both \
+                and not self.order_specs:
+            raise ValueError("a bounded RANGE frame requires an ORDER BY")
+
+    def tpu_supported(self) -> Optional[str]:
+        f = self.func
+        fr = self.frame
+        if isinstance(f, AggregateFunction) \
+                and not isinstance(f, _DEVICE_WINDOW_AGGS):
+            return (f"window aggregate {f.pretty_name()} not on device "
+                    f"(CPU oracle only)")
+        if isinstance(f, Average) \
+                and isinstance(f.children[0].dtype, dt.DecimalType):
+            return "decimal average over window not on device"
+        if isinstance(f, _OffsetFunction) and f.default is not None \
+                and f.dtype.is_variable_width:
+            return "lag/lead default over strings not on device"
+        if fr.frame_type == "range":
+            bounded = [v for v in (fr.lower, fr.upper)
+                       if v is not None and v != 0]
+            if bounded:
+                return ("RANGE frame with literal offsets not on device "
+                        "(CPU oracle only)")
+        else:
+            uses_gather = isinstance(f, (Min, Max)) or (
+                isinstance(f, (First, Last)) and f.ignore_nulls)
+            if fr.lower is not None and fr.upper is not None \
+                    and uses_gather \
+                    and fr.upper - fr.lower + 1 > MAX_GATHER_FRAME:
+                return (f"bounded rows frame wider than "
+                        f"{MAX_GATHER_FRAME} not on device")
+        return None
+
+    def with_children(self, children):
+        c = type(self).__new__(type(self))
+        c.__dict__.update(self.__dict__)
+        c.children = tuple(children)
+        return c
+
+    def __repr__(self):
+        return (f"{self.func!r} OVER ({self.spec_signature()} "
+                f"{self.frame.describe()})")

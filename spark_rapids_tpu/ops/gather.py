@@ -91,6 +91,12 @@ def gather_batch(batch: TpuBatch, indices: jax.Array, count,
         if c.is_string_like or c.data is None:
             col_lanes.append(("special", 0, 0))
             continue
+        if c.data.dtype == jnp.float64:
+            # TPU has no native f64 (stored/computed as f32) and its X64
+            # rewriter cannot implement bitcast f64<->s64; gather the
+            # lane directly instead of packing it
+            col_lanes.append(("direct", 0, 0))
+            continue
         d = c.data
         if d.dtype == jnp.bool_:
             w = d.astype(jnp.int32)[:, None]
@@ -124,6 +130,10 @@ def gather_batch(batch: TpuBatch, indices: jax.Array, count,
         word = gathered[:, vbase + i // 32]
         validity = (((word >> (i % 32)) & 1) != 0) & out_live
         kind, loff, width = col_lanes[i]
+        if kind == "direct":
+            cols.append(c.with_arrays(data=c.data[indices],
+                                      validity=validity))
+            continue
         if kind == "special":
             if c.is_string_like:
                 cc = char_capacities[i] if char_capacities is not None \

@@ -65,6 +65,12 @@ def orderable_int(col: TpuColumnVector) -> jax.Array:
     if dt.is_floating(t):
         bits_t = jnp.int32 if t.np_dtype == jnp.float32 else jnp.int64
         d = canonicalize_floats(d)
+        if t.np_dtype == jnp.float64 and jax.default_backend() != "cpu":
+            # the TPU stores f64 as f32 (no f64 hardware) and its X64
+            # rewriter cannot bitcast f64<->s64: order via the f32 bits
+            # (a physical no-op for the stored values)
+            d = d.astype(jnp.float32)
+            bits_t = jnp.int32
         bits = jax.lax.bitcast_convert_type(d, bits_t)
         # Signed total-order map: positives (incl. +0, +inf, NaN) keep their
         # bits (already ascending); negatives map to ~bits + INT_MIN, a

@@ -1,0 +1,251 @@
+"""Window operator tests via the dual-run harness (reference:
+window_function_test.py — SURVEY.md §4.1; capability-built, mount empty).
+
+Covers ranking functions, running/rolling/whole-partition frames, rows vs
+range semantics (peers), lag/lead, first/last, nulls in order keys, empty
+frames, multi-batch inputs, and the planner fallback for frames the
+device does not support (range literal offsets, stddev over window)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import datatypes as dt
+from spark_rapids_tpu.exec import HostBatchSourceExec
+from spark_rapids_tpu.exec.sort import SortOrder
+from spark_rapids_tpu.exec.window import TpuWindowExec
+from spark_rapids_tpu.expr import (Alias, DenseRank, Lag, Lead, Literal,
+                                   NTile, PercentRank, Rank, RowNumber,
+                                   UnresolvedColumn as col, WindowExpression,
+                                   WindowFrame)
+from spark_rapids_tpu.expr.aggregates import (Average, Count, First, Last,
+                                              Max, Min, StddevSamp, Sum)
+
+from asserts import assert_tpu_and_cpu_plan_equal
+from data_gen import (DecimalGen, DoubleGen, IntegerGen, LongGen,
+                      StringGen, gen_table)
+
+
+def source(gens, n=256, seed=1234, names=None, n_batches=1):
+    return HostBatchSourceExec(
+        [gen_table(gens, n, seed + i, names) for i in range(n_batches)])
+
+
+def part_order_source(n=200, seed=1234, **kw):
+    """3 columns: c0 partition key (small card), c1 order key (with ties
+    + nulls), c2 values (with nulls)."""
+    return source([IntegerGen(min_val=0, max_val=4, null_frac=0.1),
+                   IntegerGen(min_val=0, max_val=20, null_frac=0.15),
+                   LongGen(min_val=-1000, max_val=1000, null_frac=0.2)],
+                  n=n, seed=seed, **kw)
+
+
+def win(func, frame=None, partition=("c0",), order=("c1",)):
+    return Alias(WindowExpression(
+        func, [col(c) for c in partition],
+        [SortOrder(col(c)) for c in order], frame), "w")
+
+
+RANKING = [RowNumber(), Rank(), DenseRank(), PercentRank(), NTile(3),
+           NTile(7)]
+
+
+@pytest.mark.parametrize("func", RANKING,
+                         ids=lambda f: f.pretty_name().lower())
+def test_ranking(func):
+    plan = TpuWindowExec([win(func)], part_order_source())
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_ranking_no_partition():
+    plan = TpuWindowExec(
+        [win(RowNumber(), partition=()), ],
+        part_order_source())
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_rank_order_desc_nulls_last():
+    we = Alias(WindowExpression(
+        Rank(), [col("c0")],
+        [SortOrder(col("c1"), ascending=False, nulls_first=False)]), "w")
+    plan = TpuWindowExec([we], part_order_source())
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+AGG_FRAMES = [
+    None,                              # default RANGE UNBOUNDED..CURRENT
+    WindowFrame("rows", None, 0),      # running
+    WindowFrame("rows", None, None),   # whole partition
+    WindowFrame("rows", -2, 0),
+    WindowFrame("rows", -1, 1),
+    WindowFrame("rows", 0, None),
+    WindowFrame("rows", 2, 4),         # empty near partition end
+    WindowFrame("range", None, None),
+    WindowFrame("range", 0, None),
+    WindowFrame("range", 0, 0),        # peer group
+]
+
+
+@pytest.mark.parametrize("frame", AGG_FRAMES,
+                         ids=lambda f: "default" if f is None
+                         else f.describe().lower().replace(" ", "_"))
+@pytest.mark.parametrize("func_cls", [Sum, Count, Min, Max, Average],
+                         ids=lambda c: c.__name__.lower())
+def test_agg_window_frames(func_cls, frame):
+    plan = TpuWindowExec([win(func_cls(col("c2")), frame)],
+                         part_order_source())
+    assert_tpu_and_cpu_plan_equal(plan, approx_float=True)
+
+
+def test_count_star_window():
+    from spark_rapids_tpu.expr.aggregates import Count as C
+    plan = TpuWindowExec([win(C(), WindowFrame("rows", -3, 3))],
+                         part_order_source())
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_sum_window_double_and_decimal():
+    src = source([IntegerGen(min_val=0, max_val=3, null_frac=0.0),
+                  IntegerGen(min_val=0, max_val=9, null_frac=0.0),
+                  DoubleGen(null_frac=0.2), DecimalGen(null_frac=0.2)],
+                 n=150)
+    for c in ("c2", "c3"):
+        plan = TpuWindowExec([win(Sum(col(c)))], src)
+        assert_tpu_and_cpu_plan_equal(plan, approx_float=True)
+
+
+def test_multiple_window_exprs_one_spec():
+    plan = TpuWindowExec(
+        [Alias(WindowExpression(RowNumber(), [col("c0")],
+                                [SortOrder(col("c1"))]), "rn"),
+         Alias(WindowExpression(Sum(col("c2")), [col("c0")],
+                                [SortOrder(col("c1"))]), "s"),
+         Alias(WindowExpression(Min(col("c2")), [col("c0")],
+                                [SortOrder(col("c1"))],
+                                WindowFrame("rows", -3, 0)), "m")],
+        part_order_source())
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_mixed_specs_rejected():
+    with pytest.raises(ValueError):
+        TpuWindowExec(
+            [Alias(WindowExpression(RowNumber(), [col("c0")],
+                                    [SortOrder(col("c1"))]), "a"),
+             Alias(WindowExpression(RowNumber(), [col("c1")],
+                                    [SortOrder(col("c0"))]), "b")],
+            part_order_source())
+
+
+@pytest.mark.parametrize("fn", ["lag", "lead"])
+def test_lag_lead(fn):
+    cls = Lag if fn == "lag" else Lead
+    for f in (cls(col("c2"), 1), cls(col("c2"), 3),
+              cls(col("c2"), 2, Literal(-99, dt.INT64))):
+        plan = TpuWindowExec([win(f)], part_order_source())
+        assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_lag_strings():
+    src = source([IntegerGen(min_val=0, max_val=3, null_frac=0.0),
+                  LongGen(nullable=False), StringGen(max_len=6)],
+                 n=120)
+    plan = TpuWindowExec([win(Lag(col("c2"), 1))], src)
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+@pytest.mark.parametrize("ignore_nulls", [False, True])
+@pytest.mark.parametrize("cls", [First, Last],
+                         ids=["first", "last"])
+def test_first_last_window(cls, ignore_nulls):
+    for frame in (None, WindowFrame("rows", -2, 2),
+                  WindowFrame("rows", 0, None)):
+        plan = TpuWindowExec(
+            [win(cls(col("c2"), ignore_nulls=ignore_nulls), frame)],
+            part_order_source())
+        assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_window_multi_batch():
+    plan = TpuWindowExec([win(Sum(col("c2")))],
+                         part_order_source(n=100, n_batches=3))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_window_float_order_keys():
+    # NaN / -0.0 / nulls in the order key: peers must match the oracle
+    src = source([IntegerGen(min_val=0, max_val=2, null_frac=0.0),
+                  DoubleGen(null_frac=0.2),
+                  LongGen(min_val=0, max_val=100, null_frac=0.1)], n=150)
+    plan = TpuWindowExec([win(Rank())], src)
+    assert_tpu_and_cpu_plan_equal(plan)
+    plan = TpuWindowExec([win(Sum(col("c2")))], src)
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_window_string_partition_keys():
+    src = source([StringGen(max_len=4, null_frac=0.1),
+                  IntegerGen(min_val=0, max_val=9, null_frac=0.0),
+                  LongGen(min_val=-50, max_val=50, null_frac=0.1)], n=150)
+    plan = TpuWindowExec([win(RowNumber())], src)
+    assert_tpu_and_cpu_plan_equal(plan)
+    plan = TpuWindowExec([win(Average(col("c2")))], src)
+    assert_tpu_and_cpu_plan_equal(plan, approx_float=True)
+
+
+# --- planner integration / fallback ---------------------------------------
+
+def _planner_dual_run(plan, expect_fallback):
+    from spark_rapids_tpu.exec.base import ExecCtx, collect_arrow_cpu
+    from spark_rapids_tpu.planner import overrides
+    pp = overrides(plan)
+    fb = pp.fallback_nodes()
+    if expect_fallback:
+        assert "WindowExec" in fb, fb
+    else:
+        assert "WindowExec" not in fb, fb
+    got = pp.collect()
+    want = collect_arrow_cpu(plan, ExecCtx())
+    assert got.to_pylist() == want.to_pylist()
+
+
+def test_planner_window_on_device():
+    plan = TpuWindowExec([win(Sum(col("c2")))], part_order_source(n=80))
+    _planner_dual_run(plan, expect_fallback=False)
+
+
+def test_planner_range_offset_falls_back():
+    # RANGE with literal offsets: CPU oracle only
+    plan = TpuWindowExec(
+        [win(Sum(col("c2")), WindowFrame("range", -5, 5))],
+        part_order_source(n=80))
+    _planner_dual_run(plan, expect_fallback=True)
+
+
+def test_planner_stddev_window_falls_back():
+    plan = TpuWindowExec([win(StddevSamp(col("c2")))],
+                         part_order_source(n=80))
+    _planner_dual_run(plan, expect_fallback=True)
+
+
+def test_window_out_of_core_bucketed():
+    """Window at data >> budget: the bucketed (hash partition -> spill ->
+    per-bucket window) path must match the oracle."""
+    from spark_rapids_tpu.config import RapidsConf
+    conf = RapidsConf({"spark.rapids.memory.device.budgetBytes": 1 << 13})
+    plan = TpuWindowExec(
+        [win(Sum(col("c2"))), win(RowNumber())],
+        part_order_source(n=400, n_batches=4))
+    assert_tpu_and_cpu_plan_equal(plan, conf=conf, ignore_order=True)
+
+
+def test_window_sentinel_extremes():
+    """Min over all-Long.MaxValue / Max over all-Long.MinValue frames must
+    not collide with the argmin sentinel (code-review finding)."""
+    imax, imin = (1 << 63) - 1, -(1 << 63)
+    rb = pa.record_batch({
+        "c0": pa.array([0, 0, 1, 1], pa.int32()),
+        "c1": pa.array([1, 2, 1, 2], pa.int32()),
+        "c2": pa.array([imax, imax, imin, imin], pa.int64())})
+    src = HostBatchSourceExec([rb])
+    for f in (Min(col("c2")), Max(col("c2"))):
+        plan = TpuWindowExec([win(f)], src)
+        assert_tpu_and_cpu_plan_equal(plan)
