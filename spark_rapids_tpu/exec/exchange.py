@@ -62,6 +62,10 @@ class TpuShuffleExchangeExec(UnaryExec):
 
     def execute(self, ctx: ExecCtx):
         unsplit = getattr(self.transport, "supports_unsplit", False)
+        if hasattr(self.transport, "set_memory_manager"):
+            # shuffle store bytes count against the HBM ledger and spill
+            # under pressure (RapidsBufferCatalog-backed store analog)
+            self.transport.set_memory_manager(ctx.mm)
         if self._jit_split is None:
             fn = self._pids if unsplit else self._split
             self._jit_split = jax.jit(fn, static_argnums=1)
@@ -108,19 +112,33 @@ class TpuShuffleExchangeExec(UnaryExec):
 
 class TpuBroadcastExchangeExec(UnaryExec):
     """Materialize the child once as a single device batch (the build-side
-    table). Single-process: concat; multi-chip: replicate over ICI."""
+    table). Single-process: concat; multi-chip: replicate over ICI. The
+    payload is registered in the spill catalog so an idle broadcast
+    yields its HBM under pressure and re-uploads on next use."""
 
     def __init__(self, child: TpuExec):
         super().__init__(child)
-        self._cached: Optional[TpuBatch] = None
+        self._sb = None  # SpillableBatch
 
-    def execute(self, ctx: ExecCtx):
-        if self._cached is None:
+    def spillable(self, ctx: ExecCtx):
+        """The catalog handle for the broadcast payload (None if the
+        child is empty). Join build sides reuse this handle instead of
+        re-registering the same buffers (double-counting the ledger)."""
+        if self._sb is None:
             batches = list(self.child.execute(ctx))
             if not batches:
-                return
-            self._cached = concat_batches(batches)
-        yield self._cached
+                return None
+            self._sb = ctx.mm.register(concat_batches(batches))
+            # the catalog holds a strong ref; without this the payload
+            # would outlive the plan in the process-shared ledger
+            import weakref
+            weakref.finalize(self, type(self._sb).release, self._sb)
+        return self._sb
+
+    def execute(self, ctx: ExecCtx):
+        sb = self.spillable(ctx)
+        if sb is not None:
+            yield sb.get()
 
     def execute_cpu(self, ctx: ExecCtx):
         rbs = list(self.child.execute_cpu(ctx))

@@ -117,18 +117,17 @@ class _BaseJoinExec(TpuExec):
     def _cross(self):
         return self.join_type == "cross" or not self.left_keys
 
-    def _stage_a(self, lbatch: TpuBatch, rbatch: TpuBatch, ectx):
+    def _stage_a(self, lbatch: TpuBatch, rbatch: TpuBatch, ectx, jt: str):
         lkeys = [k.eval_tpu(lbatch, ectx) for k in self.left_keys]
         rkeys = [k.eval_tpu(rbatch, ectx) for k in self.right_keys]
         plan = join_counts(lkeys, rkeys, lbatch.live_mask(),
                            rbatch.live_mask(), cross=self._cross())
-        return plan, join_total(plan, self.join_type)
+        return plan, join_total(plan, jt)
 
-    def _stage_b(self, out_cap: int, plan, lbatch: TpuBatch,
+    def _stage_b(self, jt: str, out_cap: int, plan, lbatch: TpuBatch,
                  rbatch: TpuBatch):
-        lidx, ridx, lvalid, rvalid, total = join_indices(
-            plan, self.join_type, out_cap)
-        semi = self.join_type in ("left_semi", "left_anti")
+        lidx, ridx, lvalid, rvalid, total = join_indices(plan, jt, out_cap)
+        semi = jt in ("left_semi", "left_anti")
         byte_counts = []
         for c in lbatch.columns:
             if c.is_string_like:
@@ -143,9 +142,9 @@ class _BaseJoinExec(TpuExec):
             jnp.zeros((0,), jnp.int32)
         return lidx, ridx, lvalid, rvalid, total, stacked
 
-    def _stage_c(self, char_caps: tuple, lbatch, rbatch, lidx, ridx,
-                 lvalid, rvalid, total):
-        if self.join_type in ("left_semi", "left_anti"):
+    def _stage_c(self, jt: str, char_caps: tuple, lbatch, rbatch, lidx,
+                 ridx, lvalid, rvalid, total):
+        if jt in ("left_semi", "left_anti"):
             from ..ops.gather import gather_batch
             return gather_batch(lbatch, lidx, total,
                                 char_capacities=list(char_caps))
@@ -153,23 +152,30 @@ class _BaseJoinExec(TpuExec):
                            total, self._schema, char_caps)
 
     def _join_batch(self, lbatch: TpuBatch, rbatch: TpuBatch,
-                    ctx: ExecCtx) -> TpuBatch:
+                    ctx: ExecCtx, jt: Optional[str] = None,
+                    want_matched: bool = False):
+        """Join one stream batch against the build batch with join type
+        `jt` (defaults to the exec's type — the chunked outer-join loop
+        passes the per-chunk type). With want_matched, also returns the
+        per-build-row matched mask for cross-batch accumulation."""
+        jt = jt or self.join_type
         if self._jit_a is None:
-            self._jit_a = jax.jit(self._stage_a, static_argnums=2)
-        plan, total_dev = self._jit_a(lbatch, rbatch, ctx.eval_ctx)
+            self._jit_a = jax.jit(self._stage_a, static_argnums=(2, 3))
+        plan, total_dev = self._jit_a(lbatch, rbatch, ctx.eval_ctx, jt)
         total = int(jax.device_get(total_dev))
         out_cap = bucket_rows(total)
-        bfn = self._jit_b.get(out_cap)
+        bkey = (jt, out_cap)
+        bfn = self._jit_b.get(bkey)
         if bfn is None:
-            bfn = jax.jit(partial(self._stage_b, out_cap))
-            self._jit_b[out_cap] = bfn
+            bfn = jax.jit(partial(self._stage_b, jt, out_cap))
+            self._jit_b[bkey] = bfn
         lidx, ridx, lvalid, rvalid, total_d, bytes_d = bfn(plan, lbatch,
                                                           rbatch)
         nbytes = [int(v) for v in jax.device_get(bytes_d)] \
             if bytes_d.shape[0] else []
         char_caps = []
         bi = 0
-        semi = self.join_type in ("left_semi", "left_anti")
+        semi = jt in ("left_semi", "left_anti")
         cols = list(lbatch.columns) + ([] if semi else
                                        list(rbatch.columns))
         for c in cols:
@@ -178,23 +184,41 @@ class _BaseJoinExec(TpuExec):
                 bi += 1
             else:
                 char_caps.append(0)
-        ckey = (out_cap, tuple(char_caps))
+        ckey = (jt, out_cap, tuple(char_caps))
         cfn = self._jit_c.get(ckey)
         if cfn is None:
-            cfn = jax.jit(partial(self._stage_c, tuple(char_caps)))
+            cfn = jax.jit(partial(self._stage_c, jt, tuple(char_caps)))
             self._jit_c[ckey] = cfn
         out = cfn(lbatch, rbatch, lidx, ridx, lvalid, rvalid, total_d)
         if self.condition is not None:
             ectx = ctx.eval_ctx
             pred = self.condition.eval_tpu(out, ectx)
             out = compact_batch(out, pred.data & pred.validity)
+        if want_matched:
+            return out, plan.matched_r
         return out
 
-    def _build_right(self, ctx: ExecCtx) -> Optional[TpuBatch]:
-        batches = list(self.right.execute(ctx))
-        if not batches:
-            return None
-        return concat_batches(batches)
+    def _build_right(self, ctx: ExecCtx):
+        """(spillable build batch, owned): the build side registers in the
+        spill catalog (ledger-accounted; evictable until pinned). A
+        broadcast child shares its existing catalog handle instead of
+        re-registering the same buffers. Returns (None, False) for an
+        empty build side."""
+        from .exchange import TpuBroadcastExchangeExec
+        if isinstance(self.right, TpuBroadcastExchangeExec):
+            sb = self.right.spillable(ctx)
+            if sb is not None:
+                sb.pin()  # refcounted; routed to the OWNING manager
+            owned = False
+        else:
+            batches = list(self.right.execute(ctx))
+            if not batches:
+                return None, False
+            # pinned at registration: eviction must not pick the batch
+            # we are about to stream against
+            sb = ctx.mm.register(concat_batches(batches), pinned=True)
+            owned = True
+        return sb, owned
 
     @staticmethod
     def _empty_batch(schema: dt.Schema) -> TpuBatch:
@@ -214,35 +238,65 @@ class _BaseJoinExec(TpuExec):
             raise NotImplementedError(self.tpu_supported())
         op_time = ctx.metric(self, "opTime")
         t0 = time.perf_counter()
-        rbatch = self._build_right(ctx)
-        if rbatch is None:
+        rsb, owned = self._build_right(ctx)
+        if rsb is None:
             # nothing can match; for semi/inner/cross/right-outer the
             # result is empty, for the others every left row is unmatched
             if self.join_type in ("inner", "cross", "left_semi",
                                   "right_outer"):
                 return
-            rbatch = self._empty_batch(self.right.output_schema)
+            rsb = ctx.mm.register(
+                self._empty_batch(self.right.output_schema), pinned=True)
+            owned = True
         op_time.value += time.perf_counter() - t0
-        if self.join_type in ("right_outer", "full_outer"):
-            # unmatched-build-rows are emitted once per join call, so the
-            # whole stream side must join in a single call
-            lbatches = list(self.left.execute(ctx))
-            lbatch = concat_batches(lbatches) if lbatches else \
-                self._empty_batch(self.left.output_schema)
-            t0 = time.perf_counter()
-            out = self._join_batch(lbatch, rbatch, ctx)
-            if ctx.sync_metrics:
-                out.block_until_ready()
-            op_time.value += time.perf_counter() - t0
-            yield out
-            return
+        try:
+            if self.join_type in ("right_outer", "full_outer"):
+                yield from self._execute_outer_build(rsb, ctx, op_time)
+                return
+            for lbatch in self.left.execute(ctx):
+                t0 = time.perf_counter()
+                out = self._join_batch(lbatch, rsb.get(), ctx)
+                if ctx.sync_metrics:
+                    out.block_until_ready()
+                op_time.value += time.perf_counter() - t0
+                yield out
+        finally:
+            rsb.unpin()
+            if owned:
+                rsb.release()
+
+    def _execute_outer_build(self, rsb, ctx: ExecCtx, op_time):
+        """right/full outer with a STREAMED stream side: each stream
+        batch joins as inner (right) / left_outer (full) while the
+        per-build-row matched mask accumulates across batches; the
+        unmatched build rows are emitted once at the end via a
+        right_outer join against an empty stream batch (reusing the
+        staged kernel's sizing machinery). This replaces the old
+        concat-the-whole-stream-side call — the stream side no longer
+        materializes (SURVEY.md §5.7)."""
+        chunk_jt = "inner" if self.join_type == "right_outer" \
+            else "left_outer"
+        any_matched = None
         for lbatch in self.left.execute(ctx):
             t0 = time.perf_counter()
-            out = self._join_batch(lbatch, rbatch, ctx)
+            out, m = self._join_batch(lbatch, rsb.get(), ctx, chunk_jt,
+                                      want_matched=True)
+            any_matched = m if any_matched is None else any_matched | m
             if ctx.sync_metrics:
                 out.block_until_ready()
             op_time.value += time.perf_counter() - t0
             yield out
+        t0 = time.perf_counter()
+        rbatch = rsb.get()
+        if any_matched is None:
+            unmatched = jnp.ones((rbatch.capacity,), jnp.bool_)
+        else:
+            unmatched = ~any_matched
+        lempty = self._empty_batch(self.left.output_schema)
+        out = self._join_batch(lempty, rbatch.with_selection(unmatched),
+                               ctx, "right_outer")
+        op_time.value += time.perf_counter() - t0
+        yield out
 
     # --- CPU oracle -------------------------------------------------------
 

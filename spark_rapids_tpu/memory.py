@@ -143,6 +143,14 @@ class SpillableBatch:
         self._mgr._touch(self)
         return self._device
 
+    def pin(self):
+        """Keep resident (refcounted) — route through the owning manager,
+        which may differ from the current query's."""
+        self._mgr.pin(self)
+
+    def unpin(self):
+        self._mgr.unpin(self)
+
     def release(self):
         self._mgr._release(self)
         self._device = None
@@ -190,7 +198,7 @@ class DeviceMemoryManager:
         self.budget = budget
         self._lock = threading.RLock()
         self._catalog: "OrderedDict[int, SpillableBatch]" = OrderedDict()
-        self._pinned: set = set()
+        self._pin_counts: dict = {}  # id -> refcount (shared consumers)
         self.device_bytes = 0
         self.spill_bytes = 0  # total bytes ever spilled (metric)
         self.semaphore = threading.BoundedSemaphore(
@@ -214,10 +222,17 @@ class DeviceMemoryManager:
 
     # --- catalog / ledger -------------------------------------------------
 
-    def register(self, batch) -> SpillableBatch:
+    def register(self, batch, pinned: bool = False) -> SpillableBatch:
+        """Add a device batch to the catalog. With ``pinned`` the new
+        batch is pinned BEFORE eviction runs, so a consumer about to use
+        it (join build side) doesn't watch it get spilled and pay a
+        pointless download+re-upload at peak pressure."""
         sb = SpillableBatch(self, batch)
         with self._lock:
             self._catalog[id(sb)] = sb
+            if pinned:
+                self._pin_counts[id(sb)] = \
+                    self._pin_counts.get(id(sb), 0) + 1
             self.device_bytes += sb.nbytes
             self._evict_to_fit()
         return sb
@@ -238,7 +253,7 @@ class DeviceMemoryManager:
             if self._catalog.pop(id(sb), None) is not None \
                     and sb.on_device:
                 self.device_bytes -= sb.nbytes
-            self._pinned.discard(id(sb))
+            self._pin_counts.pop(id(sb), None)
 
     def _evict_to_fit(self, exclude: Optional[int] = None):
         """LRU device->host spill until under budget (the
@@ -248,17 +263,23 @@ class DeviceMemoryManager:
         for key in list(self._catalog):
             if self.device_bytes <= self.budget:
                 break
-            if key == exclude or key in self._pinned:
+            if key == exclude or self._pin_counts.get(key, 0) > 0:
                 continue
             self._catalog[key].spill()  # adjusts the ledger itself
 
     def pin(self, sb: SpillableBatch):
+        """Refcounted: a batch shared by several consumers (a broadcast
+        feeding two joins) stays pinned until the LAST unpin."""
         with self._lock:
-            self._pinned.add(id(sb))
+            self._pin_counts[id(sb)] = self._pin_counts.get(id(sb), 0) + 1
 
     def unpin(self, sb: SpillableBatch):
         with self._lock:
-            self._pinned.discard(id(sb))
+            c = self._pin_counts.get(id(sb), 0) - 1
+            if c <= 0:
+                self._pin_counts.pop(id(sb), None)
+            else:
+                self._pin_counts[id(sb)] = c
 
     # --- semaphore --------------------------------------------------------
 

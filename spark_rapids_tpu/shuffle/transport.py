@@ -63,27 +63,83 @@ class ShuffleTransport:
         raise NotImplementedError
 
 
+class _MapEntry:
+    """One map task's whole batch + per-row partition ids, stored as ONE
+    spillable unit: the pid lane rides as an extra int32 column so a
+    spill round-trip (download compacts live rows) keeps row<->partition
+    alignment for free. Reads are lazy selection views over the shared
+    buffers (the contiguous_split analog, lazy edition)."""
+
+    def __init__(self, mm, batch: TpuBatch, pids):
+        import jax.numpy as jnp
+        from .. import datatypes as dt
+        from ..columnar.column import TpuColumnVector
+        self._schema = batch.schema
+        ext_schema = dt.Schema(
+            list(batch.schema.fields)
+            + [dt.StructField("__pid__", dt.INT32, False)])
+        pidcol = TpuColumnVector(
+            dt.INT32, data=pids.astype(jnp.int32),
+            validity=jnp.ones((batch.capacity,), jnp.bool_))
+        ext = TpuBatch(list(batch.columns) + [pidcol], ext_schema,
+                       batch.row_count, selection=batch.selection)
+        if mm is not None:
+            self._sb = mm.register(ext)  # ledger-accounted, spillable
+            self._raw = None
+        else:
+            self._sb = None
+            self._raw = ext
+
+    def view(self, partition_id: int) -> TpuBatch:
+        import jax.numpy as jnp
+        b = self._sb.get() if self._sb is not None else self._raw
+        pidcol = b.columns[-1]
+        core = TpuBatch(b.columns[:-1], self._schema, b.row_count,
+                        selection=b.selection)
+        return core.with_selection(pidcol.data == jnp.int32(partition_id))
+
+    def release(self):
+        if self._sb is not None:
+            self._sb.release()
+
+
 class _LocalWriter(ShuffleWriteHandle):
-    def __init__(self, store, shuffle_id, map_id):
+    def __init__(self, transport: "LocalShuffleTransport", store, map_id):
+        self._transport = transport
         self._store = store
-        self._sid = shuffle_id
         self._mid = map_id
 
     def write(self, partition_id: int, batch: TpuBatch) -> None:
+        # pre-split path (non-unsplit callers / tests): stored as-is,
+        # outside the spill catalog
         self._store.setdefault(partition_id, []).append(
             (self._mid, batch))
 
+    def write_unsplit(self, batch: TpuBatch, pids) -> None:
+        entry = _MapEntry(self._transport._mm, batch, pids)
+        self._store.setdefault(None, []).append((self._mid, entry))
+
 
 class LocalShuffleTransport(ShuffleTransport):
-    """In-process shuffle store: device batches stay resident, keyed by
-    (shuffle, partition). Doubles as the unit-test mock (SURVEY.md §4.3)
-    and the single-process engine path. Reads return batches ordered by
-    map id (deterministic, mirroring Spark's fetch-in-map-order within a
-    reduce task for our tests)."""
+    """In-process shuffle store. Doubles as the unit-test mock
+    (SURVEY.md §4.3) and the single-process engine path. Map batches are
+    stored whole with their partition-id lane and registered in the
+    device memory manager's spill catalog (when one is attached via
+    ``set_memory_manager``), so shuffle bytes count against the HBM
+    budget and spill to host under pressure — the RapidsBufferCatalog-
+    backed cached-shuffle store analog. Reads return batches ordered by
+    map id (deterministic for the dual-run harness)."""
+
+    supports_unsplit = True
 
     def __init__(self):
-        self._shuffles: Dict[int, Dict[int, List[Tuple[int, TpuBatch]]]] = {}
+        self._shuffles: Dict[int, Dict] = {}
         self._lock = threading.Lock()
+        self._mm = None
+
+    def set_memory_manager(self, mm) -> None:
+        """Attach the spill catalog; subsequent writes are spillable."""
+        self._mm = mm
 
     def register_shuffle(self, shuffle_id: int, num_partitions: int):
         with self._lock:
@@ -92,14 +148,20 @@ class LocalShuffleTransport(ShuffleTransport):
     def writer(self, shuffle_id: int, map_id: int) -> ShuffleWriteHandle:
         with self._lock:
             store = self._shuffles.setdefault(shuffle_id, {})
-        return _LocalWriter(store, shuffle_id, map_id)
+        return _LocalWriter(self, store, map_id)
 
     def read_partition(self, shuffle_id: int, partition_id: int):
         store = self._shuffles.get(shuffle_id, {})
         entries = sorted(store.get(partition_id, []), key=lambda e: e[0])
         for _, batch in entries:
             yield batch
+        whole = sorted(store.get(None, []), key=lambda e: e[0])
+        for _, entry in whole:
+            # lazy selection view — no sync, shares the entry's buffers
+            yield entry.view(partition_id)
 
     def unregister_shuffle(self, shuffle_id: int):
         with self._lock:
-            self._shuffles.pop(shuffle_id, None)
+            store = self._shuffles.pop(shuffle_id, None)
+        for _, entry in (store or {}).get(None, []):
+            entry.release()

@@ -145,9 +145,12 @@ def test_transport_seam_mock():
             rec = self
 
             class W:
-                def write(self, p, b):
-                    rec.writes.append((mid, p))
-                    inner.write(p, b)
+                def write_unsplit(self, b, pids):
+                    import numpy as np
+                    live = np.asarray(b.live_mask())
+                    for p in sorted(set(np.asarray(pids)[live].tolist())):
+                        rec.writes.append((mid, int(p)))
+                    inner.write_unsplit(b, pids)
 
                 def close(self):
                     pass
