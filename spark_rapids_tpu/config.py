@@ -150,13 +150,16 @@ OOM_RETRY_BLOCKING = register(
 
 # --- Shuffle --------------------------------------------------------------
 SHUFFLE_MODE = register(
-    "spark.rapids.shuffle.mode", "MULTITHREADED",
-    "Shuffle transport: HOST (single-thread Arrow files), MULTITHREADED "
-    "(parallel codec threads), ICI (SPMD all-to-all collectives over the "
-    "device mesh when the whole mesh participates).")
+    "spark.rapids.shuffle.mode", "LOCAL",
+    "Shuffle transport: LOCAL (device-resident spillable store — the "
+    "single-process default), HOST (Arrow IPC files, synchronous), "
+    "MULTITHREADED (Arrow IPC files with parallel codec threads), ICI "
+    "(SPMD all-to-all collectives over the device mesh; requires an "
+    "explicit IciShuffleTransport since it needs the mesh).")
 SHUFFLE_COMPRESSION = register(
     "spark.rapids.shuffle.compression.codec", "lz4",
-    "Codec for host shuffle partitions: none, lz4, zstd, snappy.")
+    "Codec for host shuffle partitions: none, lz4, zstd (the codecs "
+    "Arrow IPC buffer compression defines).")
 SHUFFLE_THREADS = register(
     "spark.rapids.shuffle.multiThreaded.writer.threads", 4,
     "Serialization/compression threads for MULTITHREADED shuffle.")

@@ -40,8 +40,31 @@ class TpuShuffleExchangeExec(UnaryExec):
                  transport: Optional[ShuffleTransport] = None):
         super().__init__(child)
         self.partitioning = partitioning.bind(child.output_schema)
-        self.transport = transport or LocalShuffleTransport()
+        # None = resolve from spark.rapids.shuffle.mode at execute
+        self.transport = transport
         self._jit_split = None
+
+    def _resolve_transport(self, ctx: ExecCtx) -> ShuffleTransport:
+        if self.transport is None:
+            from ..config import SHUFFLE_MODE
+            mode = ctx.conf.get(SHUFFLE_MODE)
+            if mode == "LOCAL":
+                self.transport = LocalShuffleTransport()
+            elif mode in ("HOST", "MULTITHREADED"):
+                import weakref
+                from ..shuffle.host import HostShuffleTransport
+                t = HostShuffleTransport(
+                    ctx.conf, threads=0 if mode == "HOST" else None)
+                # reclaim the pool + temp root when this exec goes away
+                weakref.finalize(self, HostShuffleTransport.close, t)
+                self.transport = t
+            elif mode == "ICI":
+                raise ValueError(
+                    "ICI shuffle needs an explicit IciShuffleTransport "
+                    "(it binds to a device mesh)")
+            else:
+                raise ValueError(f"unknown shuffle mode {mode!r}")
+        return self.transport
 
     def describe(self):
         return (f"ShuffleExchangeExec [{type(self.partitioning).__name__} "
@@ -61,22 +84,24 @@ class TpuShuffleExchangeExec(UnaryExec):
         return self.partitioning.partition_ids_device(batch, ectx)
 
     def execute(self, ctx: ExecCtx):
-        unsplit = getattr(self.transport, "supports_unsplit", False)
-        if hasattr(self.transport, "set_memory_manager"):
+        transport = self._resolve_transport(ctx)
+        unsplit = getattr(transport, "supports_unsplit", False)
+        if hasattr(transport, "set_memory_manager"):
             # shuffle store bytes count against the HBM ledger and spill
             # under pressure (RapidsBufferCatalog-backed store analog)
-            self.transport.set_memory_manager(ctx.mm)
+            transport.set_memory_manager(ctx.mm)
         if self._jit_split is None:
             fn = self._pids if unsplit else self._split
             self._jit_split = jax.jit(fn, static_argnums=1)
         n = self.partitioning.num_partitions
         sid = next(_shuffle_ids)
-        self.transport.register_shuffle(sid, n)
+        transport.register_shuffle(sid, n)
         op_time = ctx.metric(self, "opTime")
         rows = ctx.metric(self, "numPartitions")
         rows.set(n)
-        for map_id, batch in enumerate(self.child.execute(ctx)):
-            writer = self.transport.writer(sid, map_id)
+        source = self._with_range_bounds_device(ctx)
+        for map_id, batch in enumerate(source):
+            writer = transport.writer(sid, map_id)
             t0 = time.perf_counter()
             if unsplit:
                 writer.write_unsplit(batch,
@@ -91,14 +116,62 @@ class TpuShuffleExchangeExec(UnaryExec):
             writer.close()
         try:
             for p in range(n):
-                yield from self.transport.read_partition(sid, p)
+                yield from transport.read_partition(sid, p)
         finally:
-            self.transport.unregister_shuffle(sid)
+            transport.unregister_shuffle(sid)
+
+    # sampled rows per map batch feeding the range-bound computation
+    _RANGE_SAMPLE_ROWS = 4096
+
+    def _with_range_bounds_device(self, ctx):
+        """For RangePartitioning without precomputed bounds: materialize
+        the child, sample a deterministic prefix of each batch, compute
+        the (k-1) bounds host-side (the reference's driver-side sampled
+        bounds — SURVEY.md §2.2-B), and replay the batches. Other
+        partitionings stream straight through."""
+        from ..shuffle.partitioner import RangePartitioning
+        if not isinstance(self.partitioning, RangePartitioning) \
+                or self.partitioning.bounds is not None:
+            return self.child.execute(ctx)
+        from ..columnar.arrow_bridge import device_to_arrow
+        from ..columnar.batch import TpuBatch
+        from ..ops.gather import ensure_compacted, shrink_batch
+        k = self._RANGE_SAMPLE_ROWS
+
+        def prefix_sample(b):
+            # slice the prefix ON DEVICE before downloading: fixed-width
+            # lanes transfer only k rows (string chars stay shared)
+            b = ensure_compacted(b)
+            n = min(b.num_rows, k)
+            if b.capacity > k:
+                b = shrink_batch(TpuBatch(b.columns, b.schema, n), k)
+            return device_to_arrow(b)
+
+        batches = list(self.child.execute(ctx))
+        self.partitioning.compute_bounds(
+            [prefix_sample(b) for b in batches], ctx.eval_ctx)
+        # the materialized child is registered spillable for the replay:
+        # a child larger than HBM spills instead of OOMing here
+        sbs = [ctx.mm.register(b) for b in batches]
+
+        def replay():
+            for sb in sbs:
+                b = sb.get()
+                sb.release()
+                yield b
+        return replay()
 
     def execute_cpu(self, ctx: ExecCtx):
+        from ..shuffle.partitioner import RangePartitioning
         n = self.partitioning.num_partitions
         parts: Dict[int, List[pa.RecordBatch]] = {p: [] for p in range(n)}
-        for rb in self.child.execute_cpu(ctx):
+        rbs = list(self.child.execute_cpu(ctx))
+        if isinstance(self.partitioning, RangePartitioning) \
+                and self.partitioning.bounds is None:
+            self.partitioning.compute_bounds(
+                [rb.slice(0, self._RANGE_SAMPLE_ROWS) for rb in rbs],
+                ctx.eval_ctx)
+        for rb in rbs:
             pids = self.partitioning.partition_ids_cpu(rb, ctx.eval_ctx)
             for p in range(n):
                 idx = np.nonzero(pids == p)[0]

@@ -151,6 +151,14 @@ def string_order_ranks(col: TpuColumnVector, live: jax.Array) -> jax.Array:
     return string_order_ranks_multi([col], [live])
 
 
+def _null_rank_lane(validity: jax.Array, spec: SortSpec) -> jax.Array:
+    """Null placement is independent of direction: the value lane handles
+    direction, this lane handles where nulls land."""
+    if spec.nulls_first:
+        return jnp.where(validity, jnp.int8(1), jnp.int8(0))
+    return jnp.where(validity, jnp.int8(0), jnp.int8(1))
+
+
 def _key_lanes(key_cols: Sequence[TpuColumnVector],
                specs: Sequence[SortSpec],
                live: jax.Array) -> List[jax.Array]:
@@ -170,15 +178,37 @@ def _key_lanes(key_cols: Sequence[TpuColumnVector],
             vals = jnp.where(col.validity, vals, jnp.zeros_like(vals))
         if not spec.ascending:
             vals = ~vals  # total reversal of the signed int order
-        # Null placement is independent of direction: the value lane
-        # handles direction, this lane handles where nulls land.
-        if spec.nulls_first:
-            null_rank = jnp.where(col.validity, jnp.int8(1), jnp.int8(0))
-        else:
-            null_rank = jnp.where(col.validity, jnp.int8(0), jnp.int8(1))
-        lanes.append(null_rank)
+        lanes.append(_null_rank_lane(col.validity, spec))
         lanes.append(vals)
     return lanes
+
+
+def key_lanes_vs_bounds(col: TpuColumnVector, bcol: TpuColumnVector,
+                        spec: SortSpec):
+    """((null_lane, value_lane) for rows, same for bounds) in ONE shared
+    orderable space with the exact _key_lanes semantics — the single
+    source of truth for direction/null/NaN placement, consumed by the
+    range partitioner's row-vs-bound lexicographic compare. Strings rank
+    jointly over the virtual concat; equal nulls share the rank space's
+    top sentinel on both sides."""
+    n = col.capacity
+    if col.is_string_like:
+        ranks = string_order_ranks_multi(
+            [col, bcol], [col.validity, bcol.validity])
+        vr = ranks[:n].astype(jnp.int64)
+        vb = ranks[n:].astype(jnp.int64)
+    elif col.data is None:  # NullType: all rows equal
+        vr = jnp.zeros((n,), jnp.int64)
+        vb = jnp.zeros((bcol.capacity,), jnp.int64)
+    else:
+        vr = jnp.where(col.validity, orderable_int(col).astype(jnp.int64),
+                       jnp.int64(0))
+        vb = jnp.where(bcol.validity,
+                       orderable_int(bcol).astype(jnp.int64), jnp.int64(0))
+    if not spec.ascending:
+        vr, vb = ~vr, ~vb
+    return ((_null_rank_lane(col.validity, spec), vr),
+            (_null_rank_lane(bcol.validity, spec), vb))
 
 
 def key_lanes(key_cols, specs, live):

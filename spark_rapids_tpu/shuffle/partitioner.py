@@ -152,13 +152,54 @@ class RangePartitioning(Partitioning):
         return out
 
     def partition_ids_device(self, batch, ectx):
-        # v1: bounds comparison on host semantics is subtle (nulls/NaN);
-        # evaluate via the same comparison on downloaded key values would
-        # break the device-only path, so do a device searchsorted over
-        # normalized single-key bounds; multi-key falls back to host ids.
-        raise NotImplementedError(
-            "RangePartitioning device path lands with the range "
-            "shuffle exec")
+        """Device range ids from the sampled bounds: per key, rows and
+        the (k-1) host bounds map into one shared orderable lane space
+        (numeric/date/decimal: `orderable_int` over an uploaded bounds
+        lane; strings: joint rank refinement over the virtual concat of
+        column + bounds), then pid = count of bounds strictly below the
+        row tuple — a vectorized (n, k-1) lexicographic compare, the
+        searchsorted analog under arbitrary direction/null placement.
+        Matches `_row_partition`'s host comparison exactly (null==null,
+        NaN largest, -0.0==0.0, direction on values only)."""
+        import jax.numpy as jnp
+        from ..columnar.column import TpuColumnVector
+        from ..expr.base import _np_to_scalar_lane
+        from ..ops.sort_keys import (key_lanes_vs_bounds,
+                                     normalize_float_key_col)
+        if self.bounds is None:
+            raise RuntimeError("compute_bounds before the device split")
+        cap = batch.capacity
+        nb = len(self.bounds)
+        if nb == 0:
+            return jnp.zeros((cap,), jnp.int32)
+        lt = jnp.zeros((cap, nb), jnp.bool_)
+        eq = jnp.ones((cap, nb), jnp.bool_)
+        for j, o in enumerate(self.orders):
+            col = normalize_float_key_col(o.child.eval_tpu(batch, ectx))
+            t = o.child.dtype
+            bvals = [b[j] for b in self.bounds]
+            bvalid = np.array([v is not None for v in bvals], np.bool_)
+            if col.is_string_like:
+                enc = [v.encode() if isinstance(v, str)
+                       else (bytes(v) if v is not None else b"")
+                       for v in bvals]
+                offs = np.zeros(nb + 1, np.int32)
+                offs[1:] = np.cumsum([len(e) for e in enc])
+                chars = np.frombuffer(b"".join(enc), np.uint8)
+                bcol = TpuColumnVector.from_string_parts(
+                    t, offs, chars, bvalid, nb, max(len(chars), 1))
+            else:
+                lane_np = np.array(
+                    [_np_to_scalar_lane(v, t) if v is not None else 0
+                     for v in bvals], t.np_dtype)
+                bcol = TpuColumnVector.from_numpy(t, lane_np, bvalid, nb)
+            rows, bounds = key_lanes_vs_bounds(col, bcol, o.spec)
+            for a, b in zip(rows, bounds):
+                av, bv = a[:, None], b[None, :]
+                lt = lt | (eq & (av < bv))
+                eq = eq & (av == bv)
+        # bounds ascend, so pid = #bounds with row > bound
+        return jnp.sum(~(lt | eq), axis=1).astype(jnp.int32)
 
 
 def _tuple_leq(a, b, orders) -> bool:

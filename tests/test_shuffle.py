@@ -282,3 +282,156 @@ def test_ici_exchange_partition_count_mismatch_raises():
     t = IciShuffleTransport(_mesh())
     with pytest.raises(ValueError, match="mesh size"):
         t.register_shuffle(0, 3)
+
+
+# --- device RangePartitioning: sampled bounds -> searchsorted --------------
+
+def _range_exchange(gens, orders_cols, n=300, parts=4, n_batches=2,
+                    transport=None, **order_kw):
+    from spark_rapids_tpu.exec.sort import SortOrder
+    from spark_rapids_tpu.shuffle.partitioner import RangePartitioning
+    rbs = [gen_table(gens, n, seed=50 + i) for i in range(n_batches)]
+    src = HostBatchSourceExec(rbs)
+    orders = [SortOrder(col(c), **order_kw) for c in orders_cols]
+    return TpuShuffleExchangeExec(
+        RangePartitioning(orders, parts), src,
+        transport=transport) if transport else TpuShuffleExchangeExec(
+        RangePartitioning(orders, parts), src)
+
+
+@pytest.mark.parametrize("asc", [True, False])
+def test_range_partition_int_keys(asc):
+    plan = _range_exchange([IntegerGen(null_frac=0.1), LongGen()],
+                           ["c0"], ascending=asc)
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+def test_range_partition_string_keys():
+    plan = _range_exchange([StringGen(max_len=8, null_frac=0.1),
+                            LongGen()], ["c0"])
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+def test_range_partition_float_multi_key():
+    plan = _range_exchange([DoubleGen(null_frac=0.15),
+                            IntegerGen(min_val=0, max_val=5)],
+                           ["c1", "c0"])
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+def test_range_partition_device_matches_host_ids():
+    """Device pid kernel must place every row exactly where the host
+    _row_partition comparison does."""
+    import numpy as np
+    from spark_rapids_tpu.columnar.arrow_bridge import arrow_to_device
+    from spark_rapids_tpu.exec.sort import SortOrder
+    from spark_rapids_tpu.expr.base import EvalCtx, bind_expr
+    from spark_rapids_tpu.shuffle.partitioner import RangePartitioning
+    rb = gen_table([DoubleGen(null_frac=0.2), StringGen(max_len=5,
+                                                        null_frac=0.2)],
+                   400, seed=77)
+    from spark_rapids_tpu.columnar.arrow_bridge import engine_schema
+    schema = engine_schema(rb.schema)
+    for cols, kw in ((["c0"], {}), (["c1"], {}),
+                     (["c0", "c1"], {"ascending": False,
+                                     "nulls_first": False})):
+        part = RangePartitioning(
+            [SortOrder(col(c), **kw) for c in cols], 5).bind(schema)
+        part.compute_bounds([rb], EvalCtx())
+        cpu_ids = part.partition_ids_cpu(rb, EvalCtx())
+        dev = arrow_to_device(rb, schema)
+        dev_ids = np.asarray(part.partition_ids_device(dev, EvalCtx()))
+        assert (dev_ids[:rb.num_rows] == cpu_ids).all(), cols
+
+
+def test_distributed_global_sort_via_range_shuffle():
+    """Range shuffle + per-partition sort == total sort (the distributed
+    global-sort story — VERDICT r2 item 6)."""
+    from spark_rapids_tpu.exec.sort import (SortOrder, TpuSortExec,
+                                            cpu_sort_table)
+    from spark_rapids_tpu.shuffle.partitioner import RangePartitioning
+    from spark_rapids_tpu.exec.base import ExecCtx, collect_arrow
+    rb = gen_table([IntegerGen(null_frac=0.1), LongGen()], 500, seed=9)
+    src = HostBatchSourceExec([rb])
+    orders = [SortOrder(col("c0")), SortOrder(col("c1"))]
+    ex = TpuShuffleExchangeExec(RangePartitioning(orders, 4), src)
+    # single map batch => one batch per partition => per-batch sort of
+    # the partition-major stream is a global sort
+    plan = TpuSortExec(orders, ex, global_sort=False)
+    got = collect_arrow(plan, ExecCtx())
+    import dataclasses
+    import pyarrow as _pa
+    t = _pa.Table.from_batches([rb])
+    from spark_rapids_tpu.expr.base import EvalCtx as _E, bind_expr as _b
+    bound_orders = [dataclasses.replace(o, child=_b(o.child,
+                                                    plan.output_schema))
+                    for o in orders]
+    karrs = [o.child.eval_cpu(rb, _E()) for o in bound_orders]
+    want = cpu_sort_table(t, karrs, bound_orders)
+    assert got.to_pylist() == want.to_pylist()
+
+
+def test_range_shuffle_over_ici_mesh():
+    """Range partitioning drives the ICI collective over the 8-device
+    mesh: range-shuffled rows land shard-monotone."""
+    from spark_rapids_tpu.shuffle.ici import IciShuffleTransport
+    plan = _range_exchange([IntegerGen(null_frac=0.1), LongGen()],
+                           ["c0"], parts=8, n_batches=8, n=64,
+                           transport=IciShuffleTransport(_mesh()))
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+# --- host Arrow-IPC transport (ladder rung 1) ------------------------------
+
+@pytest.mark.parametrize("codec", ["none", "lz4", "zstd"])
+@pytest.mark.parametrize("mode", ["HOST", "MULTITHREADED"])
+def test_host_shuffle_transport(mode, codec):
+    """Exchange over the Arrow-IPC file transport: same dual-run results
+    as the device-resident store, per codec and threading mode
+    (SURVEY.md §5.8 ladder rungs 1-2; VERDICT r2 item 7)."""
+    from spark_rapids_tpu.config import RapidsConf
+    conf = RapidsConf({"spark.rapids.shuffle.mode": mode,
+                       "spark.rapids.shuffle.compression.codec": codec})
+    plan = TpuShuffleExchangeExec(
+        HashPartitioning([col("c0")], 3),
+        source([IntegerGen(null_frac=0.2), StringGen(max_len=10),
+                DoubleGen(null_frac=0.1)], 300))
+    assert_tpu_and_cpu_plan_equal(plan, conf=conf)
+
+
+def test_host_shuffle_files_cleaned_up():
+    import os
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.exec.base import ExecCtx, collect_arrow
+    from spark_rapids_tpu.shuffle.host import HostShuffleTransport
+    t = HostShuffleTransport(threads=2)
+    plan = TpuShuffleExchangeExec(
+        HashPartitioning([col("c0")], 4),
+        source([IntegerGen(), LongGen()], 200), transport=t)
+    out = collect_arrow(plan, ExecCtx())
+    assert out.num_rows == 200
+    assert os.listdir(t.root) == []  # shuffle dirs removed on unregister
+    t.close()
+    assert not os.path.exists(t.root)
+
+
+def test_host_shuffle_bad_codec_rejected():
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.shuffle.host import HostShuffleTransport
+    with pytest.raises(ValueError):
+        HostShuffleTransport(RapidsConf(
+            {"spark.rapids.shuffle.compression.codec": "snappy"}))
+
+
+def test_host_shuffle_feeds_groupby():
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.expr import Alias
+    from spark_rapids_tpu.expr.aggregates import Count, Sum
+    conf = RapidsConf({"spark.rapids.shuffle.mode": "MULTITHREADED"})
+    src = source([IntegerGen(min_val=0, max_val=30), LongGen()], 400)
+    ex = TpuShuffleExchangeExec(HashPartitioning([col("c0")], 4), src)
+    plan = TpuHashAggregateExec([col("c0")],
+                                [Alias(Sum(col("c1")), "s"),
+                                 Alias(Count(), "c")], ex)
+    assert_tpu_and_cpu_plan_equal(plan, conf=conf, ignore_order=True)
