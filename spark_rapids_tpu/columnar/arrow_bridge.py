@@ -90,12 +90,61 @@ def _string_parts(arr: pa.Array) -> Tuple[np.ndarray, np.ndarray]:
     return offsets, chars
 
 
+def _pad_validity(valid: Optional[np.ndarray], n: int, capacity: int):
+    import jax.numpy as jnp
+    out = np.zeros(capacity, np.bool_)
+    out[:n] = True if valid is None else valid
+    return jnp.asarray(out)
+
+
+def _list_parts(arr: pa.Array):
+    """(offsets[int32 n+1] rebased to 0, element window (start, end))."""
+    at = arr.type
+    if pa.types.is_large_list(at):
+        arr = arr.cast(pa.list_(at.value_type))
+    bufs = arr.buffers()
+    offsets = np.frombuffer(bufs[1], np.int32)[
+        arr.offset: arr.offset + len(arr) + 1]
+    start, end = int(offsets[0]), int(offsets[-1])
+    if start != 0:
+        offsets = offsets - start
+    return offsets, start, end
+
+
 def arrow_column_to_device(arr, t: dt.DataType, capacity: int) \
         -> TpuColumnVector:
+    import jax.numpy as jnp
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
+    n = len(arr)
     if isinstance(t, dt.NullType):
         return TpuColumnVector.nulls(t, capacity)
+    if isinstance(t, dt.StructType):
+        valid = _valid_mask(arr)
+        children = [arrow_column_to_device(arr.field(i), f.dtype, capacity)
+                    for i, f in enumerate(t.fields)]
+        return TpuColumnVector(t, validity=_pad_validity(valid, n, capacity),
+                               children=children)
+    if isinstance(t, (dt.ArrayType, dt.MapType)):
+        valid = _valid_mask(arr)
+        offsets, start, end = _list_parts(arr)
+        obuf = np.zeros(capacity + 1, np.int32)
+        obuf[:n + 1] = offsets
+        obuf[n + 1:] = offsets[-1] if n else 0
+        ecap = bucket_rows(end - start)
+        if isinstance(t, dt.MapType):
+            children = [
+                arrow_column_to_device(
+                    arr.keys.slice(start, end - start), t.key_type, ecap),
+                arrow_column_to_device(
+                    arr.items.slice(start, end - start), t.value_type,
+                    ecap)]
+        else:
+            children = [arrow_column_to_device(
+                arr.values.slice(start, end - start), t.element_type,
+                ecap)]
+        return TpuColumnVector(t, validity=_pad_validity(valid, n, capacity),
+                               offsets=jnp.asarray(obuf), children=children)
     if t.is_variable_width:
         if isinstance(t, dt.DecimalType):
             raise NotImplementedError(
@@ -130,21 +179,59 @@ def _null_buffer(valid: np.ndarray):
     return pa.array(valid).buffers()[1]
 
 
-def _host_column_to_arrow(col: TpuColumnVector, host, n: int) -> pa.Array:
+def _host_column_to_arrow(col: TpuColumnVector, host, n: int,
+                          row_start: int = 0) -> pa.Array:
     """Build an Arrow array from prefetched host buffers. `host` maps the
-    column's device arrays (by position in col.arrays()) to numpy."""
+    column's device arrays (by position in col.arrays(), pre-order
+    through nested children) to numpy. `row_start` selects a child
+    window for nested recursion (array elements)."""
     t = col.dtype
     atype = dt.to_arrow(t)
     bufs = list(host)
     data = bufs.pop(0) if col.data is not None else None
-    valid = np.asarray(bufs.pop(0))[:n]
+    valid = np.asarray(bufs.pop(0))[row_start: row_start + n]
     offsets_h = np.asarray(bufs.pop(0)) if col.offsets is not None else None
     chars_h = np.asarray(bufs.pop(0)) if col.chars is not None else None
     mask = None if bool(valid.all()) else ~valid
+    if isinstance(t, dt.StructType):
+        null_buf = None if mask is None else _null_buffer(valid)
+        children = []
+        for ch in col.children:
+            k = len(ch.arrays())
+            children.append(_host_column_to_arrow(ch, bufs[:k], n,
+                                                  row_start))
+            bufs = bufs[k:]
+        return pa.Array.from_buffers(atype, n, [null_buf],
+                                     children=children)
+    if isinstance(t, (dt.ArrayType, dt.MapType)):
+        offsets = offsets_h[row_start: row_start + n + 1].astype(
+            np.int32, copy=True)
+        start = int(offsets[0]) if n else 0
+        end = int(offsets[-1]) if n else 0
+        if start != 0:
+            offsets = offsets - start
+        null_buf = None if mask is None else _null_buffer(valid)
+        children = []
+        for ch in col.children:
+            k = len(ch.arrays())
+            children.append(_host_column_to_arrow(ch, bufs[:k],
+                                                  end - start, start))
+            bufs = bufs[k:]
+        if isinstance(t, dt.MapType):
+            entries = pa.StructArray.from_arrays(
+                children, fields=[atype.key_field, atype.item_field])
+            return pa.Array.from_buffers(
+                atype, n,
+                [null_buf, pa.py_buffer(np.ascontiguousarray(offsets))],
+                children=[entries])
+        return pa.Array.from_buffers(
+            atype, n,
+            [null_buf, pa.py_buffer(np.ascontiguousarray(offsets))],
+            children=children)
     if isinstance(t, dt.NullType):
         return pa.nulls(n)
     if col.is_string_like:
-        offsets = offsets_h[: n + 1]
+        offsets = offsets_h[row_start: row_start + n + 1]
         chars = chars_h
         start = int(offsets[0]) if n else 0
         end = int(offsets[-1]) if n else 0
@@ -160,7 +247,7 @@ def _host_column_to_arrow(col: TpuColumnVector, host, n: int) -> pa.Array:
              pa.py_buffer(np.ascontiguousarray(chars[start:end]))],
             null_count=-1)
         return arr
-    values = np.asarray(data)[:n]
+    values = np.asarray(data)[row_start: row_start + n]
     if isinstance(t, dt.DecimalType):
         lo = values.astype(np.int64)
         hi = (lo >> 63).astype(np.int64)  # sign extension

@@ -24,22 +24,33 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..datatypes import (DataType, StringType, BinaryType, DecimalType,
-                         NullType)
+from ..datatypes import (ArrayType, BinaryType, DataType, DecimalType,
+                         MapType, NullType, StringType, StructType,
+                         is_nested)
 
-__all__ = ["TpuColumnVector"]
+__all__ = ["TpuColumnVector", "is_nested"]
 
 
 class TpuColumnVector:
-    __slots__ = ("dtype", "data", "validity", "offsets", "chars")
+    """Nested layouts (Arrow-shaped, SURVEY.md §2.2-A):
+      - struct:     ``children`` = one column per field + own validity.
+      - array:      ``offsets`` (int32, cap+1) into ``children[0]`` (the
+                    element column, its own capacity) + validity.
+      - map:        array layout with ``children`` = [keys, values]
+                    (shared offsets).
+    """
+
+    __slots__ = ("dtype", "data", "validity", "offsets", "chars",
+                 "children")
 
     def __init__(self, dtype: DataType, data=None, validity=None,
-                 offsets=None, chars=None):
+                 offsets=None, chars=None, children=None):
         self.dtype = dtype
         self.data = data
         self.validity = validity
         self.offsets = offsets
         self.chars = chars
+        self.children = children
 
     # -- constructors -----------------------------------------------------
     @classmethod
@@ -80,6 +91,18 @@ class TpuColumnVector:
     @classmethod
     def nulls(cls, dtype: DataType, capacity: int):
         v = jnp.zeros((capacity,), dtype=jnp.bool_)
+        if isinstance(dtype, StructType):
+            return cls(dtype, validity=v,
+                       children=[cls.nulls(f.dtype, capacity)
+                                 for f in dtype.fields])
+        if isinstance(dtype, (ArrayType, MapType)):
+            offs = jnp.zeros((capacity + 1,), jnp.int32)
+            if isinstance(dtype, MapType):
+                ch = [cls.nulls(dtype.key_type, 0),
+                      cls.nulls(dtype.value_type, 0)]
+            else:
+                ch = [cls.nulls(dtype.element_type, 0)]
+            return cls(dtype, validity=v, offsets=offs, children=ch)
         if dtype.is_variable_width:
             return cls(dtype, validity=v,
                        offsets=jnp.zeros((capacity + 1,), jnp.int32),
@@ -92,30 +115,41 @@ class TpuColumnVector:
     def capacity(self) -> int:
         if self.data is not None:
             return self.data.shape[0]
-        return self.offsets.shape[0] - 1
+        if self.offsets is not None:
+            return self.offsets.shape[0] - 1
+        return self.validity.shape[0]  # struct: validity lane is the cap
 
     @property
     def is_string_like(self) -> bool:
         return isinstance(self.dtype, (StringType, BinaryType))
 
+    @property
+    def is_nested(self) -> bool:
+        return is_nested(self.dtype)
+
     def arrays(self):
-        """The jax.Arrays backing this column, for jit flattening."""
+        """The jax.Arrays backing this column (pre-order through nested
+        children), for jit flattening and single-transfer downloads."""
         out = []
         for a in (self.data, self.validity, self.offsets, self.chars):
             if a is not None:
                 out.append(a)
+        for ch in (self.children or ()):
+            out.extend(ch.arrays())
         return out
 
     def device_size_bytes(self) -> int:
         return sum(a.size * a.dtype.itemsize for a in self.arrays())
 
-    def with_arrays(self, data=None, validity=None, offsets=None, chars=None):
+    def with_arrays(self, data=None, validity=None, offsets=None,
+                    chars=None, children=None):
         return TpuColumnVector(
             self.dtype,
             data=self.data if data is None else data,
             validity=self.validity if validity is None else validity,
             offsets=self.offsets if offsets is None else offsets,
-            chars=self.chars if chars is None else chars)
+            chars=self.chars if chars is None else chars,
+            children=self.children if children is None else children)
 
     def __repr__(self):
         return (f"TpuColumnVector({self.dtype.simple_string()}, "
@@ -123,14 +157,18 @@ class TpuColumnVector:
 
 
 def _flatten_col(c: TpuColumnVector):
-    children = (c.data, c.validity, c.offsets, c.chars)
-    return children, c.dtype
+    nch = None if c.children is None else len(c.children)
+    leaves = (c.data, c.validity, c.offsets, c.chars,
+              tuple(c.children) if c.children is not None else ())
+    return leaves, (c.dtype, nch)
 
 
-def _unflatten_col(dtype, children):
-    data, validity, offsets, chars = children
+def _unflatten_col(aux, leaves):
+    dtype, nch = aux
+    data, validity, offsets, chars, children = leaves
     return TpuColumnVector(dtype, data=data, validity=validity,
-                           offsets=offsets, chars=chars)
+                           offsets=offsets, chars=chars,
+                           children=None if nch is None else list(children))
 
 
 jax.tree_util.register_pytree_node(TpuColumnVector, _flatten_col,

@@ -27,7 +27,8 @@ __all__ = [
     "MapType", "StructType", "StructField", "Schema",
     "NULL", "BOOL", "INT8", "INT16", "INT32", "INT64", "FLOAT32", "FLOAT64",
     "STRING", "BINARY", "DATE", "TIMESTAMP",
-    "is_numeric", "is_integral", "is_floating", "common_type",
+    "is_numeric", "is_integral", "is_floating", "is_nested",
+    "common_type",
 ]
 
 
@@ -290,6 +291,10 @@ def is_floating(dt: DataType) -> bool:
 
 def is_numeric(dt: DataType) -> bool:
     return is_integral(dt) or is_floating(dt) or isinstance(dt, DecimalType)
+
+
+def is_nested(dt: DataType) -> bool:
+    return isinstance(dt, (StructType, ArrayType, MapType))
 
 
 _NUMERIC_ORDER = [ByteType, ShortType, IntegerType, LongType, FloatType,

@@ -2,3 +2,4 @@ from .base import (ExecCtx, TpuExec, TpuMetric, HostBatchSourceExec,
                    collect_arrow, collect_arrow_cpu)
 from .basic import TpuProjectExec, TpuFilterExec, TpuRangeExec
 from .window import TpuWindowExec
+from .generate import TpuGenerateExec

@@ -105,7 +105,15 @@ class TpuHashAggregateExec(UnaryExec):
         return f"HashAggregateExec [keys=[{g}] aggs=[{a}]]"
 
     def tpu_supported(self):
+        for e in self.group_exprs:
+            if dt.is_nested(e.dtype):
+                return (f"grouping by nested type "
+                        f"{e.dtype.simple_string()} not on device")
         for a in self.aggs:
+            for c in a.children:
+                if dt.is_nested(c.dtype):
+                    return (f"aggregating nested type "
+                            f"{c.dtype.simple_string()} not on device")
             r = a.tpu_supported()
             if r:
                 return r

@@ -70,6 +70,15 @@ class TpuShuffleExchangeExec(UnaryExec):
         return (f"ShuffleExchangeExec [{type(self.partitioning).__name__} "
                 f"n={self.partitioning.num_partitions}]")
 
+    def tpu_supported(self):
+        key_exprs = getattr(self.partitioning, "key_exprs", None) or \
+            [o.child for o in getattr(self.partitioning, "orders", [])]
+        for e in key_exprs:
+            if dt.is_nested(e.dtype):
+                return (f"partitioning by nested type "
+                        f"{e.dtype.simple_string()} not on device")
+        return None
+
     def _split(self, batch: TpuBatch, ectx):
         """All partitions in ONE traced call: compute pids once, emit one
         selection-masked view per partition. The views share the input's
@@ -193,6 +202,14 @@ class TpuBroadcastExchangeExec(UnaryExec):
         super().__init__(child)
         self._sb = None  # SpillableBatch
 
+    def tpu_supported(self):
+        from ..ops.concat import device_concat_supported
+        for f in self.child.output_schema.fields:
+            if not device_concat_supported(f.dtype):
+                return (f"broadcast of nested column {f.name} not on "
+                        "device (no nested device concat yet)")
+        return None
+
     def spillable(self, ctx: ExecCtx):
         """The catalog handle for the broadcast payload (None if the
         child is empty). Join build sides reuse this handle instead of
@@ -232,6 +249,14 @@ class TpuCoalesceBatchesExec(UnaryExec):
 
     def describe(self):
         return f"CoalesceBatchesExec [target={self.target_rows}]"
+
+    def tpu_supported(self):
+        from ..ops.concat import device_concat_supported
+        for f in self.child.output_schema.fields:
+            if not device_concat_supported(f.dtype):
+                return (f"coalescing nested column {f.name} not on "
+                        "device (no nested device concat yet)")
+        return None
 
     def execute(self, ctx: ExecCtx):
         pending: List[TpuBatch] = []

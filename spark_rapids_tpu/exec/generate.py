@@ -1,0 +1,264 @@
+"""Generate operator: explode / posexplode (+outer) over arrays and maps.
+
+TPU analog of the reference's `GpuGenerateExec` (SURVEY.md §2.2-B
+"Expand/Generate"; mount empty, capability-built), staged like the join
+(output size is data-dependent — SURVEY.md §7.3.1):
+
+  stage A (jit)  — per-row emit counts (array length; 1 for null/empty
+                   under outer), total output rows
+  host sync      — static output capacity bucket
+  stage B (jit)  — output row -> (source row, element offset) via
+                   searchsorted over the emit prefix sum + string byte
+                   counts for the repeated columns
+  host sync      — char capacity buckets
+  stage C (jit)  — gather repeated columns by source row, element
+                   column(s) by element index, pos lane for posexplode
+
+Each source element appears at most once in the output, so element
+gathers keep the child's static capacity; REPEATED string columns grow
+with the fan-out and are sized in stage B (repeated array/nested
+columns would need recursive sizing and fall back via tpu_supported).
+"""
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import pyarrow as pa
+
+from .. import datatypes as dt
+from ..columnar.arrow_bridge import arrow_schema
+from ..columnar.batch import TpuBatch, bucket_bytes, bucket_rows
+from ..columnar.column import TpuColumnVector
+from ..expr.base import Expression, bind_expr
+from ..ops.gather import exclusive_cumsum, gather_column
+from .base import ExecCtx, TpuExec, UnaryExec
+
+__all__ = ["TpuGenerateExec"]
+
+
+def _string_descendants(c: TpuColumnVector):
+    """String lanes within a repeated column (itself, or struct fields
+    recursively), in the fixed pre-order stage B and C share for char-
+    capacity sizing. Arrays never appear here (tpu_supported gate)."""
+    if c.is_string_like:
+        yield c
+    elif c.children is not None and c.offsets is None:  # struct
+        for ch in c.children:
+            yield from _string_descendants(ch)
+
+
+def _gather_repeated(c: TpuColumnVector, lidx, live_out, caps):
+    """Gather a repeated (fan-out duplicating) column: every string lane
+    gets its stage-B-sized char capacity from `caps` (duplication can
+    exceed the source buffer); struct recursion keeps row alignment."""
+    from ..ops.gather import gather_column
+    if c.is_string_like:
+        return gather_column(c, lidx, live_out, next(caps))
+    if c.children is not None and c.offsets is None:  # struct
+        children = [_gather_repeated(ch, lidx, live_out, caps)
+                    for ch in c.children]
+        return TpuColumnVector(c.dtype,
+                               validity=c.validity[lidx] & live_out,
+                               children=children)
+    return gather_column(c, lidx, live_out)
+
+
+class TpuGenerateExec(UnaryExec):
+    """explode(expr) appending element column(s) to the child's columns
+    (Spark's Generate with requiredChildOutput = full child output)."""
+
+    def __init__(self, generator: Expression, child: TpuExec,
+                 outer: bool = False, position: bool = False,
+                 element_name: str = "col", pos_name: str = "pos"):
+        super().__init__(child)
+        self.generator = bind_expr(generator, child.output_schema)
+        self.outer = outer
+        self.position = position
+        gt = self.generator.dtype
+        if not isinstance(gt, (dt.ArrayType, dt.MapType)):
+            raise TypeError(
+                f"explode needs array/map input, got {gt.simple_string()}")
+        self.is_map = isinstance(gt, dt.MapType)
+        # Spark prunes the consumed column from Generate's child output
+        # (requiredChildOutput excludes the generator input when it is a
+        # plain column): repeated columns are the OTHER child columns
+        from ..expr.base import BoundReference
+        gen_ord = self.generator.ordinal \
+            if isinstance(self.generator, BoundReference) else None
+        self.keep_ordinals = [i for i in range(len(child.output_schema))
+                              if i != gen_ord]
+        kept_fields = [child.output_schema.fields[i]
+                       for i in self.keep_ordinals]
+        gen_fields = []
+        if position:
+            # outer emits a (null pos, null element) row for empty/null
+            gen_fields.append(dt.StructField(pos_name, dt.INT32, outer))
+        if self.is_map:
+            gen_fields.append(dt.StructField("key", gt.key_type, outer))
+            gen_fields.append(
+                dt.StructField("value", gt.value_type, True))
+        else:
+            gen_fields.append(
+                dt.StructField(element_name, gt.element_type, True))
+        self._schema = dt.Schema(kept_fields + gen_fields)
+        self._kept_schema = dt.Schema(kept_fields)
+        self._jit_a = None
+        self._jit_b: Dict[int, object] = {}
+        self._jit_c: Dict[tuple, object] = {}
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    def describe(self):
+        fn = "posexplode" if self.position else "explode"
+        if self.outer:
+            fn += "_outer"
+        return f"GenerateExec [{fn}({self.generator!r})]"
+
+    def expressions(self):
+        return [self.generator]
+
+    @staticmethod
+    def _has_list(t) -> bool:
+        if isinstance(t, (dt.ArrayType, dt.MapType)):
+            return True
+        if isinstance(t, dt.StructType):
+            return any(TpuGenerateExec._has_list(f.dtype)
+                       for f in t.fields)
+        return False
+
+    def tpu_supported(self):
+        for f in self._kept_schema.fields:
+            if self._has_list(f.dtype):
+                return ("explode with repeated array/map columns not on "
+                        "device (element-capacity sizing is per string "
+                        "lane only)")
+        return None
+
+    def _kept_batch(self, batch: TpuBatch) -> TpuBatch:
+        cols = [batch.columns[i] for i in self.keep_ordinals]
+        return TpuBatch(cols, self._kept_schema, batch.row_count,
+                        selection=batch.selection)
+
+    # --- staged device kernel ---------------------------------------------
+
+    def _stage_a(self, batch: TpuBatch, ectx):
+        gcol = self.generator.eval_tpu(batch, ectx)
+        live = batch.live_mask()
+        lens = gcol.offsets[1:] - gcol.offsets[:-1]
+        real = jnp.where(live & gcol.validity, lens, 0)
+        if self.outer:
+            emit = jnp.where(live, jnp.maximum(real, 1), 0)
+        else:
+            emit = real
+        return emit, real, gcol, jnp.sum(emit)
+
+    def _stage_b(self, out_cap: int, emit, real, gcol, batch: TpuBatch):
+        n = batch.capacity
+        j = jnp.arange(out_cap, dtype=jnp.int32)
+        out_start = exclusive_cumsum(emit)
+        ends = out_start + emit
+        total = jnp.sum(emit)
+        lidx = jnp.searchsorted(ends, j, side="right").astype(jnp.int32)
+        lidx = jnp.clip(lidx, 0, n - 1)
+        k = j - out_start[lidx]
+        live_out = j < total
+        is_real = live_out & (k < real[lidx])
+        ecap = max(gcol.children[0].capacity, 1)
+        elem_idx = jnp.clip(gcol.offsets[:-1][lidx] + k, 0, ecap - 1)
+        byte_counts = []
+        for c in batch.columns:
+            for sc in _string_descendants(c):
+                slens = sc.offsets[1:] - sc.offsets[:-1]
+                byte_counts.append(jnp.sum(
+                    jnp.where(live_out, slens[lidx], 0)))
+        stacked = jnp.stack(byte_counts) if byte_counts else \
+            jnp.zeros((0,), jnp.int32)
+        return lidx, k, elem_idx, live_out, is_real, total, stacked
+
+    def _stage_c(self, char_caps: tuple, gcol, batch, lidx, k, elem_idx,
+                 live_out, is_real, total):
+        caps = iter(char_caps)
+        cols = [_gather_repeated(c, lidx, live_out, caps)
+                for c in batch.columns]
+        if self.position:
+            pos_valid = is_real if self.outer else live_out
+            cols.append(TpuColumnVector(dt.INT32,
+                                        data=k.astype(jnp.int32),
+                                        validity=pos_valid))
+        elem_children = gcol.children
+        for ch in elem_children:
+            out = gather_column(ch, elem_idx, is_real)
+            cols.append(out)
+        return TpuBatch(cols, self._schema, total)
+
+    def execute(self, ctx: ExecCtx):
+        if self.tpu_supported() is not None:
+            raise NotImplementedError(self.tpu_supported())
+        if self._jit_a is None:
+            self._jit_a = jax.jit(self._stage_a, static_argnums=1)
+        op_time = ctx.metric(self, "opTime")
+        for batch in self.child.execute(ctx):
+            t0 = time.perf_counter()
+            emit, real, gcol, total_d = self._jit_a(batch, ctx.eval_ctx)
+            kept = self._kept_batch(batch)
+            total = int(jax.device_get(total_d))
+            out_cap = bucket_rows(total)
+            bfn = self._jit_b.get(out_cap)
+            if bfn is None:
+                bfn = jax.jit(partial(self._stage_b, out_cap))
+                self._jit_b[out_cap] = bfn
+            lidx, k, elem_idx, live_out, is_real, total_d, bytes_d = \
+                bfn(emit, real, gcol, kept)
+            nbytes = [int(v) for v in jax.device_get(bytes_d)] \
+                if bytes_d.shape[0] else []
+            # one cap per string LANE (pre-order through struct children)
+            char_caps = [bucket_bytes(max(b, 1)) for b in nbytes]
+            ckey = (out_cap, tuple(char_caps))
+            cfn = self._jit_c.get(ckey)
+            if cfn is None:
+                cfn = jax.jit(partial(self._stage_c, tuple(char_caps)))
+                self._jit_c[ckey] = cfn
+            out = cfn(gcol, kept, lidx, k, elem_idx, live_out, is_real,
+                      total_d)
+            if ctx.sync_metrics:
+                out.block_until_ready()
+            op_time.value += time.perf_counter() - t0
+            yield out
+
+    # --- CPU oracle -------------------------------------------------------
+
+    def execute_cpu(self, ctx: ExecCtx):
+        out_schema = arrow_schema(self._schema)
+        for rb in self.child.execute_cpu(ctx):
+            gvals = self.generator.eval_cpu(rb, ctx.eval_ctx).to_pylist()
+            cols = [rb.column(i).to_pylist() for i in self.keep_ordinals]
+            rows: List[tuple] = []
+            for r in range(rb.num_rows):
+                v = gvals[r]
+                base = tuple(c[r] for c in cols)
+                items = list(v) if v else []
+                if not items:
+                    if self.outer:
+                        extra = ((None,) if self.position else ())
+                        if self.is_map:
+                            rows.append(base + extra + (None, None))
+                        else:
+                            rows.append(base + extra + (None,))
+                    continue
+                for pos, item in enumerate(items):
+                    extra = ((pos,) if self.position else ())
+                    if self.is_map:
+                        rows.append(base + extra + (item[0], item[1]))
+                    else:
+                        rows.append(base + extra + (item,))
+            arrays = []
+            for i, f in enumerate(self._schema.fields):
+                arrays.append(pa.array([r[i] for r in rows],
+                                       type=dt.to_arrow(f.dtype)))
+            yield pa.RecordBatch.from_arrays(arrays, schema=out_schema)

@@ -98,6 +98,13 @@ class _BaseJoinExec(TpuExec):
                 self.join_type not in ("inner", "cross"):
             return (f"non-equi condition on {self.join_type} join not yet "
                     "on device")
+        for schema in (self.left.output_schema, self.right.output_schema):
+            for f in schema.fields:
+                if dt.is_nested(f.dtype):
+                    # join gathers duplicate rows; nested payload sizing
+                    # is top-level only (gather_list keeps the child cap)
+                    return (f"join over nested column {f.name} "
+                            f"({f.dtype.simple_string()}) not on device")
         return None
 
     def expressions(self):

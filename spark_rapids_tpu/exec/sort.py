@@ -69,8 +69,25 @@ def sort_batch_by(batch: TpuBatch, orders: Sequence[SortOrder],
 
 # --- CPU oracle sort (Spark semantics over host rows) ---------------------
 
+def _nested_cpu_key(v):
+    """Recursive comparable for nested values: null-first, NaN-largest,
+    -0.0==0.0; tuples give Spark's field-wise / element-wise-then-length
+    ordering."""
+    if v is None:
+        return (0,)
+    if isinstance(v, dict):
+        return (1,) + tuple(_nested_cpu_key(x) for x in v.values())
+    if isinstance(v, (list, tuple)):
+        return (1,) + tuple(_nested_cpu_key(x) for x in v)
+    if isinstance(v, float):
+        return (1, (1, 0.0)) if math.isnan(v) else (1, (0, v + 0.0))
+    return (1, (0, v))
+
+
 def _cpu_pass_key(t: dt.DataType):
     """Per-value comparable for one sort pass; None handled separately."""
+    if dt.is_nested(t):
+        return _nested_cpu_key
     if dt.is_floating(t):
         return lambda v: (1, 0.0) if (isinstance(v, float)
                                       and math.isnan(v)) else (0, v + 0.0)
@@ -111,6 +128,21 @@ class TpuSortExec(UnaryExec):
             f"{o.child!r} {'ASC' if o.ascending else 'DESC'} NULLS "
             f"{'FIRST' if o.nulls_first else 'LAST'}" for o in self.orders)
         return f"SortExec [{keys}] global={self.global_sort}"
+
+    def tpu_supported(self):
+        from ..ops.concat import device_concat_supported
+        for o in self.orders:
+            if dt.is_nested(o.child.dtype):
+                return (f"sorting by nested type "
+                        f"{o.child.dtype.simple_string()} not on device")
+        if self.global_sort:
+            # the global merge concatenates batches on device
+            for f in self.child.output_schema.fields:
+                if not device_concat_supported(f.dtype):
+                    return (f"global sort with payload column {f.name} "
+                            f"({f.dtype.simple_string()}) needs nested "
+                            "device concat")
+        return None
 
     def expressions(self):
         return [o.child for o in self.orders]

@@ -25,3 +25,5 @@ from .strings import (Length, Upper, Lower, Substring, ConcatStrings,
 from .window import (WindowFrame, WindowExpression, RowNumber, Rank,
                      DenseRank, PercentRank, NTile, Lag, Lead,
                      ROWS_UNBOUNDED, RANGE_CURRENT)
+from .complex import (GetStructField, GetArrayItem, CreateNamedStruct,
+                      Size, MapKeys, MapValues)

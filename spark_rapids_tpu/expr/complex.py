@@ -1,0 +1,221 @@
+"""Complex-type expressions: struct/array/map access and construction.
+
+TPU analog of the reference's complex-type expression surface
+(`GetStructField`, `GetArrayItem`, `CreateNamedStruct`, `Size`,
+`MapKeys`/`MapValues` — SURVEY.md §2.2-C "Complex types"; mount empty,
+capability-built). Device layout is Arrow-shaped (columnar/column.py):
+struct = child columns, array/map = offsets + element columns — so
+field access is child selection, and element access is a gather.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import pyarrow as pa
+
+from .. import datatypes as dt
+from ..columnar.column import TpuColumnVector
+from .base import Expression
+
+__all__ = ["GetStructField", "GetArrayItem", "CreateNamedStruct",
+           "Size", "MapKeys", "MapValues"]
+
+
+class GetStructField(Expression):
+    """struct.field — child column selection + parent-null propagation."""
+
+    def __init__(self, child: Expression, name: str):
+        self.children = (child,)
+        self.name = name
+
+    def _struct_type(self) -> dt.StructType:
+        t = self.children[0].dtype
+        if not isinstance(t, dt.StructType):
+            raise TypeError(f"GetStructField over {t.simple_string()}")
+        return t
+
+    @property
+    def ordinal(self) -> int:
+        st = self._struct_type()
+        for i, f in enumerate(st.fields):
+            if f.name == self.name:
+                return i
+        raise KeyError(f"no field {self.name!r} in "
+                       f"{st.simple_string()}")
+
+    @property
+    def dtype(self):
+        return self._struct_type().fields[self.ordinal].dtype
+
+    def validate(self):
+        self.ordinal  # raises on bad field / non-struct
+
+    def eval_tpu(self, batch, ctx):
+        scol = self.children[0].eval_tpu(batch, ctx)
+        field = scol.children[self.ordinal]
+        return field.with_arrays(validity=field.validity & scol.validity)
+
+    def eval_cpu(self, rb, ctx):
+        arr = self.children[0].eval_cpu(rb, ctx)
+        vals = arr.to_pylist()
+        out = [None if v is None else v[self.name] for v in vals]
+        return pa.array(out, type=dt.to_arrow(self.dtype))
+
+    def __repr__(self):
+        return f"{self.children[0]!r}.{self.name}"
+
+
+class GetArrayItem(Expression):
+    """array[index] (0-based, Spark semantics: out-of-range -> null in
+    non-ANSI mode)."""
+
+    def __init__(self, child: Expression, index: Expression):
+        self.children = (child, index)
+
+    @property
+    def dtype(self):
+        t = self.children[0].dtype
+        if not isinstance(t, dt.ArrayType):
+            raise TypeError(f"GetArrayItem over {t.simple_string()}")
+        return t.element_type
+
+    def validate(self):
+        self.dtype
+        if not dt.is_integral(self.children[1].dtype):
+            raise TypeError("array index must be integral")
+
+    def eval_tpu(self, batch, ctx):
+        from ..ops.gather import gather_column
+        acol = self.children[0].eval_tpu(batch, ctx)
+        icol = self.children[1].eval_tpu(batch, ctx)
+        lens = acol.offsets[1:] - acol.offsets[:-1]
+        k = icol.data.astype(jnp.int32)
+        ok = acol.validity & icol.validity & (k >= 0) & (k < lens)
+        elem = acol.children[0]
+        ecap = max(elem.capacity, 1)
+        idx = jnp.clip(acol.offsets[:-1] + k, 0, ecap - 1)
+        if elem.capacity == 0:
+            return TpuColumnVector.nulls(self.dtype, acol.capacity)
+        return gather_column(elem, idx, ok)
+
+    def eval_cpu(self, rb, ctx):
+        arrs = self.children[0].eval_cpu(rb, ctx).to_pylist()
+        idxs = self.children[1].eval_cpu(rb, ctx).to_pylist()
+        out = []
+        for a, i in zip(arrs, idxs):
+            if a is None or i is None or not (0 <= i < len(a)):
+                out.append(None)
+            else:
+                out.append(a[i])
+        return pa.array(out, type=dt.to_arrow(self.dtype))
+
+    def __repr__(self):
+        return f"{self.children[0]!r}[{self.children[1]!r}]"
+
+
+class CreateNamedStruct(Expression):
+    """named_struct(n1, v1, ...) — never null at the top level."""
+
+    def __init__(self, names: Sequence[str],
+                 values: Sequence[Expression]):
+        if len(names) != len(values):
+            raise ValueError("names/values length mismatch")
+        self.names = list(names)
+        self.children = tuple(values)
+
+    @property
+    def dtype(self):
+        return dt.StructType([dt.StructField(n, c.dtype, c.nullable)
+                              for n, c in zip(self.names, self.children)])
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval_tpu(self, batch, ctx):
+        cols = [c.eval_tpu(batch, ctx) for c in self.children]
+        return TpuColumnVector(
+            self.dtype, validity=jnp.ones((batch.capacity,), jnp.bool_),
+            children=cols)
+
+    def eval_cpu(self, rb, ctx):
+        arrays = [c.eval_cpu(rb, ctx) for c in self.children]
+        return pa.StructArray.from_arrays(arrays, names=self.names)
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}={c!r}"
+                          for n, c in zip(self.names, self.children))
+        return f"named_struct({inner})"
+
+
+class Size(Expression):
+    """size(array|map): element count; null input -> null (Spark 3
+    default, spark.sql.legacy.sizeOfNull=false)."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return dt.INT32
+
+    def validate(self):
+        t = self.children[0].dtype
+        if not isinstance(t, (dt.ArrayType, dt.MapType)):
+            raise TypeError(f"size() over {t.simple_string()}")
+
+    def eval_tpu(self, batch, ctx):
+        col = self.children[0].eval_tpu(batch, ctx)
+        lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
+        return TpuColumnVector(dt.INT32, data=lens, validity=col.validity)
+
+    def eval_cpu(self, rb, ctx):
+        vals = self.children[0].eval_cpu(rb, ctx).to_pylist()
+        return pa.array([None if v is None else len(v) for v in vals],
+                        pa.int32())
+
+
+class _MapProject(Expression):
+    """map_keys / map_values: reuse the map's offsets over one child."""
+
+    child_index = 0
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def _map_type(self) -> dt.MapType:
+        t = self.children[0].dtype
+        if not isinstance(t, dt.MapType):
+            raise TypeError(f"{self.pretty_name()} over "
+                            f"{t.simple_string()}")
+        return t
+
+    @property
+    def dtype(self):
+        mt = self._map_type()
+        inner = mt.key_type if self.child_index == 0 else mt.value_type
+        return dt.ArrayType(inner)
+
+    def validate(self):
+        self._map_type()
+
+    def eval_tpu(self, batch, ctx):
+        col = self.children[0].eval_tpu(batch, ctx)
+        return TpuColumnVector(self.dtype, validity=col.validity,
+                               offsets=col.offsets,
+                               children=[col.children[self.child_index]])
+
+    def eval_cpu(self, rb, ctx):
+        vals = self.children[0].eval_cpu(rb, ctx).to_pylist()
+        i = self.child_index
+        out = [None if v is None else [kv[i] for kv in v] for v in vals]
+        return pa.array(out, type=dt.to_arrow(self.dtype))
+
+
+class MapKeys(_MapProject):
+    child_index = 0
+
+
+class MapValues(_MapProject):
+    child_index = 1

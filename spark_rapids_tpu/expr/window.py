@@ -245,6 +245,12 @@ class WindowExpression(Expression):
     def tpu_supported(self) -> Optional[str]:
         f = self.func
         fr = self.frame
+        for e in self.partition_by:
+            if dt.is_nested(e.dtype):
+                return "window partition by nested type not on device"
+        for o in self.order_by:
+            if dt.is_nested(o.child.dtype):
+                return "window order by nested type not on device"
         if isinstance(f, AggregateFunction) \
                 and not isinstance(f, _DEVICE_WINDOW_AGGS):
             return (f"window aggregate {f.pretty_name()} not on device "

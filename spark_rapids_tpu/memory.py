@@ -77,11 +77,17 @@ def split_batch(batch):
         if c.data is not None:
             return (c.with_arrays(data=c.data[:h], validity=c.validity[:h]),
                     c.with_arrays(data=c.data[h:], validity=c.validity[h:]))
-        if c.offsets is not None:
+        if c.offsets is not None:  # strings/arrays: payload stays shared
             return (c.with_arrays(offsets=c.offsets[:h + 1],
                                   validity=c.validity[:h]),
                     c.with_arrays(offsets=c.offsets[h:],
                                   validity=c.validity[h:]))
+        if c.children is not None:  # struct: halve children with the rows
+            pairs = [halves(ch) for ch in c.children]
+            return (c.with_arrays(validity=c.validity[:h],
+                                  children=[p[0] for p in pairs]),
+                    c.with_arrays(validity=c.validity[h:],
+                                  children=[p[1] for p in pairs]))
         return (c.with_arrays(validity=c.validity[:h]),
                 c.with_arrays(validity=c.validity[h:]))
 

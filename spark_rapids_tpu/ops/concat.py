@@ -17,7 +17,22 @@ import numpy as np
 from ..columnar.batch import TpuBatch, bucket_bytes, bucket_rows, row_mask
 from ..columnar.column import TpuColumnVector
 
-__all__ = ["concat_batches", "concat_device"]
+__all__ = ["concat_batches", "concat_device", "device_concat_supported"]
+
+
+def device_concat_supported(t) -> bool:
+    """Whether concat_device can handle a column of this type: planner
+    guards (sort's global merge, coalesce, broadcast) consult this so
+    unsupported plans fall back instead of raising mid-execute."""
+    from .. import datatypes as dt
+    if isinstance(t, (dt.ArrayType, dt.MapType)):
+        return False
+    if isinstance(t, dt.StructType):
+        # struct children recurse through build() but nested char/element
+        # sizing is per-top-level-column only
+        return all(f.dtype.np_dtype is not None
+                   and not dt.is_nested(f.dtype) for f in t.fields)
+    return True
 
 
 def concat_device(batches: Sequence[TpuBatch], out_capacity: int,
@@ -47,29 +62,31 @@ def concat_device(batches: Sequence[TpuBatch], out_capacity: int,
     src_row = jnp.clip(src_row, 0, max_row)
 
     cols = []
-    for ci in range(ncols):
-        first = batches[0].columns[ci]
+    def build(cols_in, ccap):
+        """Concat one (possibly nested) column across the batches via the
+        shared row mapping. Structs recurse (children align with parent
+        rows); array/map columns have no device concat yet — plans that
+        need one (sort/coalesce over arrays) fall back via planner
+        guards."""
+        first = cols_in[0]
         dtype = first.dtype
-        validity_all = jnp.concatenate(
-            [b.columns[ci].validity for b in batches])
+        validity_all = jnp.concatenate([c.validity for c in cols_in])
         validity = validity_all[src_row] & out_live
         if first.is_string_like:
-            ccap = out_char_caps[ci]
             # per-batch live char counts and bases
             nchars = jnp.stack([
-                b.columns[ci].offsets[b.row_count.astype(jnp.int32)]
-                for b in batches])
+                c.offsets[b.row_count.astype(jnp.int32)]
+                for c, b in zip(cols_in, batches)])
             cum_ch = jnp.cumsum(nchars)
             ch_base = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                                        cum_ch[:-1]])
-            char_caps_in = [b.columns[ci].chars.shape[0] for b in batches]
+            char_caps_in = [c.chars.shape[0] for c in cols_in]
             ch_cap_base = np.concatenate(
                 [[0], np.cumsum(char_caps_in)[:-1]]).astype(np.int32)
-            chars_all = jnp.concatenate(
-                [b.columns[ci].chars for b in batches]) \
+            chars_all = jnp.concatenate([c.chars for c in cols_in]) \
                 if sum(char_caps_in) else jnp.zeros((0,), jnp.uint8)
             offsets_all = jnp.concatenate(
-                [b.columns[ci].offsets[:-1] for b in batches])
+                [c.offsets[:-1] for c in cols_in])
             # output offsets: source row's offset rebased into the packed
             # char space; rows past total pin to the final byte count
             o = offsets_all[src_row] + ch_base[src_b]
@@ -91,15 +108,31 @@ def concat_device(batches: Sequence[TpuBatch], out_capacity: int,
                     jnp.uint8(0))
             else:
                 chars = jnp.zeros((ccap,), jnp.uint8)
-            cols.append(TpuColumnVector(dtype, validity=validity,
-                                        offsets=offsets, chars=chars))
-        elif first.data is None:  # NullType
-            cols.append(TpuColumnVector(dtype, validity=validity))
-        else:
-            data_all = jnp.concatenate(
-                [b.columns[ci].data for b in batches])
-            cols.append(TpuColumnVector(dtype, data=data_all[src_row],
-                                        validity=validity))
+            return TpuColumnVector(dtype, validity=validity,
+                                   offsets=offsets, chars=chars)
+        if first.offsets is not None and first.children is not None:
+            raise NotImplementedError(
+                "device concat of array/map columns not yet supported")
+        if first.children is not None:  # struct
+            if any(ch.is_string_like or ch.children is not None
+                   for ch in first.children):
+                # nested char/element sizing is per-top-level-column only
+                raise NotImplementedError(
+                    "device concat of structs with var-width or nested "
+                    "children not yet supported")
+            children = [build([c.children[k] for c in cols_in], ccap)
+                        for k in range(len(first.children))]
+            return TpuColumnVector(dtype, validity=validity,
+                                   children=children)
+        if first.data is None:  # NullType
+            return TpuColumnVector(dtype, validity=validity)
+        data_all = jnp.concatenate([c.data for c in cols_in])
+        return TpuColumnVector(dtype, data=data_all[src_row],
+                               validity=validity)
+
+    for ci in range(ncols):
+        cols.append(build([b.columns[ci] for b in batches],
+                          out_char_caps[ci]))
     return TpuBatch(cols, schema, total)
 
 

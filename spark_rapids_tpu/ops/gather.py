@@ -54,6 +54,43 @@ def compaction_indices(keep: jax.Array):
     return indices, count
 
 
+def gather_list(col: TpuColumnVector, indices: jax.Array,
+                out_live: jax.Array) -> TpuColumnVector:
+    """Reorder an array/map column by row indices: new offsets are the
+    prefix sum of gathered lengths; each output ELEMENT position finds
+    its row by searchsorted, and the element columns gather recursively
+    by the resulting source-element indices (strings work the same way
+    one level down — gather_strings is this kernel with uint8 chars).
+    The element capacity stays the child's static capacity (each source
+    element appears at most once per gathered row set; duplicates from
+    repeated indices are bounded by the caller's semantics)."""
+    n = indices.shape[0]
+    lens = col.offsets[1:] - col.offsets[:-1]
+    new_lens = lens[indices]
+    if out_live is not None:
+        new_lens = jnp.where(out_live, new_lens, 0)
+    csum = jnp.cumsum(new_lens.astype(jnp.float64))
+    new_offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), csum.astype(jnp.int32)])
+    validity = col.validity[indices]
+    if out_live is not None:
+        validity = validity & out_live
+    ecap = col.children[0].capacity
+    if ecap == 0:
+        return col.with_arrays(validity=validity,
+                               offsets=jnp.zeros((n + 1,), jnp.int32))
+    src_starts = col.offsets[:-1][indices]
+    e = jnp.arange(ecap, dtype=jnp.int32)
+    row = jnp.searchsorted(new_offsets[1:], e, side="right")
+    row = jnp.clip(row, 0, n - 1).astype(jnp.int32)
+    within = e - new_offsets[row]
+    src = jnp.clip(src_starts[row] + within, 0, ecap - 1)
+    elem_live = e < new_offsets[-1]
+    children = [gather_column(ch, src, elem_live) for ch in col.children]
+    return col.with_arrays(validity=validity, offsets=new_offsets,
+                           children=children)
+
+
 def gather_column(col: TpuColumnVector, indices: jax.Array,
                   out_live: jax.Array,
                   char_capacity: int = None) -> TpuColumnVector:
@@ -65,6 +102,12 @@ def gather_column(col: TpuColumnVector, indices: jax.Array,
             else col.chars.shape[0]
         out = gather_strings(col, indices, cap, out_live=out_live)
         return out.with_arrays(validity=validity)
+    if col.offsets is not None and col.children is not None:  # array/map
+        return gather_list(col, indices, out_live)
+    if col.children is not None:  # struct
+        children = [gather_column(ch, indices, out_live)
+                    for ch in col.children]
+        return col.with_arrays(validity=validity, children=children)
     if col.data is None:  # NullType
         return col.with_arrays(validity=validity)
     return col.with_arrays(data=col.data[indices], validity=validity)
@@ -88,7 +131,7 @@ def gather_batch(batch: TpuBatch, indices: jax.Array, count,
     col_lanes = []      # per column: (kind, lane_offset, width)
     off = 0
     for c in batch.columns:
-        if c.is_string_like or c.data is None:
+        if c.is_string_like or c.data is None or c.children is not None:
             col_lanes.append(("special", 0, 0))
             continue
         if c.data.dtype == jnp.float64:
@@ -140,6 +183,9 @@ def gather_batch(batch: TpuBatch, indices: jax.Array, count,
                     else c.chars.shape[0]
                 out = gather_strings(c, indices, cc, out_live=out_live)
                 cols.append(out.with_arrays(validity=validity))
+            elif c.children is not None:  # struct / array / map
+                out = gather_column(c, indices, out_live)
+                cols.append(out.with_arrays(validity=validity))
             else:  # NullType
                 cols.append(c.with_arrays(validity=validity))
             continue
@@ -171,23 +217,28 @@ def _compact_selection(batch: TpuBatch) -> TpuBatch:
     return compact_batch(batch, batch.live_mask())
 
 
+def _shrink_col(c: TpuColumnVector, new_cap: int) -> TpuColumnVector:
+    if c.data is not None:
+        return c.with_arrays(data=c.data[:new_cap],
+                             validity=c.validity[:new_cap])
+    if c.offsets is not None:  # strings / arrays: payload stays shared
+        return c.with_arrays(offsets=c.offsets[:new_cap + 1],
+                             validity=c.validity[:new_cap])
+    if c.children is not None:  # struct: children align with rows
+        return c.with_arrays(validity=c.validity[:new_cap],
+                             children=[_shrink_col(ch, new_cap)
+                                       for ch in c.children])
+    return c.with_arrays(validity=c.validity[:new_cap])
+
+
 def shrink_batch(batch: TpuBatch, new_cap: int) -> TpuBatch:
     """Slice a prefix-layout batch down to a smaller static capacity
     (row_count must be <= new_cap). Fixed-width lanes are static slices;
-    string chars stay shared (offsets are absolute)."""
+    string chars / array elements stay shared (offsets are absolute)."""
     assert batch.selection is None, "compact before shrinking"
     if new_cap >= batch.capacity:
         return batch
-    cols = []
-    for c in batch.columns:
-        if c.data is not None:
-            cols.append(c.with_arrays(data=c.data[:new_cap],
-                                      validity=c.validity[:new_cap]))
-        elif c.offsets is not None:
-            cols.append(c.with_arrays(offsets=c.offsets[:new_cap + 1],
-                                      validity=c.validity[:new_cap]))
-        else:
-            cols.append(c.with_arrays(validity=c.validity[:new_cap]))
+    cols = [_shrink_col(c, new_cap) for c in batch.columns]
     return TpuBatch(cols, batch.schema, batch.row_count)
 
 

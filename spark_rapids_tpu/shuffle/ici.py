@@ -165,6 +165,12 @@ class _IciWriter(ShuffleWriteHandle):
             "the per-partition write path belongs to host transports")
 
     def write_unsplit(self, batch: TpuBatch, pids) -> None:
+        for c, f in zip(batch.columns, batch.schema.fields):
+            if c.children is not None:
+                raise NotImplementedError(
+                    f"nested column {f.name} "
+                    f"({f.dtype.simple_string()}) cannot ride the ICI "
+                    "collective yet (fixed-width and string lanes only)")
         with self._t._lock:
             self._t._pending[self._sid].append((self._mid, batch, pids))
 

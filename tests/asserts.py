@@ -20,7 +20,21 @@ from spark_rapids_tpu.expr.base import EvalCtx, bind_expr
 from spark_rapids_tpu.columnar.arrow_bridge import engine_schema
 
 
+def _norm_nested(v):
+    """Recursive NaN-stable normalizer for nested (struct/array/map)
+    python values."""
+    if isinstance(v, float) and math.isnan(v):
+        return "NaN"
+    if isinstance(v, dict):
+        return {k: _norm_nested(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return tuple(_norm_nested(x) for x in v)
+    return v
+
+
 def _normalize(values, t: dt.DataType, approx_float=False):
+    if dt.is_nested(t):
+        return [_norm_nested(v) for v in values]
     out = []
     for v in values:
         if v is None:

@@ -164,6 +164,83 @@ class TimestampGen(DataGen):
             int(u) / 1e6, tz=datetime.timezone.utc) for u in us]
 
 
+class ArrayGen(DataGen):
+    """array<element> with configurable length range; sub-generator
+    drives the element values (nested-type gens — SURVEY.md §4.1)."""
+
+    def __init__(self, element_gen: DataGen, nullable=True, null_frac=0.1,
+                 max_len=6):
+        super().__init__(dt.ArrayType(element_gen.dtype), nullable,
+                         null_frac)
+        self.element_gen = element_gen
+        self.max_len = max_len
+
+    def _values(self, rng, n):
+        lens = rng.integers(0, self.max_len, n)
+        out = []
+        for l in lens:
+            out.append(self.element_gen.generate(rng, int(l)).to_pylist())
+        if n >= 2:
+            out[0] = []  # empty array special
+        return out
+
+    def generate(self, rng, n):
+        vals = self._values(rng, n)
+        nulls = self._nulls(rng, n)
+        if nulls is not None:
+            vals = [None if m else v for v, m in zip(vals, nulls)]
+        return pa.array(vals, type=dt.to_arrow(self.dtype))
+
+
+class StructGen(DataGen):
+    def __init__(self, fields, nullable=True, null_frac=0.1):
+        """fields: list of (name, DataGen)."""
+        self.field_gens = list(fields)
+        st = dt.StructType([dt.StructField(n, g.dtype, g.nullable)
+                            for n, g in self.field_gens])
+        super().__init__(st, nullable, null_frac)
+
+    def generate(self, rng, n):
+        children = {name: g.generate(rng, n).to_pylist()
+                    for name, g in self.field_gens}
+        vals = [{name: children[name][i] for name, _ in self.field_gens}
+                for i in range(n)]
+        nulls = self._nulls(rng, n)
+        if nulls is not None:
+            vals = [None if m else v for v, m in zip(vals, nulls)]
+        return pa.array(vals, type=dt.to_arrow(self.dtype))
+
+
+class MapGen(DataGen):
+    def __init__(self, key_gen: DataGen, value_gen: DataGen,
+                 nullable=True, null_frac=0.1, max_len=4):
+        super().__init__(dt.MapType(key_gen.dtype, value_gen.dtype),
+                         nullable, null_frac)
+        self.key_gen = key_gen
+        self.value_gen = value_gen
+        self.max_len = max_len
+
+    def generate(self, rng, n):
+        lens = rng.integers(0, self.max_len, n)
+        vals = []
+        for l in lens:
+            l = int(l)
+            ks = self.key_gen.generate(rng, l).to_pylist()
+            vs = self.value_gen.generate(rng, l).to_pylist()
+            # map keys must be unique and non-null
+            seen, items = set(), []
+            for k, v in zip(ks, vs):
+                if k is None or k in seen:
+                    continue
+                seen.add(k)
+                items.append((k, v))
+            vals.append(items)
+        nulls = self._nulls(rng, n)
+        if nulls is not None:
+            vals = [None if m else v for v, m in zip(vals, nulls)]
+        return pa.array(vals, type=dt.to_arrow(self.dtype))
+
+
 # canonical generator sets, mirroring the reference's groupings
 numeric_gens = [ByteGen(), ShortGen(), IntegerGen(), LongGen(),
                 FloatGen(dt.FLOAT32), FloatGen(dt.FLOAT64)]

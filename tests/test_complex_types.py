@@ -1,0 +1,194 @@
+"""Complex-type tests: struct/array/map bridge round-trips, access
+expressions, explode, and planner fallbacks for nested-unsupported ops
+(reference: struct_test.py / array_test.py / map_test.py /
+generate_expr_test.py — SURVEY.md §4.1; capability-built, mount empty)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import datatypes as dt
+from spark_rapids_tpu.columnar.arrow_bridge import (arrow_to_device,
+                                                    device_to_arrow)
+from spark_rapids_tpu.exec import HostBatchSourceExec, TpuGenerateExec, \
+    TpuFilterExec, TpuProjectExec
+from spark_rapids_tpu.expr import (Alias, CreateNamedStruct, GetArrayItem,
+                                   GetStructField, GreaterThan, Literal,
+                                   MapKeys, MapValues, Size,
+                                   UnresolvedColumn as col)
+
+from asserts import assert_tpu_and_cpu_plan_equal
+from data_gen import (ArrayGen, DoubleGen, IntegerGen, LongGen, MapGen,
+                      StringGen, StructGen, gen_table)
+
+
+def source(gens, n=200, seed=77, names=None, n_batches=1):
+    return HostBatchSourceExec(
+        [gen_table(gens, n, seed + i, names) for i in range(n_batches)])
+
+
+NESTED_GENS = [
+    StructGen([("a", IntegerGen()), ("b", StringGen(max_len=6))]),
+    ArrayGen(LongGen()),
+    ArrayGen(StringGen(max_len=5)),
+    ArrayGen(ArrayGen(IntegerGen(), max_len=3)),
+    MapGen(StringGen(max_len=4, nullable=False), LongGen()),
+    StructGen([("in", StructGen([("x", DoubleGen())]))]),
+]
+
+
+from asserts import _norm_nested as _norm
+
+
+@pytest.mark.parametrize("gen", NESTED_GENS,
+                         ids=lambda g: g.dtype.simple_string()[:40])
+def test_nested_roundtrip(gen):
+    rb = gen_table([gen, IntegerGen()], 300, seed=5)
+    out = device_to_arrow(arrow_to_device(rb))
+    assert _norm(out.to_pylist()) == _norm(rb.to_pylist())
+
+
+@pytest.mark.parametrize("gen", NESTED_GENS,
+                         ids=lambda g: g.dtype.simple_string()[:40])
+def test_nested_filter_compaction(gen):
+    """Filter over a batch with nested columns: the compaction gather
+    must reorder struct children / array elements correctly."""
+    plan = TpuFilterExec(
+        GreaterThan(col("c1"), Literal(0, dt.INT32)),
+        source([gen, IntegerGen(null_frac=0.0)], n=250))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_get_struct_field():
+    g = StructGen([("a", IntegerGen()), ("b", StringGen(max_len=6)),
+                   ("c", DoubleGen())])
+    plan = TpuProjectExec(
+        [Alias(GetStructField(col("c0"), "a"), "a"),
+         Alias(GetStructField(col("c0"), "b"), "b"),
+         Alias(GetStructField(col("c0"), "c"), "c")],
+        source([g]))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_get_struct_field_nested():
+    g = StructGen([("in", StructGen([("x", DoubleGen())]))])
+    plan = TpuProjectExec(
+        [Alias(GetStructField(GetStructField(col("c0"), "in"), "x"), "x")],
+        source([g]))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+@pytest.mark.parametrize("elem_gen", [LongGen(), StringGen(max_len=5),
+                                      DoubleGen()],
+                         ids=["long", "string", "double"])
+def test_get_array_item(elem_gen):
+    plan = TpuProjectExec(
+        [Alias(GetArrayItem(col("c0"), Literal(0, dt.INT32)), "first"),
+         Alias(GetArrayItem(col("c0"), Literal(2, dt.INT32)), "third"),
+         Alias(GetArrayItem(col("c0"), col("c1")), "dyn")],
+        source([ArrayGen(elem_gen), IntegerGen(min_val=-1, max_val=5,
+                                               null_frac=0.1)]))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_create_named_struct():
+    plan = TpuProjectExec(
+        [Alias(CreateNamedStruct(["x", "y"], [col("c0"), col("c1")]),
+               "s")],
+        source([IntegerGen(), StringGen(max_len=5)]))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_size_and_map_projections():
+    plan = TpuProjectExec(
+        [Alias(Size(col("c0")), "asz"), Alias(Size(col("c1")), "msz"),
+         Alias(MapKeys(col("c1")), "ks"),
+         Alias(MapValues(col("c1")), "vs")],
+        source([ArrayGen(LongGen()),
+                MapGen(StringGen(max_len=4, nullable=False), LongGen())]))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+# --- explode ---------------------------------------------------------------
+
+@pytest.mark.parametrize("outer", [False, True], ids=["inner", "outer"])
+@pytest.mark.parametrize("position", [False, True], ids=["explode",
+                                                         "posexplode"])
+def test_explode_array(outer, position):
+    plan = TpuGenerateExec(col("c0"),
+                           source([ArrayGen(LongGen()), IntegerGen(),
+                                   StringGen(max_len=6)]),
+                           outer=outer, position=position)
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_explode_string_elements():
+    plan = TpuGenerateExec(col("c0"),
+                           source([ArrayGen(StringGen(max_len=8)),
+                                   LongGen()]))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_explode_map():
+    plan = TpuGenerateExec(
+        col("c0"),
+        source([MapGen(StringGen(max_len=4, nullable=False), LongGen()),
+                IntegerGen()]),
+        outer=True)
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_explode_multi_batch():
+    plan = TpuGenerateExec(col("c0"),
+                           source([ArrayGen(IntegerGen()), LongGen()],
+                                  n=120, n_batches=3))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_explode_then_filter_then_explode():
+    """Nested pipeline: explode -> filter -> project (array access)."""
+    src = source([ArrayGen(LongGen(), max_len=5), IntegerGen()])
+    g = TpuGenerateExec(col("c0"), src)
+    f = TpuFilterExec(GreaterThan(col("col"), Literal(0, dt.INT64)), g)
+    plan = TpuProjectExec([Alias(col("col"), "v"), Alias(col("c1"), "k")],
+                          f)
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+# --- planner fallbacks for nested-unsupported ops --------------------------
+
+def test_nested_sort_falls_back():
+    from spark_rapids_tpu.exec.sort import SortOrder, TpuSortExec
+    from spark_rapids_tpu.planner import overrides
+    plan = TpuSortExec([SortOrder(col("c0"))],
+                       source([StructGen([("a", IntegerGen())]),
+                               LongGen()], n=60))
+    pp = overrides(plan)
+    assert "SortExec" in pp.fallback_nodes()
+    from spark_rapids_tpu.exec.base import ExecCtx, collect_arrow_cpu
+    got = pp.collect()
+    want = collect_arrow_cpu(plan, ExecCtx())
+    assert got.to_pylist() == want.to_pylist()
+
+
+def test_nested_groupby_falls_back():
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.expr.aggregates import Count
+    from spark_rapids_tpu.planner import overrides
+    plan = TpuHashAggregateExec(
+        [col("c0")], [Alias(Count(), "n")],
+        source([StructGen([("a", IntegerGen(min_val=0, max_val=3))],
+                          null_frac=0.0), LongGen()], n=60))
+    pp = overrides(plan)
+    assert "HashAggregateExec" in pp.fallback_nodes()
+
+
+def test_explode_nested_passthrough_falls_back():
+    from spark_rapids_tpu.planner import overrides
+    plan = TpuGenerateExec(
+        col("c0"),
+        source([ArrayGen(LongGen()), ArrayGen(IntegerGen())], n=60))
+    pp = overrides(plan)
+    assert "GenerateExec" in pp.fallback_nodes()
+    from spark_rapids_tpu.exec.base import ExecCtx, collect_arrow_cpu
+    got = pp.collect()
+    want = collect_arrow_cpu(plan, ExecCtx())
+    assert got.to_pylist() == want.to_pylist()
