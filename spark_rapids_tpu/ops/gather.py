@@ -20,14 +20,19 @@ __all__ = ["compaction_indices", "exclusive_cumsum", "invert_permutation",
            "ensure_compacted", "shrink_batch"]
 
 
-def exclusive_cumsum(x: jax.Array) -> jax.Array:
-    """Exclusive int prefix sum, computed in f64.
+def inclusive_int_cumsum(x: jax.Array) -> jax.Array:
+    """Inclusive int32 prefix sum via an explicit log-depth
+    associative_scan. jnp.cumsum on int lowers to a serial loop on TPU
+    (~100ms for 2M elements) and f64 cumsum is only f32 there (exact to
+    just 2^24 — too small for char/element offsets); the scan network is
+    parallel AND exact to 2^31."""
+    return jax.lax.associative_scan(jnp.add, x.astype(jnp.int32))
 
-    XLA-on-TPU lowers integer cumsum to a serial loop (~100ms for 2M
-    elements) but float cumsum to a parallel prefix (~0.3ms); f64 is exact
-    for sums below 2^53, far past any batch capacity."""
-    s = jnp.cumsum(x.astype(jnp.float64))
-    return (s - x).astype(jnp.int32)
+
+def exclusive_cumsum(x: jax.Array) -> jax.Array:
+    """Exclusive int32 prefix sum (see inclusive_int_cumsum)."""
+    x = x.astype(jnp.int32)
+    return inclusive_int_cumsum(x) - x
 
 
 def invert_permutation(perm: jax.Array, values: jax.Array) -> jax.Array:
@@ -69,9 +74,8 @@ def gather_list(col: TpuColumnVector, indices: jax.Array,
     new_lens = lens[indices]
     if out_live is not None:
         new_lens = jnp.where(out_live, new_lens, 0)
-    csum = jnp.cumsum(new_lens.astype(jnp.float64))
     new_offsets = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), csum.astype(jnp.int32)])
+        [jnp.zeros((1,), jnp.int32), inclusive_int_cumsum(new_lens)])
     validity = col.validity[indices]
     if out_live is not None:
         validity = validity & out_live

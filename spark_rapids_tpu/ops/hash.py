@@ -286,3 +286,313 @@ def pmod(hash_vals, n: int, xp=jnp):
     """Spark's positive modulo for partition ids."""
     r = hash_vals % xp.int32(n)
     return xp.where(r < 0, r + n, r)
+
+
+# --- xxhash64 (Spark XxHash64, seed 42) ------------------------------------
+# 64-bit XXH64 exactly as Spark's catalyst XXH64.java defines it: fixed
+# types hash their int/long form, strings hash their bytes (4-lane
+# accumulator path for >= 32 bytes). All arithmetic wraps in uint64 (the
+# TPU X64 rewriter emulates u64 as 32-bit pairs).
+
+_XP1 = 0x9E3779B185EBCA87
+_XP2 = 0xC2B2AE3D27D4EB4F
+_XP3 = 0x165667B19E3779F9
+_XP4 = 0x85EBCA77C2B2AE63
+_XP5 = 0x27D4EB2F165667C5
+XXSEED = 42
+
+
+def _u64(x, xp):
+    return xp.uint64(x)
+
+
+def _rotl64(x, r, xp):
+    return (x << _u64(r, xp)) | (x >> _u64(64 - r, xp))
+
+
+def _xx_avalanche(h, xp):
+    h = h ^ (h >> _u64(33, xp))
+    h = h * _u64(_XP2, xp)
+    h = h ^ (h >> _u64(29, xp))
+    h = h * _u64(_XP3, xp)
+    return h ^ (h >> _u64(32, xp))
+
+
+def xxhash64_long(v_u64, seed_u64, xp):
+    h = seed_u64 + _u64(_XP5, xp) + _u64(8, xp)
+    h = h ^ (_rotl64(v_u64 * _u64(_XP2, xp), 31, xp) * _u64(_XP1, xp))
+    h = _rotl64(h, 27, xp) * _u64(_XP1, xp) + _u64(_XP4, xp)
+    return _xx_avalanche(h, xp)
+
+
+def xxhash64_int(v_u32_as_u64, seed_u64, xp):
+    """Spark hashInt: the 4-byte value zero-extended into the u64 mix."""
+    h = seed_u64 + _u64(_XP5, xp) + _u64(4, xp)
+    h = h ^ (v_u32_as_u64 * _u64(_XP1, xp))
+    h = _rotl64(h, 23, xp) * _u64(_XP2, xp) + _u64(_XP3, xp)
+    return _xx_avalanche(h, xp)
+
+
+def _xx_fixed(values, t: dt.DataType, seed, xp=jnp):
+    """One fixed-width column's dense DEVICE values -> xxhash64
+    contribution (the host oracle goes through _xx_scalar_np)."""
+    def bits64(a):
+        return jax.lax.bitcast_convert_type(a, jnp.uint64)
+
+    def bits32(a):
+        return jax.lax.bitcast_convert_type(a, jnp.uint32) \
+            .astype(jnp.uint64)
+    if isinstance(t, dt.BooleanType):
+        return xxhash64_int(values.astype(xp.uint64), seed, xp)
+    if isinstance(t, (dt.ByteType, dt.ShortType, dt.IntegerType,
+                      dt.DateType)):
+        return xxhash64_int(bits32(values.astype(xp.int32)), seed, xp)
+    if isinstance(t, (dt.LongType, dt.TimestampType, dt.DecimalType)):
+        return xxhash64_long(bits64(values.astype(xp.int64)), seed, xp)
+    if isinstance(t, dt.FloatType):
+        v = xp.where(values == 0, xp.zeros_like(values), values)
+        v = xp.where(xp.isnan(v), xp.full_like(v, np.nan), v)
+        return xxhash64_int(bits32(v), seed, xp)
+    if isinstance(t, dt.DoubleType):
+        v = xp.where(values == 0, xp.zeros_like(values), values)
+        v = xp.where(xp.isnan(v), xp.full_like(v, np.nan), v)
+        return xxhash64_long(bits64(v), seed, xp)
+    raise NotImplementedError(f"xxhash64 of {t.simple_string()}")
+
+
+def xxhash64_bytes_device_seeded(offsets, chars, seed):
+    """Per-row XXH64 over variable-length byte strings, per-row seeds.
+    Rows >= 32 bytes use the 4-accumulator stripe path, shorter rows the
+    small path — both computed with masked loops over static shapes."""
+    n = offsets.shape[0] - 1
+    starts = offsets[:-1]
+    lens = (offsets[1:] - starts).astype(jnp.uint64)
+    limit = max(chars.shape[0] - 1, 0)
+
+    def get_byte(pos):
+        idx = jnp.clip(pos, 0, limit)
+        return (chars[idx] if chars.shape[0] else
+                jnp.zeros_like(idx, jnp.uint8)).astype(jnp.uint64)
+
+    def word64(base):
+        w = jnp.zeros((n,), jnp.uint64)
+        for i in range(8):
+            w = w | (get_byte(base + i) << jnp.uint64(8 * i))
+        return w
+
+    def word32(base):
+        w = jnp.zeros((n,), jnp.uint64)
+        for i in range(4):
+            w = w | (get_byte(base + i) << jnp.uint64(8 * i))
+        return w
+
+    u = lambda c: jnp.uint64(c)
+    nstripes = (lens >> u(5)).astype(jnp.int32)
+    max_stripes = jnp.max(nstripes, initial=0)
+
+    def stripe_body(state):
+        s, a1, a2, a3, a4 = state
+        active = s < nstripes
+        base = starts + s * 32
+
+        def rnd(acc, off):
+            acc2 = acc + word64(base + off) * u(_XP2)
+            return _rotl64(acc2, 31, jnp) * u(_XP1)
+        b1, b2, b3, b4 = (rnd(a1, 0), rnd(a2, 8), rnd(a3, 16),
+                          rnd(a4, 24))
+        return (s + 1, jnp.where(active, b1, a1),
+                jnp.where(active, b2, a2), jnp.where(active, b3, a3),
+                jnp.where(active, b4, a4))
+
+    sd = seed * jnp.ones((n,), jnp.uint64)
+    a1 = sd + u(_XP1) + u(_XP2)
+    a2 = sd + u(_XP2)
+    a3 = sd
+    a4 = sd - u(_XP1)
+    _, a1, a2, a3, a4 = jax.lax.while_loop(
+        lambda st: st[0] < max_stripes, stripe_body,
+        (jnp.int32(0), a1, a2, a3, a4))
+
+    merged = (_rotl64(a1, 1, jnp) + _rotl64(a2, 7, jnp)
+              + _rotl64(a3, 12, jnp) + _rotl64(a4, 18, jnp))
+
+    def merge_round(h, acc):
+        k = _rotl64(acc * u(_XP2), 31, jnp) * u(_XP1)
+        return (h ^ k) * u(_XP1) + u(_XP4)
+    for acc in (a1, a2, a3, a4):
+        merged = merge_round(merged, acc)
+
+    h = jnp.where(lens >= u(32), merged, sd + u(_XP5))
+    h = h + lens
+    # remaining (< 32) bytes: up to 3x 8-byte, one 4-byte, up to 3 bytes
+    pos = (nstripes.astype(jnp.uint64) << u(5))
+    for _ in range(3):
+        active = pos + u(8) <= lens
+        k = word64(starts + pos.astype(jnp.int32))
+        h2 = _rotl64(h ^ (_rotl64(k * u(_XP2), 31, jnp) * u(_XP1)),
+                     27, jnp) * u(_XP1) + u(_XP4)
+        h = jnp.where(active, h2, h)
+        pos = jnp.where(active, pos + u(8), pos)
+    active = pos + u(4) <= lens
+    k = word32(starts + pos.astype(jnp.int32))
+    h2 = _rotl64(h ^ (k * u(_XP1)), 23, jnp) * u(_XP2) + u(_XP3)
+    h = jnp.where(active, h2, h)
+    pos = jnp.where(active, pos + u(4), pos)
+    for _ in range(3):
+        active = pos < lens
+        b = get_byte(starts + pos.astype(jnp.int32))
+        h2 = _rotl64(h ^ (b * u(_XP5)), 11, jnp) * u(_XP1)
+        h = jnp.where(active, h2, h)
+        pos = jnp.where(active, pos + u(1), pos)
+    return _xx_avalanche(h, jnp)
+
+
+def xxhash64_column_device(col: TpuColumnVector, seed) -> jax.Array:
+    """One column's contribution; null rows keep the incoming seed."""
+    if col.is_string_like:
+        h = xxhash64_bytes_device_seeded(col.offsets, col.chars, seed)
+    elif col.data is None:
+        return seed
+    else:
+        h = _xx_fixed(col.data, col.dtype, seed, jnp)
+    return jnp.where(col.validity, h, seed)
+
+
+def xxhash64_columns_device(cols: Sequence[TpuColumnVector]) -> jax.Array:
+    n = cols[0].capacity if cols else 0
+    h = jnp.full((n,), XXSEED, jnp.uint64)
+    for c in cols:
+        h = xxhash64_column_device(c, h)
+    return jax.lax.bitcast_convert_type(h, jnp.int64)
+
+
+def _xx_bytes_np(b: bytes, seed: int) -> int:
+    M = (1 << 64) - 1
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (64 - r))) & M
+    ln = len(b)
+    if ln >= 32:
+        a1 = (seed + _XP1 + _XP2) & M
+        a2 = (seed + _XP2) & M
+        a3 = seed & M
+        a4 = (seed - _XP1) & M
+        s = 0
+        while s + 32 <= ln:
+            for i, acc in enumerate((a1, a2, a3, a4)):
+                k = int.from_bytes(b[s + 8 * i: s + 8 * i + 8], "little")
+                acc = (acc + k * _XP2) & M
+                acc = (rotl(acc, 31) * _XP1) & M
+                if i == 0:
+                    a1 = acc
+                elif i == 1:
+                    a2 = acc
+                elif i == 2:
+                    a3 = acc
+                else:
+                    a4 = acc
+            s += 32
+        h = (rotl(a1, 1) + rotl(a2, 7) + rotl(a3, 12) + rotl(a4, 18)) & M
+        for acc in (a1, a2, a3, a4):
+            k = (rotl((acc * _XP2) & M, 31) * _XP1) & M
+            h = (((h ^ k) * _XP1) + _XP4) & M
+        pos = (ln // 32) * 32
+    else:
+        h = (seed + _XP5) & M
+        pos = 0
+    h = (h + ln) & M
+    while pos + 8 <= ln:
+        k = int.from_bytes(b[pos: pos + 8], "little")
+        h = (rotl(h ^ ((rotl((k * _XP2) & M, 31) * _XP1) & M), 27)
+             * _XP1 + _XP4) & M
+        pos += 8
+    if pos + 4 <= ln:
+        k = int.from_bytes(b[pos: pos + 4], "little")
+        h = ((rotl(h ^ ((k * _XP1) & M), 23) * _XP2) + _XP3) & M
+        pos += 4
+    while pos < ln:
+        h = (rotl(h ^ ((b[pos] * _XP5) & M), 11) * _XP1) & M
+        pos += 1
+    h ^= h >> 33
+    h = (h * _XP2) & M
+    h ^= h >> 29
+    h = (h * _XP3) & M
+    h ^= h >> 32
+    return h
+
+
+def _xx_scalar_np(v, t: dt.DataType, seed: int) -> int:
+    import datetime as _dtm
+    import decimal as _dec
+    M = (1 << 64) - 1
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (64 - r))) & M
+
+    def avalanche(h):
+        h ^= h >> 33
+        h = (h * _XP2) & M
+        h ^= h >> 29
+        h = (h * _XP3) & M
+        return h ^ (h >> 32)
+
+    def hash_int(i32):
+        h = (seed + _XP5 + 4) & M
+        h = h ^ ((i32 & 0xFFFFFFFF) * _XP1) & M
+        h = ((rotl(h, 23) * _XP2) + _XP3) & M
+        return avalanche(h)
+
+    def hash_long(l64):
+        l64 &= M
+        h = (seed + _XP5 + 8) & M
+        h = h ^ ((rotl((l64 * _XP2) & M, 31) * _XP1) & M)
+        h = ((rotl(h, 27) * _XP1) + _XP4) & M
+        return avalanche(h)
+
+    if isinstance(t, dt.BooleanType):
+        return hash_int(1 if v else 0)
+    if isinstance(t, (dt.ByteType, dt.ShortType, dt.IntegerType)):
+        return hash_int(int(v) & 0xFFFFFFFF)
+    if isinstance(t, dt.DateType):
+        days = (v - _dtm.date(1970, 1, 1)).days \
+            if isinstance(v, _dtm.date) else int(v)
+        return hash_int(days & 0xFFFFFFFF)
+    if isinstance(t, (dt.LongType, dt.TimestampType, dt.DecimalType)):
+        if isinstance(t, dt.TimestampType) and isinstance(v, _dtm.datetime):
+            if v.tzinfo is None:
+                v = v.replace(tzinfo=_dtm.timezone.utc)
+            epoch = _dtm.datetime(1970, 1, 1, tzinfo=_dtm.timezone.utc)
+            v = (v - epoch) // _dtm.timedelta(microseconds=1)
+        elif isinstance(t, dt.DecimalType):
+            v = int(_dec.Decimal(v).scaleb(t.scale))
+        return hash_long(int(v))
+    if isinstance(t, dt.FloatType):
+        f = np.float32(0.0) if v == 0 else np.float32(v)
+        if np.isnan(f):
+            f = np.float32(np.nan)
+        return hash_int(int(f.view(np.uint32)))
+    if isinstance(t, dt.DoubleType):
+        f = np.float64(0.0) if v == 0 else np.float64(v)
+        if np.isnan(f):
+            f = np.float64(np.nan)
+        return hash_long(int(f.view(np.uint64)))
+    raise NotImplementedError(t.simple_string())
+
+
+def xxhash64_columns_numpy(arrays, types: Sequence[dt.DataType],
+                           n: int) -> np.ndarray:
+    """Host oracle: running-seed xxhash64 over pyarrow arrays."""
+    h = [XXSEED] * n
+    for arr, t in zip(arrays, types):
+        vals = arr.to_pylist()
+        for i in range(n):
+            v = vals[i]
+            if v is None:
+                continue
+            if isinstance(t, (dt.StringType, dt.BinaryType)):
+                b = v.encode() if isinstance(v, str) else bytes(v)
+                h[i] = _xx_bytes_np(b, h[i])
+            else:
+                h[i] = _xx_scalar_np(v, t, h[i])
+    out = np.array([x & ((1 << 64) - 1) for x in h], np.uint64)
+    return out.view(np.int64)

@@ -76,7 +76,8 @@ def union_group_ids(left_keys: Sequence[TpuColumnVector],
     for lane in sorted_lanes:
         boundary = boundary | jnp.concatenate(
             [jnp.zeros((1,), jnp.bool_), lane[1:] != lane[:-1]])
-    seg = jnp.cumsum(boundary.astype(jnp.float64)).astype(jnp.int32) - 1
+    from .gather import inclusive_int_cumsum
+    seg = inclusive_int_cumsum(boundary) - 1
     from .gather import invert_permutation
     g = invert_permutation(perm, seg)
     return g[:nl], g[nl:]

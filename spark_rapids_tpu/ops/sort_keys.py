@@ -132,11 +132,10 @@ def string_order_ranks_multi(cols: Sequence[TpuColumnVector],
         boundary = jnp.concatenate([
             jnp.ones((1,), jnp.bool_),
             (srank[1:] != srank[:-1]) | (skey[1:] != skey[:-1])])
-        # f64 prefix + sort-based inversion: int cumsum and scatters
-        # both serialize on TPU
-        new_rank_sorted = (jnp.cumsum(boundary.astype(jnp.float64))
-                           .astype(jnp.int32) - 1)
-        from .gather import invert_permutation
+        # log-depth int prefix + sort-based inversion: serial cumsum and
+        # scatters both lose on TPU
+        from .gather import inclusive_int_cumsum, invert_permutation
+        new_rank_sorted = inclusive_int_cumsum(boundary) - 1
         new_rank = invert_permutation(sidx, new_rank_sorted)
         distinct = jnp.max(jnp.where(live, new_rank, -1), initial=-1) + 1
         return chunk + 1, new_rank, distinct
@@ -279,7 +278,8 @@ def segment_ids_for_keys(key_cols: Sequence[TpuColumnVector],
     for lane in sorted_lanes:
         boundary = boundary | jnp.concatenate(
             [jnp.zeros((1,), jnp.bool_), lane[1:] != lane[:-1]])
-    seg = jnp.cumsum(boundary.astype(jnp.float64)).astype(jnp.int32) - 1
+    from .gather import inclusive_int_cumsum
+    seg = inclusive_int_cumsum(boundary) - 1
     live_sorted = live[perm]
     num_groups = jnp.max(jnp.where(live_sorted, seg + 1, 0), initial=0)
     return perm, seg, num_groups

@@ -84,8 +84,9 @@ def gather_strings(col: TpuColumnVector, indices: jax.Array,
     """Reorder a string column by row indices, all gathers (no scatter —
     arbitrary scatters serialize on TPU, gathers don't).
 
-    Output offsets = cumulative gathered lengths (f64 prefix sum: integer
-    cumsum also serializes on TPU). For each output char position, the
+    Output offsets = cumulative gathered lengths (log-depth int32
+    associative_scan: serial int cumsum and 24-bit-exact f64-as-f32
+    cumsum both lose on TPU). For each output char position, the
     owning row comes from one searchsorted over the offsets, then the byte
     is a single gather from the source. out_live (if given) zeroes the
     lengths of dead output rows so padding can't inflate the offsets."""
@@ -94,9 +95,9 @@ def gather_strings(col: TpuColumnVector, indices: jax.Array,
     new_lens = lens[indices]
     if out_live is not None:
         new_lens = jnp.where(out_live, new_lens, 0)
-    csum = jnp.cumsum(new_lens.astype(jnp.float64))
+    from .gather import inclusive_int_cumsum
     new_offsets = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), csum.astype(jnp.int32)])
+        [jnp.zeros((1,), jnp.int32), inclusive_int_cumsum(new_lens)])
     src_starts = col.offsets[:-1][indices]
 
     c = jnp.arange(char_capacity, dtype=jnp.int32)

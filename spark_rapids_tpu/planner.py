@@ -109,6 +109,7 @@ class PhysicalPlan:
         self.root_on_device = root_on_device
         self.meta = meta
         self.conf = conf
+        self.last_ctx: Optional[ExecCtx] = None  # metrics of last collect
 
     @property
     def output_schema(self):
@@ -136,14 +137,54 @@ class PhysicalPlan:
 
     def collect(self, ctx: Optional[ExecCtx] = None) -> pa.Table:
         ctx = ctx or ExecCtx(self.conf)
+        self.last_ctx = ctx
+        from .config import PROFILE_PATH
         from .columnar.arrow_bridge import arrow_schema, device_to_arrow
+        import contextlib
         schema = arrow_schema(self.root.output_schema)
-        if self.root_on_device:
-            with ctx.mm.task_slot():  # GpuSemaphore admission control
-                rbs = [device_to_arrow(b) for b in self.root.execute(ctx)]
+        prof_dir = self.conf.get(PROFILE_PATH)
+        if prof_dir:
+            import jax
+            tracer = jax.profiler.trace(prof_dir)
         else:
-            rbs = list(self.root.execute_cpu(ctx))
+            tracer = contextlib.nullcontext()
+        with tracer:
+            if self.root_on_device:
+                with ctx.mm.task_slot():  # GpuSemaphore admission control
+                    rbs = [device_to_arrow(b)
+                           for b in self.root.execute(ctx)]
+            else:
+                rbs = list(self.root.execute_cpu(ctx))
         return pa.Table.from_batches(rbs, schema=schema)
+
+    def metrics_report(self, ctx: Optional[ExecCtx] = None) -> str:
+        """Explain-style tree annotated with the metrics the last
+        collect() (or the given ctx) accumulated per operator — opTime /
+        spillTime / row counts, so regressions are attributable to a
+        node (SURVEY.md §5.1/§5.5; run with metrics.level=DEBUG for
+        device-time opTime)."""
+        ctx = ctx or self.last_ctx
+        metrics = ctx.metrics if ctx is not None else {}
+
+        def fmt(v):
+            if isinstance(v, float):
+                return f"{v * 1e3:.2f}ms"
+            return str(v)
+
+        lines = []
+
+        def rec(node: TpuExec, depth: int):
+            m = metrics.get(node.node_label(), {})
+            ann = ", ".join(f"{k}: {fmt(mm.value)}"
+                            for k, mm in sorted(m.items()))
+            pad = "  " * depth
+            lines.append(f"{pad}{node.describe()}"
+                         + (f"  [{ann}]" if ann else ""))
+            for c in node.children:
+                rec(c, depth + 1)
+
+        rec(self.root, 0)
+        return "\n".join(lines)
 
 
 class TpuOverrides:

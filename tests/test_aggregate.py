@@ -271,3 +271,60 @@ def test_decimal_sum_overflow_semantics():
         assert False, "expected ExprError"
     except ExprError:
         pass
+
+
+# --- collect_list / collect_set (single-pass, array results) ---------------
+
+def _collect_plan(agg_cls, val_gen, n=200):
+    from spark_rapids_tpu.expr.aggregates import CollectList, CollectSet
+    from data_gen import gen_table
+    src = HostBatchSourceExec(
+        [gen_table([IntegerGen(min_val=0, max_val=6, null_frac=0.1),
+                    val_gen], n, seed=31 + i) for i in range(2)])
+    return TpuHashAggregateExec(
+        [col("c0")], [Alias(agg_cls(col("c1")), "vals")], src)
+
+
+@pytest.mark.parametrize("val_gen", [LongGen(null_frac=0.2),
+                                     StringGen(max_len=5, null_frac=0.2),
+                                     DoubleGen(null_frac=0.2)],
+                         ids=["long", "string", "double"])
+def test_collect_list(val_gen):
+    from spark_rapids_tpu.expr.aggregates import CollectList
+    assert_tpu_and_cpu_plan_equal(_collect_plan(CollectList, val_gen),
+                                  ignore_order=True)
+
+
+@pytest.mark.parametrize("val_gen", [LongGen(null_frac=0.2),
+                                     StringGen(max_len=4, null_frac=0.2),
+                                     DoubleGen(null_frac=0.2)],
+                         ids=["long", "string", "double"])
+def test_collect_set(val_gen):
+    from spark_rapids_tpu.expr.aggregates import CollectSet
+    assert_tpu_and_cpu_plan_equal(_collect_plan(CollectSet, val_gen),
+                                  ignore_order=True)
+
+
+def test_collect_mixed_with_other_aggs():
+    from spark_rapids_tpu.expr.aggregates import CollectList
+    from data_gen import gen_table
+    src = HostBatchSourceExec(
+        [gen_table([IntegerGen(min_val=0, max_val=4, null_frac=0.0),
+                    LongGen(null_frac=0.1)], 150, seed=3)])
+    plan = TpuHashAggregateExec(
+        [col("c0")],
+        [Alias(CollectList(col("c1")), "vals"),
+         Alias(Sum(col("c1")), "s"), Alias(Count(), "n")], src)
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+def test_collect_global_no_keys():
+    from spark_rapids_tpu.expr.aggregates import CollectList, CollectSet
+    from data_gen import gen_table
+    src = HostBatchSourceExec(
+        [gen_table([IntegerGen(min_val=0, max_val=9, null_frac=0.3)], 80,
+                   seed=8)])
+    for cls in (CollectList, CollectSet):
+        plan = TpuHashAggregateExec([], [Alias(cls(col("c0")), "vals")],
+                                    src)
+        assert_tpu_and_cpu_plan_equal(plan)

@@ -134,3 +134,40 @@ def test_string_plan_through_planner():
         [gen_table([StringGen(max_len=8), IntegerGen()], 128, 3)])
     agg = TpuHashAggregateExec([col("c0")], [Alias(Count(), "n")], src)
     assert_planner_matches_cpu(agg)
+
+
+def test_metrics_report():
+    """metrics_report renders per-op metrics from the last collect
+    (VERDICT r2 item 10): DEBUG level gives device-time opTime."""
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.expr import Alias
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.planner import overrides
+    conf = RapidsConf({"spark.rapids.sql.metrics.level": "DEBUG"})
+    src = HostBatchSourceExec(
+        [gen_table([IntegerGen(min_val=0, max_val=5), LongGen()], 300, 5)])
+    plan = TpuHashAggregateExec([col("c0")],
+                                [Alias(Sum(col("c1")), "s")], src)
+    pp = overrides(plan, conf)
+    pp.collect()
+    report = pp.metrics_report()
+    assert "HashAggregateExec" in report
+    assert "opTime" in report
+    # numOutputRows flows from the source
+    assert "numOutputRows" in report
+
+
+def test_profiler_trace_written(tmp_path):
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.planner import overrides
+    import os
+    conf = RapidsConf({"spark.rapids.profile.path": str(tmp_path)})
+    plan = TpuProjectExec(
+        [Alias(col("c0"), "x")],
+        HostBatchSourceExec([gen_table([IntegerGen()], 100, 7)]))
+    pp = overrides(plan, conf)
+    pp.collect()
+    # jax profiler writes a plugins/profile/<ts>/ tree
+    found = [p for p, _, files in os.walk(tmp_path) for f in files]
+    assert found, "no profiler output written"
