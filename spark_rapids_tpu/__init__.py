@@ -21,3 +21,5 @@ from .config import RapidsConf
 from .datatypes import Schema
 
 __all__ = ["RapidsConf", "Schema", "__version__"]
+
+from .session import TpuSession, DataFrame  # noqa: E402  (product surface)

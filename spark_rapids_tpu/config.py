@@ -123,9 +123,6 @@ CONCURRENT_TPU_TASKS = register(
 ALLOC_FRACTION = register(
     "spark.rapids.memory.gpu.allocFraction", 0.85,
     "Fraction of device HBM the buffer pool may use.")
-POOL_MODE = register(
-    "spark.rapids.memory.gpu.pool", "ARENA",
-    "Device pool mode: NONE or ARENA (preallocated HBM arena).")
 HOST_SPILL_LIMIT = register(
     "spark.rapids.memory.host.spillStorageSize", 8 << 30,
     "Bytes of host memory usable for spilled device buffers before "
@@ -222,9 +219,6 @@ TEST_RETRY_OOM_INJECT = register(
     "spark.rapids.sql.test.injectRetryOOM", 0,
     "Testing: force a synthetic device OOM after N allocations "
     "(0 = disabled).", internal=True)
-STUB_DISTRIBUTED = register(
-    "spark.rapids.sql.test.mockTransport", False,
-    "Testing: use the in-process mock shuffle transport.", internal=True)
 
 
 class RapidsConf:

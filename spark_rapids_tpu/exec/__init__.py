@@ -3,3 +3,5 @@ from .base import (ExecCtx, TpuExec, TpuMetric, HostBatchSourceExec,
 from .basic import TpuProjectExec, TpuFilterExec, TpuRangeExec
 from .window import TpuWindowExec
 from .generate import TpuGenerateExec
+from .misc import TpuUnionExec, TpuExpandExec, TpuSampleExec
+from .joins import TpuBroadcastNestedLoopJoinExec

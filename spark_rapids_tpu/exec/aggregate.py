@@ -105,6 +105,20 @@ class TpuHashAggregateExec(UnaryExec):
                       for x in self.aggs)
         return f"HashAggregateExec [keys=[{g}] aggs=[{a}]]"
 
+    def tpu_supported_conf(self, conf):
+        """Conf-dependent eligibility (planner hook): float aggregation
+        results can vary with reduction order vs CPU Spark; when
+        spark.rapids.sql.variableFloatAgg.enabled is false those
+        aggregates stay on CPU (reference semantics)."""
+        from ..config import VARIABLE_FLOAT_AGG
+        if conf.get(VARIABLE_FLOAT_AGG):
+            return None
+        for a in self.aggs:
+            if a.children and dt.is_floating(a.children[0].dtype):
+                return (f"float aggregation {a.pretty_name()} disabled "
+                        "by spark.rapids.sql.variableFloatAgg.enabled")
+        return None
+
     def tpu_supported(self):
         if any(getattr(a, "single_pass", False) for a in self.aggs):
             # the single-pass path concatenates the whole child input

@@ -259,20 +259,26 @@ class TpuCoalesceBatchesExec(UnaryExec):
         return None
 
     def execute(self, ctx: ExecCtx):
+        from ..config import BATCH_SIZE_BYTES
+        target_bytes = ctx.conf.get(BATCH_SIZE_BYTES)
         pending: List[TpuBatch] = []
         pending_rows = 0
+        pending_bytes = 0
         concat_time = ctx.metric(self, "concatTime")
         for batch in self.child.execute(ctx):
             n = batch.num_rows
             if n == 0:
                 continue
-            if pending_rows + n > self.target_rows and pending:
+            b = batch.device_size_bytes()
+            if pending and (pending_rows + n > self.target_rows
+                            or pending_bytes + b > target_bytes):
                 t0 = time.perf_counter()
                 yield concat_batches(pending)
                 concat_time.value += time.perf_counter() - t0
-                pending, pending_rows = [], 0
+                pending, pending_rows, pending_bytes = [], 0, 0
             pending.append(batch)
             pending_rows += n
+            pending_bytes += b
         if pending:
             t0 = time.perf_counter()
             yield concat_batches(pending)

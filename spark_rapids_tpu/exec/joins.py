@@ -35,7 +35,7 @@ from .base import ExecCtx, TpuExec
 from .basic import bind_all
 
 __all__ = ["TpuShuffledHashJoinExec", "TpuBroadcastHashJoinExec",
-           "TpuCartesianProductExec"]
+           "TpuCartesianProductExec", "TpuBroadcastNestedLoopJoinExec"]
 
 
 def _join_output_schema(left: dt.Schema, right: dt.Schema,
@@ -158,14 +158,11 @@ class _BaseJoinExec(TpuExec):
         return join_gather(lbatch, rbatch, lidx, ridx, lvalid, rvalid,
                            total, self._schema, char_caps)
 
-    def _join_batch(self, lbatch: TpuBatch, rbatch: TpuBatch,
-                    ctx: ExecCtx, jt: Optional[str] = None,
-                    want_matched: bool = False):
-        """Join one stream batch against the build batch with join type
-        `jt` (defaults to the exec's type — the chunked outer-join loop
-        passes the per-chunk type). With want_matched, also returns the
-        per-build-row matched mask for cross-batch accumulation."""
-        jt = jt or self.join_type
+    def _stage_ab(self, lbatch: TpuBatch, rbatch: TpuBatch, ctx: ExecCtx,
+                  jt: str):
+        """Stages A+B plus char-capacity sizing — shared by the hash-join
+        batch path and the nested-loop pair path (one source of truth
+        for string byte sizing)."""
         if self._jit_a is None:
             self._jit_a = jax.jit(self._stage_a, static_argnums=(2, 3))
         plan, total_dev = self._jit_a(lbatch, rbatch, ctx.eval_ctx, jt)
@@ -191,10 +188,23 @@ class _BaseJoinExec(TpuExec):
                 bi += 1
             else:
                 char_caps.append(0)
-        ckey = (jt, out_cap, tuple(char_caps))
+        return plan, out_cap, lidx, ridx, lvalid, rvalid, total_d, \
+            tuple(char_caps)
+
+    def _join_batch(self, lbatch: TpuBatch, rbatch: TpuBatch,
+                    ctx: ExecCtx, jt: Optional[str] = None,
+                    want_matched: bool = False):
+        """Join one stream batch against the build batch with join type
+        `jt` (defaults to the exec's type — the chunked outer-join loop
+        passes the per-chunk type). With want_matched, also returns the
+        per-build-row matched mask for cross-batch accumulation."""
+        jt = jt or self.join_type
+        plan, out_cap, lidx, ridx, lvalid, rvalid, total_d, char_caps = \
+            self._stage_ab(lbatch, rbatch, ctx, jt)
+        ckey = (jt, out_cap, char_caps)
         cfn = self._jit_c.get(ckey)
         if cfn is None:
-            cfn = jax.jit(partial(self._stage_c, jt, tuple(char_caps)))
+            cfn = jax.jit(partial(self._stage_c, jt, char_caps))
             self._jit_c[ckey] = cfn
         out = cfn(lbatch, rbatch, lidx, ridx, lvalid, rvalid, total_d)
         if self.condition is not None:
@@ -235,6 +245,23 @@ class _BaseJoinExec(TpuExec):
             schema=arrow_schema(schema))
         return arrow_to_device(rb, schema)
 
+    def _acquire_build(self, ctx: ExecCtx):
+        """(rsb, owned): the pinned spillable build side, with the
+        empty-build fallback applied. rsb None means the join's result
+        is already decided empty (semi/inner/cross/right-outer with an
+        empty build)."""
+        rsb, owned = self._build_right(ctx)
+        if rsb is None:
+            # nothing can match; for semi/inner/cross/right-outer the
+            # result is empty, for the others every left row is unmatched
+            if self.join_type in ("inner", "cross", "left_semi",
+                                  "right_outer"):
+                return None, False
+            rsb = ctx.mm.register(
+                self._empty_batch(self.right.output_schema), pinned=True)
+            owned = True
+        return rsb, owned
+
     def execute(self, ctx: ExecCtx):
         if self.tpu_supported() is not None:
             # device post-filtering is wrong for outer joins and
@@ -245,16 +272,9 @@ class _BaseJoinExec(TpuExec):
             raise NotImplementedError(self.tpu_supported())
         op_time = ctx.metric(self, "opTime")
         t0 = time.perf_counter()
-        rsb, owned = self._build_right(ctx)
+        rsb, owned = self._acquire_build(ctx)
         if rsb is None:
-            # nothing can match; for semi/inner/cross/right-outer the
-            # result is empty, for the others every left row is unmatched
-            if self.join_type in ("inner", "cross", "left_semi",
-                                  "right_outer"):
-                return
-            rsb = ctx.mm.register(
-                self._empty_batch(self.right.output_schema), pinned=True)
-            owned = True
+            return
         op_time.value += time.perf_counter() - t0
         try:
             if self.join_type in ("right_outer", "full_outer"):
@@ -412,3 +432,136 @@ class TpuCartesianProductExec(_BaseJoinExec):
     def __init__(self, left: TpuExec, right: TpuExec,
                  condition: Optional[Expression] = None):
         super().__init__([], [], "cross", left, right, condition)
+
+
+class TpuBroadcastNestedLoopJoinExec(_BaseJoinExec):
+    """Nested-loop join: every (stream row, build row) pair is tested
+    against the condition — the path for non-equi-only joins of EVERY
+    type (GpuBroadcastNestedLoopJoinExec analog; the hash-join exec
+    still rejects non-equi on outer/semi types and plans route here).
+
+    Device kernel per stream batch: the cross-product machinery emits
+    all pairs, the condition evaluates over the pair batch, and per-row
+    matched masks drive outer/semi/anti emission; matched-build masks
+    accumulate across stream batches like the hash join's streamed
+    outer path."""
+
+    def __init__(self, join_type: str, left: TpuExec, right: TpuExec,
+                 condition: Optional[Expression] = None):
+        super().__init__([], [], join_type, left, right, condition)
+
+    def tpu_supported(self):
+        # condition allowed for every join type here; nested columns
+        # still can't ride the pair gather
+        for schema in (self.left.output_schema, self.right.output_schema):
+            for f in schema.fields:
+                if dt.is_nested(f.dtype):
+                    return (f"nested loop join over nested column "
+                            f"{f.name} not on device")
+        return None
+
+    def _pairs(self, lbatch: TpuBatch, rbatch: TpuBatch, ctx: ExecCtx):
+        """(pair batch | None, ok mask | None, matched_l | None,
+        matched_r | None) — each computed only when the exec's join type
+        consumes it (semi/anti never materializes payload pairs beyond
+        the condition's needs; inner skips the matched masks)."""
+        jt = self.join_type
+        _, out_cap, lidx, ridx, lvalid, rvalid, total_d, char_caps = \
+            self._stage_ab(lbatch, rbatch, ctx, "cross")
+        need_pair = jt in ("inner", "cross", "left_outer", "right_outer",
+                           "full_outer")
+        need_ml = jt in ("left_outer", "full_outer", "left_semi",
+                         "left_anti")
+        need_mr = jt in ("right_outer", "full_outer")
+        ckey = ("pairs", jt, out_cap, char_caps, ctx.eval_ctx)
+        cfn = self._jit_c.get(ckey)
+        if cfn is None:
+            def build(caps, ectx, lb, rb, li, ri, lv, rv, tot):
+                from ..ops.join import join_gather
+                pair = join_gather(lb, rb, li, ri, lv, rv, tot,
+                                   self._cond_schema, caps)
+                pred = self.condition.eval_tpu(pair, ectx)
+                ok = pred.data & pred.validity & pair.live_mask()
+                okl = ok.astype(jnp.int32)
+                nl, nr = lb.capacity, rb.capacity
+                matched_l = jax.ops.segment_max(
+                    okl, jnp.clip(li, 0, nl - 1),
+                    num_segments=nl) > 0 if need_ml else None
+                matched_r = jax.ops.segment_max(
+                    okl, jnp.clip(ri, 0, nr - 1),
+                    num_segments=nr) > 0 if need_mr else None
+                return (pair if need_pair else None, ok, matched_l,
+                        matched_r)
+            cfn = jax.jit(partial(build, char_caps, ctx.eval_ctx))
+            self._jit_c[ckey] = cfn
+        return cfn(lbatch, rbatch, lidx, ridx, lvalid, rvalid, total_d)
+
+    def _null_side_batch(self, batch: TpuBatch, keep, left_side: bool,
+                         ctx: ExecCtx) -> TpuBatch:
+        """Rows of one side (masked by `keep`) joined to nulls of the
+        other side, in the output schema."""
+        from ..columnar.column import TpuColumnVector
+        from ..ops.gather import compact_batch
+        kept = compact_batch(batch, keep)
+        other = self.right.output_schema if left_side \
+            else self.left.output_schema
+        nulls = [TpuColumnVector.nulls(f.dtype, kept.capacity)
+                 for f in other.fields]
+        cols = (list(kept.columns) + nulls) if left_side \
+            else (nulls + list(kept.columns))
+        return TpuBatch(cols, self._schema, kept.row_count)
+
+    def execute(self, ctx: ExecCtx):
+        if self.condition is None:
+            # pure cross product: the base staged path handles it
+            yield from super().execute(ctx)
+            return
+        if self.tpu_supported() is not None:
+            raise NotImplementedError(self.tpu_supported())
+        jt = self.join_type
+        op_time = ctx.metric(self, "opTime")
+        rsb, owned = self._acquire_build(ctx)
+        if rsb is None:
+            return
+        try:
+            any_matched_r = None
+            for lbatch in self.left.execute(ctx):
+                t0 = time.perf_counter()
+                rbatch = rsb.get()
+                pair, ok, matched_l, matched_r = \
+                    self._pairs(lbatch, rbatch, ctx)
+                if matched_r is not None:
+                    any_matched_r = matched_r if any_matched_r is None \
+                        else any_matched_r | matched_r
+                if jt in ("inner", "cross", "left_outer", "right_outer",
+                          "full_outer"):
+                    out = compact_batch(pair, ok)
+                    # pair batches carry the cond schema; the output
+                    # schema differs in outer-side nullability
+                    out = TpuBatch(out.columns, self._schema,
+                                   out.row_count)
+                    op_time.value += time.perf_counter() - t0
+                    yield out
+                    t0 = time.perf_counter()
+                if jt in ("left_outer", "full_outer"):
+                    unmatched = lbatch.live_mask() & ~matched_l
+                    yield self._null_side_batch(lbatch, unmatched, True,
+                                                ctx)
+                elif jt == "left_semi":
+                    yield compact_batch(lbatch, matched_l
+                                        & lbatch.live_mask())
+                elif jt == "left_anti":
+                    yield compact_batch(lbatch, ~matched_l
+                                        & lbatch.live_mask())
+                op_time.value += time.perf_counter() - t0
+            if jt in ("right_outer", "full_outer"):
+                rbatch = rsb.get()
+                if any_matched_r is None:
+                    unmatched = rbatch.live_mask()
+                else:
+                    unmatched = rbatch.live_mask() & ~any_matched_r
+                yield self._null_side_batch(rbatch, unmatched, False, ctx)
+        finally:
+            rsb.unpin()
+            if owned:
+                rsb.release()

@@ -1,0 +1,202 @@
+"""Union / Expand / Sample operators.
+
+TPU analog of the reference's `GpuUnionExec`, `GpuExpandExec`,
+`GpuSampleExec` (SURVEY.md §2.2-B "Expand/Generate/Union/Sample";
+mount empty, capability-built).
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import pyarrow as pa
+
+from .. import datatypes as dt
+from ..columnar.batch import TpuBatch
+from ..columnar.column import TpuColumnVector
+from ..expr.base import Expression
+from .base import ExecCtx, TpuExec, UnaryExec
+
+__all__ = ["TpuUnionExec", "TpuExpandExec", "TpuSampleExec"]
+
+
+class TpuUnionExec(TpuExec):
+    """UNION ALL: children's batches streamed in child order. Children
+    must share the output schema (the DataFrame layer inserts casts)."""
+
+    def __init__(self, children: Sequence[TpuExec]):
+        super().__init__()
+        if not children:
+            raise ValueError("union needs >= 1 child")
+        self.children = tuple(children)
+        first = children[0].output_schema
+        for c in children[1:]:
+            if c.output_schema.types != first.types:
+                raise TypeError(
+                    f"union children schemas differ: {first.types} vs "
+                    f"{c.output_schema.types}")
+        # Spark ORs nullability across children: a later nullable child
+        # must not be masked by a non-nullable first schema
+        self._schema = dt.Schema([
+            dt.StructField(
+                f.name, f.dtype,
+                any(c.output_schema.fields[i].nullable
+                    for c in children))
+            for i, f in enumerate(first.fields)])
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    def execute(self, ctx: ExecCtx):
+        for c in self.children:
+            yield from c.execute(ctx)
+
+    def execute_cpu(self, ctx: ExecCtx):
+        from ..columnar.arrow_bridge import arrow_schema
+        target = arrow_schema(self._schema)
+        for c in self.children:
+            for rb in c.execute_cpu(ctx):
+                if rb.schema != target:  # names may differ; types match
+                    rb = pa.RecordBatch.from_arrays(
+                        [rb.column(i) for i in range(rb.num_columns)],
+                        schema=target)
+                yield rb
+
+
+class TpuExpandExec(UnaryExec):
+    """Each input row expands through every projection list (the
+    ROLLUP/CUBE/grouping-sets backbone). Emits one batch per projection
+    per input batch — same multiset as Spark's row-interleaved output."""
+
+    def __init__(self, projections: Sequence[Sequence[Expression]],
+                 names: Sequence[str], child: TpuExec):
+        super().__init__(child)
+        from .basic import bind_all
+        if not projections:
+            raise ValueError("expand needs >= 1 projection")
+        self.projections = [bind_all(p, child.output_schema)
+                            for p in projections]
+        width = len(self.projections[0])
+        if any(len(p) != width for p in self.projections) \
+                or len(names) != width:
+            raise ValueError("projection widths/names mismatch")
+        first = self.projections[0]
+        self._schema = dt.Schema([
+            dt.StructField(n, e.dtype,
+                           any(p[i].nullable for p in self.projections))
+            for i, (n, e) in enumerate(zip(names, first))])
+        for p in self.projections[1:]:
+            for i, e in enumerate(p):
+                if e.dtype != first[i].dtype:
+                    raise TypeError(
+                        f"expand projection column {i} type mismatch")
+        self._jits: List = [None] * len(self.projections)
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"ExpandExec [{len(self.projections)} projections]"
+
+    def expressions(self):
+        return [e for p in self.projections for e in p]
+
+    def _project(self, exprs, batch: TpuBatch, ectx) -> TpuBatch:
+        cols = [e.eval_tpu(batch, ectx) for e in exprs]
+        return TpuBatch(cols, self._schema, batch.row_count,
+                        selection=batch.selection)
+
+    def execute(self, ctx: ExecCtx):
+        from functools import partial
+        op_time = ctx.metric(self, "opTime")
+        for batch in self.child.execute(ctx):
+            t0 = time.perf_counter()
+            for i, p in enumerate(self.projections):
+                if self._jits[i] is None:
+                    self._jits[i] = jax.jit(
+                        partial(self._project, tuple(p)),
+                        static_argnums=1)
+                yield self._jits[i](batch, ctx.eval_ctx)
+            op_time.value += time.perf_counter() - t0
+
+    def execute_cpu(self, ctx: ExecCtx):
+        from ..columnar.arrow_bridge import arrow_schema
+        target = arrow_schema(self._schema)
+        for rb in self.child.execute_cpu(ctx):
+            for p in self.projections:
+                arrays = [e.eval_cpu(rb, ctx.eval_ctx) for e in p]
+                yield pa.RecordBatch.from_arrays(arrays, schema=target)
+
+
+class TpuSampleExec(UnaryExec):
+    """Bernoulli sample without replacement. Row selection is a
+    deterministic hash of (seed, global row position) compared against
+    the fraction — IDENTICAL on the device and oracle paths, so the
+    dual-run harness compares exactly (Spark's XORShift sampler is
+    per-partition-seeded and not bit-matched here; the row DISTRIBUTION
+    contract is)."""
+
+    def __init__(self, fraction: float, seed: int, child: TpuExec):
+        super().__init__(child)
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError("fraction must be in [0, 1]")
+        self.fraction = float(fraction)
+        self.seed = int(seed)
+        self._threshold = int(self.fraction * (1 << 32))
+        self._jitted = None  # compile once across executions
+
+    def describe(self):
+        return f"SampleExec [fraction={self.fraction} seed={self.seed}]"
+
+    def _keep_mask_np(self, start: int, n: int):
+        import numpy as np
+        from ..ops.hash import murmur3_int64
+        pos = np.arange(start, start + n, dtype=np.int64)
+        err = np.seterr(over="ignore")
+        lo = (pos & 0xffffffff).astype(np.uint32)
+        hi = (pos >> 32).astype(np.uint32)
+        h = murmur3_int64((lo, hi), np.uint32(self.seed & 0xffffffff), np)
+        np.seterr(**err)
+        return h.astype(np.uint64).astype(np.int64) < self._threshold
+
+    def execute(self, ctx: ExecCtx):
+        from ..ops.gather import compact_batch
+        from ..ops.hash import murmur3_int64
+        op_time = ctx.metric(self, "opTime")
+        start = 0
+
+        def keep_fn(start_, batch, ectx):
+            cap = batch.capacity
+            pos = start_ + jnp.arange(cap, dtype=jnp.int64)
+            lo = (pos & 0xffffffff).astype(jnp.uint32)
+            hi = (pos >> 32).astype(jnp.uint32)
+            h = murmur3_int64((lo, hi),
+                              jnp.uint32(self.seed & 0xffffffff), jnp)
+            keep = h.astype(jnp.uint32).astype(jnp.int64) \
+                < self._threshold
+            return compact_batch(batch, keep)
+
+        if self._jitted is None:
+            self._jitted = jax.jit(keep_fn, static_argnums=2)
+        jitted = self._jitted
+        for batch in self.child.execute(ctx):
+            from ..ops.gather import ensure_compacted
+            batch = ensure_compacted(batch)  # global positions = prefix
+            n = batch.num_rows
+            t0 = time.perf_counter()
+            yield jitted(jnp.int64(start), batch, ctx.eval_ctx)
+            op_time.value += time.perf_counter() - t0
+            start += n
+
+    def execute_cpu(self, ctx: ExecCtx):
+        import numpy as np
+        start = 0
+        for rb in self.child.execute_cpu(ctx):
+            keep = self._keep_mask_np(start, rb.num_rows)
+            idx = np.nonzero(keep)[0]
+            yield rb.take(pa.array(idx, pa.int64()))
+            start += rb.num_rows

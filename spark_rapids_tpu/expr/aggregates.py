@@ -640,17 +640,20 @@ class _Collect(AggregateFunction):
     def cpu_agg(self, values, ectx=None):
         vals = [v for v in values if v is not None]
         if self.dedupe:
+            # tuple-tagged keys: the string "NaN" must never collide
+            # with float NaN
             seen, out = set(), []
             for v in vals:
                 if isinstance(v, float):
-                    k = "NaN" if math.isnan(v) else v + 0.0
+                    k = ("fnan",) if math.isnan(v) else ("f", v + 0.0)
+                    canon = float("nan") if math.isnan(v) else v + 0.0
                 else:
-                    k = v
+                    k = ("v", v)
+                    canon = v
                 if k in seen:
                     continue
                 seen.add(k)
-                out.append(float("nan") if k == "NaN" else
-                           (k if isinstance(v, float) else v))
+                out.append(canon)
             vals = out
 
         def key(v):

@@ -268,8 +268,11 @@ def infer_literal_type(value) -> dt.DataType:
 
 
 def bind_expr(expr: Expression, schema: dt.Schema,
-              case_sensitive: bool = False) -> Expression:
-    """Resolve UnresolvedColumn nodes to BoundReference ordinals."""
+              case_sensitive: bool = False,
+              validate: bool = True) -> Expression:
+    """Resolve UnresolvedColumn nodes to BoundReference ordinals.
+    validate=False defers type checks — the DataFrame analyzer inserts
+    implicit casts between resolution and validation."""
 
     def resolve(node):
         if isinstance(node, UnresolvedColumn):
@@ -288,6 +291,8 @@ def bind_expr(expr: Expression, schema: dt.Schema,
         return node
 
     bound = expr.transform(resolve)
+    if not validate:
+        return bound
 
     def check(node):
         node.validate()

@@ -90,8 +90,15 @@ class Lower(_CaseMap):
 
 
 class Substring(Expression):
+
     """substring(str, pos, len) — 1-based, negative pos from end.
     Device kernel is byte-based (exact for ASCII); CPU is char-based."""
+
+    #: consumed by the planner's incompatibleOps gate: the device
+    #: path slices BYTES, which differs from Spark's char slicing
+    #: on multi-byte UTF-8 input
+    incompat = "byte-based substring differs from Spark on non-ASCII"
+
 
     def __init__(self, child, pos: Expression, length: Expression):
         self.children = (child, pos, length)

@@ -102,37 +102,92 @@ def split_batch(batch):
 
 
 class SpillableBatch:
-    """A catalog-registered device batch that can round-trip to host Arrow
-    (SpillableColumnarBatch analog)."""
+    """A catalog-registered device batch that tiers device -> host Arrow
+    -> disk Arrow IPC (SpillableColumnarBatch over the reference's
+    device/host/disk store ladder — SURVEY.md:143)."""
 
     def __init__(self, mgr: "DeviceMemoryManager", batch):
         self._mgr = mgr
         self._device = batch
         self._host = None
+        self._disk_path = None
         self._schema = batch.schema
         self.nbytes = batch.device_size_bytes()
+        self.host_nbytes = 0
         self.spill_count = 0
 
     @property
     def on_device(self) -> bool:
         return self._device is not None
 
-    def spill(self):
+    @property
+    def on_disk(self) -> bool:
+        return self._disk_path is not None
+
+    def spill(self, cascade: bool = True):
         """Download to host Arrow, drop the device buffers (XLA frees),
-        and credit the ledger."""
+        and credit the ledger; host pressure cascades to the disk tier
+        (cascade=False when the caller already holds the ledger lock —
+        disk IO must never run under it)."""
         if self._device is None:
             return
         from .columnar.arrow_bridge import device_to_arrow
         self._host = device_to_arrow(self._device)
         self._device = None
         self.spill_count += 1
+        self.host_nbytes = self._host.nbytes
         with self._mgr._lock:
             if id(self) in self._mgr._catalog:
                 self._mgr.device_bytes -= self.nbytes
                 self._mgr.spill_bytes += self.nbytes
+                self._mgr.host_bytes += self.host_nbytes
+        if cascade:
+            self._mgr._evict_host_to_disk()
+
+    def spill_to_disk(self):
+        """Host Arrow -> Arrow IPC file in spark.rapids.memory.spillDir
+        (disk tier, SURVEY.md:143)."""
+        if self._host is None or self._disk_path is not None:
+            return
+        import os
+        import uuid
+
+        import pyarrow as pa
+        os.makedirs(self._mgr.spill_dir, exist_ok=True)
+        path = os.path.join(self._mgr.spill_dir,
+                            f"spill-{uuid.uuid4().hex}.arrow")
+        with pa.OSFile(path, "wb") as f, \
+                pa.ipc.new_file(f, self._host.schema) as w:
+            w.write_batch(self._host)
+        self._disk_path = path
+        self._host = None
+        with self._mgr._lock:
+            self._mgr.host_bytes -= self.host_nbytes
+            self._mgr.disk_spill_bytes += self.host_nbytes
+
+    def _read_disk(self):
+        import os
+
+        import pyarrow as pa
+        with pa.OSFile(self._disk_path, "rb") as f:
+            table = pa.ipc.open_file(f).read_all().combine_chunks()
+        os.unlink(self._disk_path)
+        self._disk_path = None
+        rbs = table.to_batches()
+        if rbs:
+            return rbs[0]
+        # 0-row tables yield no batches: rebuild an empty RecordBatch
+        return pa.RecordBatch.from_arrays(
+            [pa.array([], type=f.type) for f in table.schema],
+            schema=table.schema)
 
     def get_host(self):
-        """Host Arrow view (spills if still on device)."""
+        """Host Arrow view (spills if still on device; reads back the
+        disk tier if spilled further)."""
+        if self._host is None and self._disk_path is not None:
+            self._host = self._read_disk()
+            with self._mgr._lock:
+                self._mgr.host_bytes += self.host_nbytes
         if self._host is None:
             from .columnar.arrow_bridge import device_to_arrow
             self._host = device_to_arrow(self._device)
@@ -143,9 +198,12 @@ class SpillableBatch:
         spilled."""
         if self._device is None:
             from .columnar.arrow_bridge import arrow_to_device
+            host = self.get_host()
             self._mgr._charge(self, self.nbytes)
-            self._device = arrow_to_device(self._host, self._schema)
+            self._device = arrow_to_device(host, self._schema)
             self._host = None
+            with self._mgr._lock:
+                self._mgr.host_bytes -= self.host_nbytes
         self._mgr._touch(self)
         return self._device
 
@@ -159,6 +217,12 @@ class SpillableBatch:
 
     def release(self):
         self._mgr._release(self)
+        if self._disk_path is not None:
+            import contextlib
+            import os
+            with contextlib.suppress(OSError):
+                os.unlink(self._disk_path)
+            self._disk_path = None
         self._device = None
         self._host = None
 
@@ -185,9 +249,13 @@ class DeviceMemoryManager:
         conf = conf or RapidsConf()
         if conf.get(TEST_RETRY_OOM_INJECT):
             return cls(conf)
+        from .config import (HOST_SPILL_LIMIT, LEAK_DEBUG, MEM_DEBUG,
+                             SPILL_DIR)
         key = (conf.get(DEVICE_BUDGET), conf.get(ALLOC_FRACTION),
                conf.get(CONCURRENT_TPU_TASKS), conf.get(OOM_RETRY_ENABLED),
-               conf.get(OOM_MAX_SPLITS), conf.get(OOM_RETRY_BLOCKING))
+               conf.get(OOM_MAX_SPLITS), conf.get(OOM_RETRY_BLOCKING),
+               conf.get(HOST_SPILL_LIMIT), conf.get(SPILL_DIR),
+               conf.get(MEM_DEBUG), conf.get(LEAK_DEBUG))
         with cls._shared_lock:
             mgr = cls._shared.get(key)
             if mgr is None:
@@ -207,6 +275,11 @@ class DeviceMemoryManager:
         self._pin_counts: dict = {}  # id -> refcount (shared consumers)
         self.device_bytes = 0
         self.spill_bytes = 0  # total bytes ever spilled (metric)
+        from .config import HOST_SPILL_LIMIT, SPILL_DIR
+        self.host_bytes = 0          # host-tier residency
+        self.disk_spill_bytes = 0    # total bytes ever tiered to disk
+        self.host_limit = self.conf.get(HOST_SPILL_LIMIT)
+        self.spill_dir = self.conf.get(SPILL_DIR)
         self.semaphore = threading.BoundedSemaphore(
             self.conf.get(CONCURRENT_TPU_TASKS))
         self._retry_enabled = self.conf.get(OOM_RETRY_ENABLED)
@@ -214,6 +287,31 @@ class DeviceMemoryManager:
         self.max_splits = self.conf.get(OOM_MAX_SPLITS)
         self._inject_after = self.conf.get(TEST_RETRY_OOM_INJECT)
         self._op_count = 0
+        from .config import LEAK_DEBUG, MEM_DEBUG
+        self._mem_debug = self.conf.get(MEM_DEBUG) == "STDOUT"
+        self._leak_debug = self.conf.get(LEAK_DEBUG)
+        self._alloc_sites: dict = {}  # id -> traceback summary
+
+    def _debug(self, event: str, sb: "SpillableBatch"):
+        if self._mem_debug:
+            print(f"[rapids-mem] {event} id={id(sb):#x} "
+                  f"bytes={sb.nbytes} device={self.device_bytes} "
+                  f"host={self.host_bytes}")
+
+    def leak_report(self) -> str:
+        """Catalog entries never released, with their registration sites
+        (spark.rapids.refcount.debug — SURVEY.md §5.2)."""
+        with self._lock:
+            live = [(id(sb), sb.nbytes,
+                     self._alloc_sites.get(id(sb), "<site untracked>"))
+                    for sb in self._catalog.values()]
+        if not live:
+            return "no leaked catalog entries"
+        lines = [f"{len(live)} catalog entr"
+                 f"{'y' if len(live) == 1 else 'ies'} never released:"]
+        for key, nbytes, site in live:
+            lines.append(f"  id={key:#x} bytes={nbytes}\n    {site}")
+        return "\n".join(lines)
 
     @staticmethod
     def _device_memory() -> int:
@@ -240,7 +338,15 @@ class DeviceMemoryManager:
                 self._pin_counts[id(sb)] = \
                     self._pin_counts.get(id(sb), 0) + 1
             self.device_bytes += sb.nbytes
+            if self._leak_debug:
+                import traceback
+                # drop only the register() frame itself: the caller is
+                # the allocation site being reported
+                self._alloc_sites[id(sb)] = "".join(
+                    traceback.format_stack(limit=6)[:-1]).strip()
             self._evict_to_fit()
+        self._evict_host_to_disk()  # disk IO outside the ledger lock
+        self._debug("register", sb)
         return sb
 
     def _charge(self, sb: SpillableBatch, nbytes: int):
@@ -248,6 +354,7 @@ class DeviceMemoryManager:
             self.device_bytes += nbytes
             self._catalog[id(sb)] = sb
             self._evict_to_fit(exclude=id(sb))
+        self._evict_host_to_disk()  # disk IO outside the ledger lock
 
     def _touch(self, sb: SpillableBatch):
         with self._lock:
@@ -256,10 +363,28 @@ class DeviceMemoryManager:
 
     def _release(self, sb: SpillableBatch):
         with self._lock:
-            if self._catalog.pop(id(sb), None) is not None \
-                    and sb.on_device:
-                self.device_bytes -= sb.nbytes
+            if self._catalog.pop(id(sb), None) is not None:
+                if sb.on_device:
+                    self.device_bytes -= sb.nbytes
+                elif sb._host is not None:
+                    self.host_bytes -= sb.host_nbytes
             self._pin_counts.pop(id(sb), None)
+            self._alloc_sites.pop(id(sb), None)
+        self._debug("release", sb)
+
+    def _evict_host_to_disk(self):
+        """Cascade the host tier to disk when past
+        spark.rapids.memory.host.spillStorageSize (the reference's
+        host-store overflow-to-disk ladder)."""
+        with self._lock:
+            if self.host_bytes <= self.host_limit:
+                return
+            victims = [sb for sb in self._catalog.values()
+                       if sb._host is not None and not sb.on_device]
+        for sb in victims:
+            if self.host_bytes <= self.host_limit:
+                break
+            sb.spill_to_disk()
 
     def _evict_to_fit(self, exclude: Optional[int] = None):
         """LRU device->host spill until under budget (the
@@ -271,7 +396,8 @@ class DeviceMemoryManager:
                 break
             if key == exclude or self._pin_counts.get(key, 0) > 0:
                 continue
-            self._catalog[key].spill()  # adjusts the ledger itself
+            # no disk cascade here: the ledger lock is held
+            self._catalog[key].spill(cascade=False)
 
     def pin(self, sb: SpillableBatch):
         """Refcounted: a batch shared by several consumers (a broadcast

@@ -57,6 +57,7 @@ class NodeMeta:
 
     def tag(self, conf: RapidsConf):
         """Eligibility checks for this node (children tagged separately)."""
+        from .config import INCOMPATIBLE_OPS
         name = self.node.pretty_name()
         if not conf.get(SQL_ENABLED):
             self.will_not_work("spark.rapids.sql.enabled is false")
@@ -67,6 +68,12 @@ class NodeMeta:
         r = self.node.tpu_supported()
         if r:
             self.will_not_work(r)
+        conf_hook = getattr(self.node, "tpu_supported_conf", None)
+        if conf_hook is not None:
+            r = conf_hook(conf)
+            if r:
+                self.will_not_work(r)
+        allow_incompat = conf.get(INCOMPATIBLE_OPS)
         for root in self.node.expressions():
             for e in _walk_expr(root):
                 ename = e.pretty_name()
@@ -74,6 +81,13 @@ class NodeMeta:
                     self.will_not_work(
                         f"expression {e!r} has been disabled by "
                         f"spark.rapids.sql.expression.{ename}")
+                    continue
+                incompat = getattr(e, "incompat", None)
+                if incompat and not allow_incompat:
+                    self.will_not_work(
+                        f"expression {e!r} is incompatible ({incompat}) "
+                        "and spark.rapids.sql.incompatibleOps.enabled "
+                        "is false")
                     continue
                 er = e.tpu_supported()
                 if er:

@@ -1,0 +1,325 @@
+"""Session / DataFrame facade — the user-facing product surface.
+
+TPU analog of the entry point the reference gives Spark users
+(`spark.plugins=com.nvidia.spark.SQLPlugin` + the unchanged DataFrame
+API — SURVEY.md §2.2-A "Plugin bootstrap"; mount empty,
+capability-built): a user writes DataFrame transformations; the session
+builds the exec tree, runs the override/planner pass, and executes on
+TPU with per-operator CPU fallback. Until a JVM bridge exists the API
+is Python-native (pyarrow in, pyarrow out), but the plan/override/
+execute pipeline underneath is exactly the plugin's.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import pyarrow as pa
+
+from . import datatypes as dt
+from .config import (CASE_SENSITIVE, RapidsConf, SHUFFLE_PARTITIONS)
+from .exec.base import ExecCtx, HostBatchSourceExec, TpuExec, UnaryExec
+from .expr.base import Expression, bind_expr
+from .expr import UnresolvedColumn
+
+__all__ = ["TpuSession", "DataFrame", "TpuCacheExec"]
+
+
+class TpuCacheExec(UnaryExec):
+    """df.cache(): the child materializes ONCE into spillable catalog
+    entries and replays from them afterwards (the reference's
+    GpuDataFrame cache / InMemoryTableScan analog, SURVEY.md §2.2-B
+    "DataFrame cache"). Spill pressure tiers cached batches device ->
+    host -> disk like any catalog entry."""
+
+    def __init__(self, child: TpuExec):
+        super().__init__(child)
+        self._entries = None   # List[SpillableBatch]
+        self._cpu_cache = None
+
+    def describe(self):
+        state = "cached" if self._entries is not None else "lazy"
+        return f"CacheExec [{state}]"
+
+    def execute(self, ctx: ExecCtx):
+        if self._entries is None:
+            entries = []
+            for b in self.child.execute(ctx):
+                entries.append(ctx.mm.register(b))
+            self._entries = entries
+            import weakref
+            for sb in entries:
+                weakref.finalize(self, type(sb).release, sb)
+        for sb in self._entries:
+            yield sb.get()
+
+    def execute_cpu(self, ctx: ExecCtx):
+        if self._cpu_cache is None:
+            self._cpu_cache = list(self.child.execute_cpu(ctx))
+        yield from self._cpu_cache
+
+
+def _analyze(e: Expression) -> Expression:
+    """The analyzer slice the engine's type-resolved expressions expect:
+    implicit numeric widening casts on binary comparisons/arithmetic
+    (Catalyst's TypeCoercion analog). The exec layer stays strict; only
+    the user-facing DataFrame API coerces."""
+    from .expr import Cast, Divide
+    from .expr.arithmetic import BinaryArithmetic
+    from .expr.predicates import BinaryComparison
+
+    def coerce(node):
+        if isinstance(node, (BinaryComparison, BinaryArithmetic)) \
+                and len(node.children) == 2:
+            left, right = node.children
+            try:
+                lt, rt = left.dtype, right.dtype
+            except TypeError:
+                return node
+            if lt == rt and not isinstance(node, Divide):
+                return node
+            if dt.is_numeric(lt) and dt.is_numeric(rt):
+                t = dt.common_type(lt, rt)
+                if isinstance(node, Divide) and dt.is_integral(t):
+                    t = dt.FLOAT64  # Spark `/` is fractional
+                new = []
+                for c in (left, right):
+                    new.append(c if c.dtype == t else Cast(c, t))
+                if new[0] is not left or new[1] is not right:
+                    return node.with_children(new)
+        return node
+
+    return e.transform(coerce)
+
+
+def _as_expr(c) -> Expression:
+    if isinstance(c, Expression):
+        return c
+    if isinstance(c, str):
+        return UnresolvedColumn(c)
+    raise TypeError(f"not a column: {c!r}")
+
+
+class GroupedData:
+    def __init__(self, df: "DataFrame", keys: List[Expression]):
+        self._df = df
+        self._keys = keys
+
+    def agg(self, *agg_exprs) -> "DataFrame":
+        """Shuffle by the grouping keys (spark.sql.shuffle.partitions
+        exchanges — the plan shape CPU Spark produces) then aggregate."""
+        from .exec.aggregate import TpuHashAggregateExec
+        from .exec.exchange import TpuShuffleExchangeExec
+        from .shuffle.partitioner import HashPartitioning
+        df = self._df
+        child = df._node
+        if self._keys:
+            n = df._session.conf.get(SHUFFLE_PARTITIONS)
+            child = TpuShuffleExchangeExec(
+                HashPartitioning(self._keys, n), child)
+        node = TpuHashAggregateExec(self._keys, list(agg_exprs), child)
+        return DataFrame(node, df._session)
+
+
+class DataFrame:
+    def __init__(self, node: TpuExec, session: "TpuSession"):
+        self._node = node
+        self._session = session
+
+    # --- schema / plan ----------------------------------------------------
+    @property
+    def schema(self) -> dt.Schema:
+        return self._node.output_schema
+
+    @property
+    def columns(self) -> List[str]:
+        return self._node.output_schema.names
+
+    def _bind(self, e) -> Expression:
+        bound = bind_expr(_as_expr(e), self._node.output_schema,
+                          case_sensitive=self._session.conf.get(
+                              CASE_SENSITIVE),
+                          validate=False)
+        analyzed = _analyze(bound)
+        analyzed.transform(lambda n: (n.validate(), n)[1])
+        return analyzed
+
+    def explain(self, mode: str = "ALL") -> str:
+        from .planner import TpuOverrides
+        pp = TpuOverrides(self._session.conf).apply(self._node)
+        return pp.explain(mode)
+
+    # --- transformations --------------------------------------------------
+    def select(self, *cols) -> "DataFrame":
+        from .exec.basic import TpuProjectExec
+        return DataFrame(TpuProjectExec([self._bind(c) for c in cols],
+                                        self._node), self._session)
+
+    def with_column(self, name: str, expr) -> "DataFrame":
+        from .expr import Alias
+        keep = [UnresolvedColumn(n) for n in self.columns if n != name]
+        return self.select(*keep, Alias(_as_expr(expr), name))
+
+    def filter(self, cond) -> "DataFrame":
+        from .exec.basic import TpuFilterExec
+        return DataFrame(TpuFilterExec(self._bind(cond), self._node),
+                         self._session)
+
+    where = filter
+
+    def group_by(self, *keys) -> GroupedData:
+        return GroupedData(self, [self._bind(k) for k in keys])
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner",
+             condition=None) -> "DataFrame":
+        """Equi-join via the shuffled hash join (`on` = column name(s)
+        shared by both sides, or a (left, right) expression pair list);
+        condition-only joins route to the nested-loop exec like the
+        reference's plan rules."""
+        from .exec.joins import (TpuBroadcastNestedLoopJoinExec,
+                                 TpuShuffledHashJoinExec)
+        how = {"left": "left_outer", "right": "right_outer",
+               "outer": "full_outer", "full": "full_outer",
+               "semi": "left_semi", "anti": "left_anti"}.get(how, how)
+        if on is None:
+            node = TpuBroadcastNestedLoopJoinExec(
+                how, self._node, other._node, condition)
+            return DataFrame(node, self._session)
+        if isinstance(on, str):
+            on = [on]
+        from .expr import Cast
+        cs = self._session.conf.get(CASE_SENSITIVE)
+        lkeys, rkeys = [], []
+        for k in on:
+            lk = _as_expr(k if not isinstance(k, tuple) else k[0])
+            rk = _as_expr(k if not isinstance(k, tuple) else k[1])
+            lk = bind_expr(lk, self._node.output_schema,
+                           case_sensitive=cs)
+            rk = bind_expr(rk, other._node.output_schema,
+                           case_sensitive=cs)
+            # analyzer-grade key coercion: mixed-width numeric keys
+            # widen to their common type (Spark's TypeCoercion)
+            if lk.dtype != rk.dtype and dt.is_numeric(lk.dtype) \
+                    and dt.is_numeric(rk.dtype):
+                t = dt.common_type(lk.dtype, rk.dtype)
+                if lk.dtype != t:
+                    lk = Cast(lk, t)
+                if rk.dtype != t:
+                    rk = Cast(rk, t)
+            lkeys.append(lk)
+            rkeys.append(rk)
+        node = TpuShuffledHashJoinExec(lkeys, rkeys, how, self._node,
+                                       other._node, condition)
+        return DataFrame(node, self._session)
+
+    def order_by(self, *cols, ascending: Union[bool, Sequence[bool]] =
+                 True) -> "DataFrame":
+        from .exec.sort import SortOrder, TpuSortExec
+        if isinstance(ascending, bool):
+            ascending = [ascending] * len(cols)
+        orders = [SortOrder(_as_expr(c), asc)
+                  for c, asc in zip(cols, ascending)]
+        return DataFrame(TpuSortExec(orders, self._node), self._session)
+
+    def limit(self, n: int) -> "DataFrame":
+        from .exec.sort import TpuGlobalLimitExec
+        return DataFrame(TpuGlobalLimitExec(n, self._node), self._session)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        from .exec.misc import TpuUnionExec
+        return DataFrame(TpuUnionExec([self._node, other._node]),
+                         self._session)
+
+    def sample(self, fraction: float, seed: int = 42) -> "DataFrame":
+        from .exec.misc import TpuSampleExec
+        return DataFrame(TpuSampleExec(fraction, seed, self._node),
+                         self._session)
+
+    def explode(self, column, outer: bool = False,
+                position: bool = False) -> "DataFrame":
+        from .exec.generate import TpuGenerateExec
+        return DataFrame(
+            TpuGenerateExec(self._bind(column), self._node, outer=outer,
+                            position=position), self._session)
+
+    def cache(self) -> "DataFrame":
+        return DataFrame(TpuCacheExec(self._node), self._session)
+
+    # --- actions ----------------------------------------------------------
+    def _plan(self):
+        from .planner import TpuOverrides
+        return TpuOverrides(self._session.conf).apply(self._node)
+
+    def collect(self) -> pa.Table:
+        return self._plan().collect()
+
+    def count(self) -> int:
+        return self.collect().num_rows
+
+    def to_pylist(self) -> List[dict]:
+        return self.collect().to_pylist()
+
+    def write(self, path: str, fmt: str = "parquet",
+              partition_by=None) -> List[str]:
+        """Write via the engine's write exec; returns the part files."""
+        from .io.write import TpuFileWriteExec
+        node = TpuFileWriteExec(self._node, path, fmt,
+                                partition_by=partition_by,
+                                conf=self._session.conf)
+        from .planner import TpuOverrides
+        pp = TpuOverrides(self._session.conf).apply(node)
+        pp.collect()
+        return node.written_files
+
+    def write_parquet(self, path: str, **kw) -> List[str]:
+        return self.write(path, "parquet", **kw)
+
+
+class TpuSession:
+    """The SparkSession analog: conf + DataFrame builders."""
+
+    def __init__(self, conf: Optional[Union[RapidsConf, Dict]] = None):
+        if isinstance(conf, dict):
+            conf = RapidsConf(conf)
+        self.conf = conf or RapidsConf()
+
+    # --- builders ---------------------------------------------------------
+    def create_dataframe(self, data) -> DataFrame:
+        """From a pyarrow Table/RecordBatch or a {name: list} dict."""
+        if isinstance(data, dict):
+            data = pa.table(data)
+        if isinstance(data, pa.Table):
+            rbs = data.combine_chunks().to_batches()
+            schema = data.schema
+        elif isinstance(data, pa.RecordBatch):
+            rbs = [data]
+            schema = data.schema
+        else:
+            raise TypeError(f"cannot build a DataFrame from {type(data)}")
+        from .columnar.arrow_bridge import engine_schema
+        # explicit schema: a 0-row table yields no batches
+        return DataFrame(HostBatchSourceExec(
+            rbs, schema=engine_schema(schema)), self)
+
+    def _read(self, paths, fmt: str, schema=None) -> DataFrame:
+        from .io import TpuFileScanExec
+        if isinstance(paths, str):
+            paths = [paths]
+        return DataFrame(
+            TpuFileScanExec(paths, fmt=fmt, schema=schema,
+                            conf=self.conf), self)
+
+    def read_parquet(self, paths, schema=None) -> DataFrame:
+        return self._read(paths, "parquet", schema)
+
+    def read_csv(self, paths, schema=None) -> DataFrame:
+        return self._read(paths, "csv", schema)
+
+    def read_json(self, paths, schema=None) -> DataFrame:
+        return self._read(paths, "json", schema)
+
+    def read_orc(self, paths, schema=None) -> DataFrame:
+        return self._read(paths, "orc", schema)
+
+    def range(self, n: int) -> DataFrame:
+        from .exec.basic import TpuRangeExec
+        return DataFrame(TpuRangeExec(0, n), self)

@@ -171,6 +171,13 @@ class _IciWriter(ShuffleWriteHandle):
                     f"nested column {f.name} "
                     f"({f.dtype.simple_string()}) cannot ride the ICI "
                     "collective yet (fixed-width and string lanes only)")
+        nbytes = batch.device_size_bytes()
+        if nbytes > self._t.max_payload:
+            raise ValueError(
+                f"map batch of {nbytes} bytes exceeds "
+                f"spark.rapids.shuffle.ici.maxPartitionBytes "
+                f"({self._t.max_payload}); emit smaller map batches or "
+                "raise the conf")
         with self._t._lock:
             self._t._pending[self._sid].append((self._mid, batch, pids))
 
@@ -187,9 +194,11 @@ class IciShuffleTransport(ShuffleTransport):
 
     supports_unsplit = True
 
-    def __init__(self, mesh: Mesh, axis: str = "x"):
+    def __init__(self, mesh: Mesh, axis: str = "x", conf=None):
+        from ..config import ICI_MAX_PAYLOAD, RapidsConf
         self.mesh = mesh
         self.axis = axis
+        self.max_payload = (conf or RapidsConf()).get(ICI_MAX_PAYLOAD)
         self.ndev = mesh.shape[axis]
         self._exchange = make_ici_all_to_all(mesh, axis)
         self._pending: Dict[int, List[Tuple[int, TpuBatch, object]]] = {}

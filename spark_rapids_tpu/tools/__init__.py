@@ -1,0 +1,4 @@
+from .udf_compiler import compile_udf, TpuCompiledUDF
+from .qualification import qualify
+from .profiling import profile_report
+from .api_validation import generate_supported_ops

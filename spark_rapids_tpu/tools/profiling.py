@@ -1,0 +1,62 @@
+"""Profiling tool: post-run analysis of an executed plan.
+
+TPU analog of the reference's profiling tool (SURVEY.md §2.2-F: mines
+event logs for per-op times and tuning recommendations; mount empty,
+capability-built). Here it mines the metrics the engine itself
+accumulated during collect() — run with
+spark.rapids.sql.metrics.level=DEBUG for real device times — and emits
+the annotated plan plus ranked hotspots and recommendations.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+__all__ = ["profile_report"]
+
+
+def profile_report(pp, ctx=None) -> str:
+    """`pp` is a PhysicalPlan whose collect() already ran (or pass the
+    ExecCtx used)."""
+    ctx = ctx or pp.last_ctx
+    lines = ["=== TPU profile ===", pp.metrics_report(ctx)]
+    if ctx is None:
+        lines.append("(no metrics: run collect() first)")
+        return "\n".join(lines)
+
+    # ranked hotspots by opTime
+    hot = []
+    for label, ms in ctx.metrics.items():
+        t = ms.get("opTime")
+        if t is not None and t.value:
+            hot.append((t.value, label))
+    hot.sort(reverse=True)
+    if hot:
+        lines.append("hotspots:")
+        total = sum(t for t, _ in hot) or 1.0
+        for t, label in hot[:5]:
+            lines.append(f"  {label:<28} {t * 1e3:9.2f}ms "
+                         f"({t / total:.0%})")
+
+    recs: List[str] = []
+    if not ctx.sync_metrics:
+        recs.append("set spark.rapids.sql.metrics.level=DEBUG for "
+                    "device-time opTime (timings above are dispatch "
+                    "cost only)")
+    for label, ms in ctx.metrics.items():
+        sp = ms.get("spillTime")
+        if sp is not None and sp.value > 0.05:
+            recs.append(f"{label}: {sp.value * 1e3:.0f}ms spilling — "
+                        "raise spark.rapids.memory.device.budgetBytes "
+                        "or reduce concurrency")
+        up = ms.get("uploadTime")
+        if up is not None and up.value > 0.5:
+            recs.append(f"{label}: {up.value * 1e3:.0f}ms uploading — "
+                        "keep data device-resident between stages")
+    fb = pp.fallback_nodes()
+    if fb:
+        recs.append("CPU fallbacks present: " + ", ".join(sorted(set(fb)))
+                    + " (see explain NOT_ON_GPU)")
+    if recs:
+        lines.append("recommendations:")
+        lines.extend(f"  - {r}" for r in recs)
+    return "\n".join(lines)

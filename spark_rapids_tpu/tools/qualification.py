@@ -1,0 +1,72 @@
+"""Qualification tool: how much of a workload would run on TPU?
+
+TPU analog of the reference's qualification tool (SURVEY.md §2.2-F:
+offline analysis of which plans/operators accelerate; mount empty,
+capability-built). Instead of parsing event logs, it runs the REAL
+override pass over a plan tree in dry-run and scores the outcome.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from ..config import RapidsConf
+from ..exec.base import TpuExec
+
+__all__ = ["qualify", "QualificationReport"]
+
+
+@dataclasses.dataclass
+class QualificationReport:
+    total_ops: int
+    on_device_ops: int
+    fallback_reasons: List[str]
+    score: float          # fraction of operators that accelerate
+
+    def render(self) -> str:
+        lines = [
+            "=== TPU qualification report ===",
+            f"operators on device : {self.on_device_ops}/{self.total_ops}"
+            f"  (score {self.score:.0%})",
+        ]
+        if self.fallback_reasons:
+            lines.append("not accelerated:")
+            lines.extend(f"  - {r}" for r in self.fallback_reasons)
+        else:
+            lines.append("fully accelerated: every operator runs on TPU")
+        rec = ("RECOMMENDED: this workload accelerates well"
+               if self.score >= 0.75 else
+               "PARTIAL: review the fallback reasons before migrating"
+               if self.score >= 0.3 else
+               "NOT RECOMMENDED: most operators fall back to CPU")
+        lines.append(rec)
+        return "\n".join(lines)
+
+
+def qualify(plan: TpuExec,
+            conf: Optional[RapidsConf] = None) -> QualificationReport:
+    """Dry-run the override pass (wrap + tag only — no execution, no
+    transition rewrite) and score device placement."""
+    from ..planner import TpuOverrides
+    ov = TpuOverrides(conf or RapidsConf())
+    meta = ov._wrap(plan)
+    ov._tag(meta)
+
+    total = 0
+    on_dev = 0
+    reasons: List[str] = []
+
+    def rec(m):
+        nonlocal total, on_dev
+        total += 1
+        if m.on_device:
+            on_dev += 1
+        else:
+            reasons.append(
+                f"{m.node.pretty_name()}: {'; '.join(m.reasons)}")
+        for c in m.children:
+            rec(c)
+
+    rec(meta)
+    return QualificationReport(total, on_dev, reasons,
+                               on_dev / max(total, 1))

@@ -1,0 +1,237 @@
+"""UDF compiler: Python lambdas -> engine expression trees.
+
+TPU analog of the reference's udf-compiler (JVM bytecode ->
+Catalyst expressions — SURVEY.md §2.2-F; mount empty,
+capability-built), built the Python-native way: instead of decompiling
+bytecode, the UDF is traced SYMBOLICALLY — it runs once over operator-
+overloading column proxies, and the operations it performs materialize
+as the engine's own Expression nodes, which then run on the device like
+any built-in expression (no per-row Python, no host fallback).
+
+Covers the same UDF subset the reference's compiler targets: arithmetic
+(+ - * / % **), comparisons, boolean logic (& | ~), conditionals via
+`where(cond, a, b)`, abs/min/max, and math functions exposed on the
+trace module. UDFs that branch on data (`if col > 0:`) or call
+unsupported functions raise TypeError during tracing and the caller
+falls back to a host UDF (spark.rapids.sql.udfCompiler.enabled).
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional, Sequence
+
+from .. import datatypes as dt
+from ..expr import (Abs, Add, And, Divide, EqualTo, GreaterThan,
+                    GreaterThanOrEqual, Greatest, If, IsNull, Least,
+                    LessThan, LessThanOrEqual, Literal, Multiply, Not,
+                    Or, Pmod, Pow, Remainder, Subtract, UnaryMinus)
+from ..expr.base import Expression
+
+__all__ = ["compile_udf", "TpuCompiledUDF", "trace_math"]
+
+
+def _lift(v) -> Expression:
+    if isinstance(v, SymbolicColumn):
+        return v.expr
+    if isinstance(v, Expression):
+        return v
+    return Literal(v)
+
+
+def _coerce_pair(a: Expression, b: Expression, fractional=False):
+    """Numeric widening so binary ops see equal types (the engine's
+    expressions are constructed type-resolved, like post-analysis
+    Catalyst). Unbound inputs (no dtype yet) pass through — bind the
+    inputs via compile_udf's `schema` to get analyzer-grade casts."""
+    from ..expr import Cast
+    try:
+        ta, tb = a.dtype, b.dtype
+    except TypeError:
+        return a, b
+    t = dt.common_type(ta, tb)
+    if fractional and dt.is_integral(t):
+        t = dt.FLOAT64  # Spark `/` casts integral operands to double
+    if ta != t:
+        a = Cast(a, t)
+    if tb != t:
+        b = Cast(b, t)
+    return a, b
+
+
+class SymbolicColumn:
+    """Operator-overloading proxy: applying Python operators builds the
+    equivalent engine expression."""
+
+    def __init__(self, expr: Expression):
+        self.expr = expr
+
+    # -- arithmetic --------------------------------------------------------
+    def _bin(self, other, cls, swap=False):
+        from ..expr import Divide
+        a, b = self.expr, _lift(other)
+        if swap:
+            a, b = b, a
+        a, b = _coerce_pair(a, b, fractional=cls is Divide)
+        return SymbolicColumn(cls(a, b))
+
+    def __add__(self, o):
+        return self._bin(o, Add)
+
+    def __radd__(self, o):
+        return self._bin(o, Add, swap=True)
+
+    def __sub__(self, o):
+        return self._bin(o, Subtract)
+
+    def __rsub__(self, o):
+        return self._bin(o, Subtract, swap=True)
+
+    def __mul__(self, o):
+        return self._bin(o, Multiply)
+
+    def __rmul__(self, o):
+        return self._bin(o, Multiply, swap=True)
+
+    def __truediv__(self, o):
+        return self._bin(o, Divide)
+
+    def __rtruediv__(self, o):
+        return self._bin(o, Divide, swap=True)
+
+    def __mod__(self, o):
+        return self._bin(o, Pmod)
+
+    def __pow__(self, o):
+        return self._bin(o, Pow)
+
+    def __neg__(self):
+        return SymbolicColumn(UnaryMinus(self.expr))
+
+    def __abs__(self):
+        return SymbolicColumn(Abs(self.expr))
+
+    # -- comparisons -------------------------------------------------------
+    def __lt__(self, o):
+        return self._bin(o, LessThan)
+
+    def __le__(self, o):
+        return self._bin(o, LessThanOrEqual)
+
+    def __gt__(self, o):
+        return self._bin(o, GreaterThan)
+
+    def __ge__(self, o):
+        return self._bin(o, GreaterThanOrEqual)
+
+    def __eq__(self, o):  # noqa: D105 — symbolic, intentionally
+        return self._bin(o, EqualTo)
+
+    def __ne__(self, o):
+        return SymbolicColumn(Not(self._bin(o, EqualTo).expr))
+
+    # -- boolean -----------------------------------------------------------
+    def __and__(self, o):
+        return self._bin(o, And)
+
+    def __rand__(self, o):
+        return self._bin(o, And, swap=True)
+
+    def __or__(self, o):
+        return self._bin(o, Or)
+
+    def __ror__(self, o):
+        return self._bin(o, Or, swap=True)
+
+    def __invert__(self):
+        return SymbolicColumn(Not(self.expr))
+
+    def is_null(self):
+        return SymbolicColumn(IsNull(self.expr))
+
+    # -- tracing guards ----------------------------------------------------
+    def __bool__(self):
+        raise TypeError(
+            "data-dependent Python control flow (`if col:`) cannot be "
+            "compiled; use trace_math.where(cond, a, b)")
+
+    def __iter__(self):
+        raise TypeError("cannot iterate a column inside a compiled UDF")
+
+    def __hash__(self):
+        return id(self)
+
+
+class _TraceMath:
+    """Math surface available inside compiled UDFs (`from
+    spark_rapids_tpu.tools.udf_compiler import trace_math as m`)."""
+
+    @staticmethod
+    def where(cond, a, b):
+        ae, be = _coerce_pair(_lift(a), _lift(b))
+        return SymbolicColumn(If(_lift(cond), ae, be))
+
+    @staticmethod
+    def minimum(a, b):
+        ae, be = _coerce_pair(_lift(a), _lift(b))
+        return SymbolicColumn(Least(ae, be))
+
+    @staticmethod
+    def maximum(a, b):
+        ae, be = _coerce_pair(_lift(a), _lift(b))
+        return SymbolicColumn(Greatest(ae, be))
+
+    def __getattr__(self, name):
+        from .. import expr as E
+        cls = {"sqrt": E.Sqrt, "exp": E.Exp, "log": E.Log,
+               "log10": E.Log10, "log2": E.Log2, "sin": E.Sin,
+               "cos": E.Cos, "tan": E.Tan, "floor": E.Floor,
+               "ceil": E.Ceil, "abs": E.Abs}.get(name)
+        if cls is None:
+            raise TypeError(f"math function {name!r} not compilable")
+
+        def apply(v):
+            return SymbolicColumn(cls(_lift(v)))
+        return apply
+
+
+trace_math = _TraceMath()
+
+
+class TpuCompiledUDF:
+    """Result of a successful compile: the expression tree plus the
+    original callable (kept for the CPU oracle / debugging)."""
+
+    def __init__(self, expr: Expression, fn: Callable):
+        self.expr = expr
+        self.fn = fn
+
+    def __repr__(self):
+        return f"TpuCompiledUDF({self.expr!r})"
+
+
+def compile_udf(fn: Callable, inputs: Sequence[Expression],
+                schema: Optional[dt.Schema] = None,
+                conf=None) -> Optional[TpuCompiledUDF]:
+    """Trace `fn` over symbolic columns built from `inputs`. With a
+    `schema`, inputs bind first so the trace inserts analyzer-grade
+    numeric casts. Returns None when the UDF is not compilable
+    (data-dependent branches, unsupported calls) — the caller keeps the
+    host fallback, matching the reference compiler's opt-out."""
+    from ..config import UDF_COMPILER_ENABLED, RapidsConf
+    if not (conf or RapidsConf()).get(UDF_COMPILER_ENABLED):
+        return None
+    from ..expr.base import bind_expr
+    if schema is not None:
+        inputs = [bind_expr(e, schema) for e in inputs]
+    args = [SymbolicColumn(e) for e in inputs]
+    try:
+        out = fn(*args)
+    except TypeError:
+        return None
+    except Exception:
+        return None
+    if isinstance(out, SymbolicColumn):
+        return TpuCompiledUDF(out.expr, fn)
+    if isinstance(out, (int, float, bool, str)):
+        return TpuCompiledUDF(Literal(out), fn)
+    return None

@@ -110,3 +110,73 @@ def test_multi_batch_source():
     plan = TpuFilterExec(GreaterThan(col("c0"), Literal(0)),
                          HostBatchSourceExec(rbs))
     assert_tpu_and_cpu_plan_equal(plan)
+
+
+# --- union / expand / sample ----------------------------------------------
+
+def test_union_all():
+    from spark_rapids_tpu.exec import TpuUnionExec
+    kids = [HostBatchSourceExec([gen_table([IntegerGen(), StringGen()],
+                                           n, seed=s)])
+            for n, s in [(80, 1), (50, 2), (120, 3)]]
+    plan = TpuUnionExec(kids)
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_expand_grouping_sets_shape():
+    from spark_rapids_tpu.exec import TpuExpandExec
+    from spark_rapids_tpu.expr import Literal
+    src = HostBatchSourceExec(
+        [gen_table([IntegerGen(min_val=0, max_val=5),
+                    IntegerGen(min_val=0, max_val=3),
+                    LongGen()], 150, seed=4)])
+    # ROLLUP(c0, c1)-style projections with a grouping-id literal
+    projections = [
+        [col("c0"), col("c1"), col("c2"), Literal(0, dt.INT32)],
+        [col("c0"), Literal(None, dt.INT32), col("c2"),
+         Literal(1, dt.INT32)],
+        [Literal(None, dt.INT32), Literal(None, dt.INT32), col("c2"),
+         Literal(3, dt.INT32)],
+    ]
+    plan = TpuExpandExec(projections, ["c0", "c1", "c2", "gid"], src)
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_expand_feeds_rollup_aggregate():
+    from spark_rapids_tpu.exec import TpuExpandExec
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.expr import Alias, Literal
+    from spark_rapids_tpu.expr.aggregates import Sum
+    src = HostBatchSourceExec(
+        [gen_table([IntegerGen(min_val=0, max_val=4), LongGen()], 200,
+                   seed=6)])
+    exp = TpuExpandExec(
+        [[col("c0"), col("c1"), Literal(0, dt.INT32)],
+         [Literal(None, dt.INT32), col("c1"), Literal(1, dt.INT32)]],
+        ["c0", "c1", "gid"], src)
+    plan = TpuHashAggregateExec([col("c0"), col("gid")],
+                                [Alias(Sum(col("c1")), "s")], exp)
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+@pytest.mark.parametrize("fraction", [0.0, 0.3, 1.0])
+def test_sample(fraction):
+    from spark_rapids_tpu.exec import TpuSampleExec
+    src = HostBatchSourceExec(
+        [gen_table([IntegerGen(), StringGen()], 150, seed=s)
+         for s in (1, 2)])
+    plan = TpuSampleExec(fraction, seed=42, child=src)
+    got = assert_tpu_and_cpu_plan_equal(plan)
+    if fraction == 0.0:
+        assert got.num_rows == 0
+    if fraction == 1.0:
+        assert got.num_rows == 300
+
+
+def test_sample_deterministic():
+    from spark_rapids_tpu.exec import TpuSampleExec
+    from spark_rapids_tpu.exec.base import ExecCtx, collect_arrow
+    src = HostBatchSourceExec([gen_table([IntegerGen()], 200, seed=9)])
+    a = collect_arrow(TpuSampleExec(0.5, 7, src), ExecCtx())
+    b = collect_arrow(TpuSampleExec(0.5, 7, src), ExecCtx())
+    assert a.to_pylist() == b.to_pylist()

@@ -315,3 +315,58 @@ def test_shuffle_store_bytes_in_ledger():
     out = collect_arrow(plan, ctx)
     assert out.num_rows == 1600
     assert mm.spill_bytes > 0, "shuffle store never hit the ledger"
+
+
+# --- broadcast nested loop join (non-equi for every type) ------------------
+
+def _bnlj_plan(jt, nl=60, nr=45):
+    from spark_rapids_tpu.exec.joins import TpuBroadcastNestedLoopJoinExec
+    left = HostBatchSourceExec(
+        [gen_table([IntegerGen(min_val=0, max_val=50, null_frac=0.1),
+                    LongGen(nullable=False)], nl, 21, names=["lk", "lv"]),
+         gen_table([IntegerGen(min_val=0, max_val=50, null_frac=0.1),
+                    LongGen(nullable=False)], nl // 2, 22,
+                   names=["lk", "lv"])])
+    right = HostBatchSourceExec(
+        [gen_table([IntegerGen(min_val=0, max_val=50, null_frac=0.1),
+                    LongGen(nullable=False)], nr, 23,
+                   names=["rk", "rv"])])
+    cond = GreaterThan(col("lk"), col("rk"))
+    return TpuBroadcastNestedLoopJoinExec(jt, left, right, cond)
+
+
+@pytest.mark.parametrize("jt", ALL_TYPES + ["cross"])
+def test_bnlj_non_equi_all_types(jt):
+    if jt == "cross":
+        plan = _bnlj_plan("cross")
+    else:
+        plan = _bnlj_plan(jt)
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True, label=jt)
+
+
+def test_bnlj_condition_with_strings_payload():
+    from spark_rapids_tpu.exec.joins import TpuBroadcastNestedLoopJoinExec
+    left = HostBatchSourceExec(
+        [gen_table([IntegerGen(min_val=0, max_val=20),
+                    StringGen(max_len=5)], 40, 31, names=["lk", "ls"])])
+    right = HostBatchSourceExec(
+        [gen_table([IntegerGen(min_val=0, max_val=20),
+                    StringGen(max_len=5)], 30, 32, names=["rk", "rs"])])
+    cond = GreaterThan(col("lk"), col("rk"))
+    plan = TpuBroadcastNestedLoopJoinExec("left_outer", left, right, cond)
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+def test_bnlj_empty_sides():
+    from spark_rapids_tpu.exec.joins import TpuBroadcastNestedLoopJoinExec
+    from spark_rapids_tpu import datatypes as _dt
+    schema = _dt.Schema([_dt.StructField("rk", _dt.INT32, True),
+                         _dt.StructField("rv", _dt.INT64, False)])
+    empty = HostBatchSourceExec([], schema=schema)
+    left = HostBatchSourceExec(
+        [gen_table([IntegerGen(), LongGen(nullable=False)], 30, 41,
+                   names=["lk", "lv"])])
+    cond = GreaterThan(col("lk"), col("rk"))
+    for jt in ("left_outer", "full_outer", "left_anti"):
+        plan = TpuBroadcastNestedLoopJoinExec(jt, left, empty, cond)
+        assert_tpu_and_cpu_plan_equal(plan, ignore_order=True, label=jt)

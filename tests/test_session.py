@@ -1,0 +1,127 @@
+"""Session / DataFrame facade tests — the product surface over the
+planner + exec pipeline (SURVEY.md §2.2-A plugin analog)."""
+import os
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import TpuSession, datatypes as dt
+from spark_rapids_tpu.expr import (Alias, GreaterThan, Literal,
+                                   UnresolvedColumn as col)
+from spark_rapids_tpu.expr.aggregates import Count, Sum
+
+
+@pytest.fixture
+def spark():
+    return TpuSession()
+
+
+def _df(spark, n=100):
+    return spark.create_dataframe({
+        "k": [i % 7 for i in range(n)],
+        "v": list(range(n)),
+        "s": [f"row{i % 5}" for i in range(n)],
+    })
+
+
+def test_select_filter_collect(spark):
+    out = (_df(spark)
+           .filter(GreaterThan(col("v"), Literal(50)))
+           .select("k", "v")
+           .collect())
+    assert out.num_rows == 49
+    assert out.column("v").to_pylist() == list(range(51, 100))
+
+
+def test_with_column_and_count(spark):
+    from spark_rapids_tpu.expr import Multiply
+    df = _df(spark).with_column("v2", Multiply(col("v"), Literal(2)))
+    assert "v2" in df.columns
+    assert df.count() == 100
+    got = df.collect()
+    assert got.column("v2").to_pylist()[:3] == [0, 2, 4]
+
+
+def test_group_by_agg_uses_shuffle_partitions(spark):
+    df = (_df(spark)
+          .group_by("k")
+          .agg(Alias(Sum(col("v")), "total"), Alias(Count(), "n")))
+    # plan shape: aggregate over a shuffle exchange with the conf's
+    # partition count (spark.sql.shuffle.partitions consumption)
+    assert "ShuffleExchangeExec" in df.explain("ALL") or \
+        "ShuffleExchange" in repr(df._node)
+    rows = {r["k"]: r for r in df.to_pylist()}
+    assert rows[0]["n"] == 15  # 0,7,...,98
+    assert rows[0]["total"] == sum(range(0, 100, 7))
+
+
+def test_join_orderby_limit(spark):
+    left = _df(spark)
+    right = spark.create_dataframe({
+        "k": list(range(7)), "name": [f"g{i}" for i in range(7)]})
+    out = (left.join(right, on="k")
+           .order_by("v", ascending=False)
+           .limit(3)
+           .collect())
+    assert out.column("v").to_pylist() == [99, 98, 97]
+    assert out.column("name").to_pylist() == ["g1", "g0", "g6"]
+
+
+def test_condition_only_join_routes_to_nlj(spark):
+    left = spark.create_dataframe({"a": [1, 5, 9]})
+    right = spark.create_dataframe({"b": [3, 7]})
+    df = left.join(right, how="inner",
+                   condition=GreaterThan(col("a"), col("b")))
+    assert "NestedLoop" in type(df._node).__name__
+    got = sorted((r["a"], r["b"]) for r in df.to_pylist())
+    assert got == [(5, 3), (9, 3), (9, 7)]
+
+
+def test_union_sample_cache(spark):
+    df = _df(spark, 50).union(_df(spark, 50))
+    assert df.count() == 100
+    cached = df.cache()
+    a = cached.collect()
+    b = cached.collect()  # replays from the cache exec
+    assert a.to_pylist() == b.to_pylist()
+    from spark_rapids_tpu.session import TpuCacheExec
+    assert isinstance(cached._node, TpuCacheExec)
+    assert cached._node._entries is not None  # materialized once
+
+
+def test_explode(spark):
+    df = spark.create_dataframe(pa.table({
+        "id": pa.array([1, 2], pa.int32()),
+        "xs": pa.array([[10, 20], [30]], pa.list_(pa.int64()))}))
+    out = df.explode("xs").collect()
+    assert out.column("col").to_pylist() == [10, 20, 30]
+    assert out.column("id").to_pylist() == [1, 1, 2]
+
+
+def test_case_sensitivity_conf(spark):
+    df = _df(spark)
+    # default: case-insensitive resolution (spark.sql.caseSensitive)
+    assert df.select("K").collect().num_rows == 100
+    strict = TpuSession({"spark.sql.caseSensitive": True})
+    df2 = _df(strict)
+    with pytest.raises(Exception):
+        df2.select("K").collect()
+
+
+def test_read_write_roundtrip(spark, tmp_path):
+    df = _df(spark)
+    files = df.write_parquet(str(tmp_path / "out"))
+    assert files and all(os.path.exists(f) for f in files)
+    back = spark.read_parquet(files)
+    got = back.collect().sort_by("v")
+    assert got.column("v").to_pylist() == list(range(100))
+
+
+def test_range(spark):
+    assert spark.range(10).collect().column("id").to_pylist() == \
+        list(range(10))
+
+
+def test_explain_renders(spark):
+    text = _df(spark).filter(GreaterThan(col("v"), Literal(1))).explain()
+    assert "will run on TPU" in text

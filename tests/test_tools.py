@@ -1,0 +1,127 @@
+"""Tooling tier tests: udf-compiler, qualification, profiling,
+supported-ops generation (reference: udf-compiler + tools modules —
+SURVEY.md §2.2-F; capability-built, mount empty)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import datatypes as dt
+from spark_rapids_tpu.exec import HostBatchSourceExec, TpuProjectExec
+from spark_rapids_tpu.expr import Alias, UnresolvedColumn as col
+from spark_rapids_tpu.tools import (compile_udf, generate_supported_ops,
+                                    profile_report, qualify)
+
+from asserts import assert_tpu_and_cpu_plan_equal
+from data_gen import DoubleGen, IntegerGen, LongGen, StringGen, gen_table
+
+
+def source(gens, n=120, seed=3):
+    return HostBatchSourceExec([gen_table(gens, n, seed)])
+
+
+def compiled(fn, cols_, src):
+    return compile_udf(fn, cols_, schema=src.output_schema)
+
+
+# --- udf compiler ----------------------------------------------------------
+
+def test_udf_compile_arithmetic():
+    src = source([IntegerGen(), IntegerGen()])
+    c = compiled(lambda x, y: (x + y) * 2 - x / 4,
+                 [col("c0"), col("c1")], src)
+    assert c is not None
+    plan = TpuProjectExec([Alias(c.expr, "out")], src)
+    assert_tpu_and_cpu_plan_equal(plan, approx_float=True)
+
+
+def test_udf_compile_conditional_and_math():
+    from spark_rapids_tpu.tools.udf_compiler import trace_math as m
+
+    def udf(x, y):
+        return m.where(x > y, m.sqrt(abs(x)), y * 1.5)
+
+    src = source([DoubleGen(), DoubleGen()])
+    c = compiled(udf, [col("c0"), col("c1")], src)
+    assert c is not None
+    plan = TpuProjectExec([Alias(c.expr, "out")], src)
+    assert_tpu_and_cpu_plan_equal(plan, approx_float=True)
+
+
+def test_udf_compile_comparison_chain():
+    src = source([IntegerGen(null_frac=0.2)])
+    c = compiled(lambda x: (x > 3) & (x < 100) | (x == -1),
+                 [col("c0")], src)
+    assert c is not None
+    plan = TpuProjectExec([Alias(c.expr, "flag")], src)
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_udf_data_dependent_branch_falls_back():
+    def bad(x):
+        if x > 0:  # python branch on data: not compilable
+            return x
+        return -x
+    assert compile_udf(bad, [col("c0")]) is None
+
+
+def test_udf_unsupported_call_falls_back():
+    import math
+    assert compile_udf(lambda x: math.erf(x), [col("c0")]) is None
+
+
+# --- qualification ---------------------------------------------------------
+
+def test_qualification_full_acceleration():
+    from spark_rapids_tpu.expr import Add, Literal
+    plan = TpuProjectExec([Alias(Add(col("c0"), Literal(1, dt.INT32)),
+                                 "x")], source([IntegerGen()]))
+    rep = qualify(plan)
+    assert rep.score == 1.0
+    assert "fully accelerated" in rep.render()
+
+
+def test_qualification_reports_fallbacks():
+    from spark_rapids_tpu.exec.sort import SortOrder, TpuSortExec
+    from data_gen import StructGen
+    plan = TpuSortExec(
+        [SortOrder(col("c0"))],
+        source([StructGen([("a", IntegerGen())]), LongGen()]))
+    rep = qualify(plan)
+    assert rep.score < 1.0
+    assert any("SortExec" in r for r in rep.fallback_reasons)
+
+
+# --- profiling -------------------------------------------------------------
+
+def test_profile_report_renders():
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.planner import overrides
+    conf = RapidsConf({"spark.rapids.sql.metrics.level": "DEBUG"})
+    plan = TpuHashAggregateExec(
+        [col("c0")], [Alias(Sum(col("c1")), "s")],
+        source([IntegerGen(min_val=0, max_val=5), LongGen()], 200))
+    pp = overrides(plan, conf)
+    pp.collect()
+    rep = profile_report(pp)
+    assert "TPU profile" in rep
+    assert "HashAggregateExec" in rep
+    assert "hotspots" in rep
+
+
+# --- supported-ops doc + config validation ---------------------------------
+
+def test_generate_supported_ops():
+    doc = generate_supported_ops()
+    for name in ("TpuHashAggregateExec", "TpuWindowExec",
+                 "TpuGenerateExec", "TpuShuffleExchangeExec",
+                 "XxHash64", "WindowExpression", "GetStructField"):
+        assert name in doc, name
+
+
+def test_validate_configs_no_dead_confs():
+    from spark_rapids_tpu.tools.api_validation import validate_configs
+    out = validate_configs()
+    assert len(out["checked"]) > 30
+    # every registered conf must be consumed somewhere in the package
+    assert out["unused"] == [], out["unused"]
