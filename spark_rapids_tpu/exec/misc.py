@@ -152,33 +152,32 @@ class TpuSampleExec(UnaryExec):
     def describe(self):
         return f"SampleExec [fraction={self.fraction} seed={self.seed}]"
 
+    def _keep_mask(self, pos, xp):
+        """ONE hash/threshold body for both paths (the dual-run contract
+        needs them bit-identical): pos is int64 global row positions in
+        the given array module."""
+        from ..ops.hash import murmur3_int64
+        lo = (pos & 0xffffffff).astype(xp.uint32)
+        hi = (pos >> 32).astype(xp.uint32)
+        h = murmur3_int64((lo, hi), xp.uint32(self.seed & 0xffffffff), xp)
+        return h.astype(xp.uint32).astype(xp.int64) < self._threshold
+
     def _keep_mask_np(self, start: int, n: int):
         import numpy as np
-        from ..ops.hash import murmur3_int64
-        pos = np.arange(start, start + n, dtype=np.int64)
         err = np.seterr(over="ignore")
-        lo = (pos & 0xffffffff).astype(np.uint32)
-        hi = (pos >> 32).astype(np.uint32)
-        h = murmur3_int64((lo, hi), np.uint32(self.seed & 0xffffffff), np)
+        out = self._keep_mask(
+            np.arange(start, start + n, dtype=np.int64), np)
         np.seterr(**err)
-        return h.astype(np.uint64).astype(np.int64) < self._threshold
+        return out
 
     def execute(self, ctx: ExecCtx):
         from ..ops.gather import compact_batch
-        from ..ops.hash import murmur3_int64
         op_time = ctx.metric(self, "opTime")
         start = 0
 
         def keep_fn(start_, batch, ectx):
-            cap = batch.capacity
-            pos = start_ + jnp.arange(cap, dtype=jnp.int64)
-            lo = (pos & 0xffffffff).astype(jnp.uint32)
-            hi = (pos >> 32).astype(jnp.uint32)
-            h = murmur3_int64((lo, hi),
-                              jnp.uint32(self.seed & 0xffffffff), jnp)
-            keep = h.astype(jnp.uint32).astype(jnp.int64) \
-                < self._threshold
-            return compact_batch(batch, keep)
+            pos = start_ + jnp.arange(batch.capacity, dtype=jnp.int64)
+            return compact_batch(batch, self._keep_mask(pos, jnp))
 
         if self._jitted is None:
             self._jitted = jax.jit(keep_fn, static_argnums=2)

@@ -64,7 +64,13 @@ def split_batch(batch):
     """Split a device batch at the capacity midpoint into two half-capacity
     batches (the GpuSplitAndRetryOOM halving). Fixed-width lanes are static
     slices; string chars/offsets stay shared (offsets are absolute), so the
-    split itself allocates only the halved fixed-width lanes."""
+    split itself allocates only the halved fixed-width lanes.
+
+    Known limitation: the split is positional, not selection-aware — a
+    lazy-filter batch whose live rows all fall in one half retries that
+    half with the same live footprint (the halving still shrinks the
+    STATIC capacity, which is what bounds the retried program's
+    allocations, so the retry remains productive)."""
     from .columnar.batch import TpuBatch
     import jax.numpy as jnp
     cap = batch.capacity
@@ -115,6 +121,10 @@ class SpillableBatch:
         self.nbytes = batch.device_size_bytes()
         self.host_nbytes = 0
         self.spill_count = 0
+        # serializes THIS batch's tier transitions (spill / to-disk /
+        # read-back) against concurrent tasks, without holding the
+        # manager's ledger lock across device/disk IO
+        self._state_lock = threading.RLock()
 
     @property
     def on_device(self) -> bool:
@@ -129,41 +139,43 @@ class SpillableBatch:
         and credit the ledger; host pressure cascades to the disk tier
         (cascade=False when the caller already holds the ledger lock —
         disk IO must never run under it)."""
-        if self._device is None:
-            return
-        from .columnar.arrow_bridge import device_to_arrow
-        self._host = device_to_arrow(self._device)
-        self._device = None
-        self.spill_count += 1
-        self.host_nbytes = self._host.nbytes
-        with self._mgr._lock:
-            if id(self) in self._mgr._catalog:
-                self._mgr.device_bytes -= self.nbytes
-                self._mgr.spill_bytes += self.nbytes
-                self._mgr.host_bytes += self.host_nbytes
+        with self._state_lock:
+            if self._device is None:
+                return
+            from .columnar.arrow_bridge import device_to_arrow
+            self._host = device_to_arrow(self._device)
+            self._device = None
+            self.spill_count += 1
+            self.host_nbytes = self._host.nbytes
+            with self._mgr._lock:
+                if id(self) in self._mgr._catalog:
+                    self._mgr.device_bytes -= self.nbytes
+                    self._mgr.spill_bytes += self.nbytes
+                    self._mgr.host_bytes += self.host_nbytes
         if cascade:
             self._mgr._evict_host_to_disk()
 
     def spill_to_disk(self):
         """Host Arrow -> Arrow IPC file in spark.rapids.memory.spillDir
         (disk tier, SURVEY.md:143)."""
-        if self._host is None or self._disk_path is not None:
-            return
-        import os
-        import uuid
+        with self._state_lock:
+            if self._host is None or self._disk_path is not None:
+                return
+            import os
+            import uuid
 
-        import pyarrow as pa
-        os.makedirs(self._mgr.spill_dir, exist_ok=True)
-        path = os.path.join(self._mgr.spill_dir,
-                            f"spill-{uuid.uuid4().hex}.arrow")
-        with pa.OSFile(path, "wb") as f, \
-                pa.ipc.new_file(f, self._host.schema) as w:
-            w.write_batch(self._host)
-        self._disk_path = path
-        self._host = None
-        with self._mgr._lock:
-            self._mgr.host_bytes -= self.host_nbytes
-            self._mgr.disk_spill_bytes += self.host_nbytes
+            import pyarrow as pa
+            os.makedirs(self._mgr.spill_dir, exist_ok=True)
+            path = os.path.join(self._mgr.spill_dir,
+                                f"spill-{uuid.uuid4().hex}.arrow")
+            with pa.OSFile(path, "wb") as f, \
+                    pa.ipc.new_file(f, self._host.schema) as w:
+                w.write_batch(self._host)
+            self._disk_path = path
+            self._host = None
+            with self._mgr._lock:
+                self._mgr.host_bytes -= self.host_nbytes
+                self._mgr.disk_spill_bytes += self.host_nbytes
 
     def _read_disk(self):
         import os
@@ -184,28 +196,30 @@ class SpillableBatch:
     def get_host(self):
         """Host Arrow view (spills if still on device; reads back the
         disk tier if spilled further)."""
-        if self._host is None and self._disk_path is not None:
-            self._host = self._read_disk()
-            with self._mgr._lock:
-                self._mgr.host_bytes += self.host_nbytes
-        if self._host is None:
-            from .columnar.arrow_bridge import device_to_arrow
-            self._host = device_to_arrow(self._device)
-        return self._host
+        with self._state_lock:
+            if self._host is None and self._disk_path is not None:
+                self._host = self._read_disk()
+                with self._mgr._lock:
+                    self._mgr.host_bytes += self.host_nbytes
+            if self._host is None:
+                from .columnar.arrow_bridge import device_to_arrow
+                self._host = device_to_arrow(self._device)
+            return self._host
 
     def get(self):
         """The device batch, re-uploading (and re-charging the ledger) if
         spilled."""
-        if self._device is None:
-            from .columnar.arrow_bridge import arrow_to_device
-            host = self.get_host()
-            self._mgr._charge(self, self.nbytes)
-            self._device = arrow_to_device(host, self._schema)
-            self._host = None
-            with self._mgr._lock:
-                self._mgr.host_bytes -= self.host_nbytes
-        self._mgr._touch(self)
-        return self._device
+        with self._state_lock:
+            if self._device is None:
+                from .columnar.arrow_bridge import arrow_to_device
+                host = self.get_host()
+                self._mgr._charge(self, self.nbytes)
+                self._device = arrow_to_device(host, self._schema)
+                self._host = None
+                with self._mgr._lock:
+                    self._mgr.host_bytes -= self.host_nbytes
+            self._mgr._touch(self)
+            return self._device
 
     def pin(self):
         """Keep resident (refcounted) — route through the owning manager,

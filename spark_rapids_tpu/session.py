@@ -43,8 +43,15 @@ class TpuCacheExec(UnaryExec):
     def execute(self, ctx: ExecCtx):
         if self._entries is None:
             entries = []
-            for b in self.child.execute(ctx):
-                entries.append(ctx.mm.register(b))
+            try:
+                for b in self.child.execute(ctx):
+                    entries.append(ctx.mm.register(b))
+            except BaseException:
+                # partial materialization must not leak catalog entries
+                # into the process-shared manager
+                for sb in entries:
+                    sb.release()
+                raise
             self._entries = entries
             import weakref
             for sb in entries:

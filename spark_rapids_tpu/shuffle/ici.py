@@ -172,12 +172,15 @@ class _IciWriter(ShuffleWriteHandle):
                     f"({f.dtype.simple_string()}) cannot ride the ICI "
                     "collective yet (fixed-width and string lanes only)")
         nbytes = batch.device_size_bytes()
-        if nbytes > self._t.max_payload:
+        # the conf is a PER-SHARD ceiling; a map batch spreads over the
+        # whole mesh, so the whole-batch bound is ceiling x mesh size
+        limit = self._t.max_payload * self._t.ndev
+        if nbytes > limit:
             raise ValueError(
                 f"map batch of {nbytes} bytes exceeds "
                 f"spark.rapids.shuffle.ici.maxPartitionBytes "
-                f"({self._t.max_payload}); emit smaller map batches or "
-                "raise the conf")
+                f"({self._t.max_payload}) x mesh size {self._t.ndev}; "
+                "emit smaller map batches or raise the conf")
         with self._t._lock:
             self._t._pending[self._sid].append((self._mid, batch, pids))
 

@@ -99,7 +99,6 @@ def validate_configs() -> Dict[str, List[str]]:
     for line in config_src.splitlines():
         if ".get(" in line or "self._settings" in line:
             blob += "\n" + line
-    registry = C.REGISTRY if hasattr(C, "REGISTRY") else None
     unused: List[str] = []
     names: List[Tuple[str, str]] = []
     for attr in dir(C):
@@ -115,5 +114,4 @@ def validate_configs() -> Dict[str, List[str]]:
         # anywhere outside config.py
         if attr not in blob and key not in blob:
             unused.append(key)
-    del registry
     return {"checked": [k for _, k in names], "unused": unused}

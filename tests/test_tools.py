@@ -125,3 +125,16 @@ def test_validate_configs_no_dead_confs():
     assert len(out["checked"]) > 30
     # every registered conf must be consumed somewhere in the package
     assert out["unused"] == [], out["unused"]
+
+
+def test_supported_ops_doc_in_sync():
+    """SUPPORTED_OPS.md is generated, never handwritten: the committed
+    file must match the live registry (regenerate with
+    python -c "from spark_rapids_tpu.tools import generate_supported_ops;
+    print(generate_supported_ops())")."""
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "SUPPORTED_OPS.md")) as f:
+        committed = f.read().rstrip("\n")
+    assert committed == generate_supported_ops().rstrip("\n"), \
+        "SUPPORTED_OPS.md is stale; regenerate it"
