@@ -328,13 +328,44 @@ class TpuHashAggregateExec(UnaryExec):
 
     def _execute_single_pass(self, ctx: ExecCtx):
         """collect_* cannot partial/merge (variable-length buffers have
-        no device concat): group the WHOLE input in one pass. In-core
-        only — inputs beyond the HBM budget fall back via the planner."""
+        no device concat): group the WHOLE input in one pass. The input
+        accumulates as spillable catalog entries, and when its total
+        exceeds the HBM budget (the one-pass concat+sort cannot fit) the
+        exec reroutes the ALREADY-PRODUCED batches (downloaded, not
+        recomputed) plus the rest of the device stream into the CPU
+        grouping and uploads the result — a runtime gate, since
+        tpu_supported() sees only types (ADVICE r3 #4). The threshold is
+        budget/2: the one-pass path concats a second full copy of the
+        input off-ledger."""
         if self._jit_single is None:
             self._jit_single = jax.jit(self._single_pass, static_argnums=1)
         op_time = ctx.metric(self, "opTime")
-        batches = list(fused_batches(self, ctx))
+        from ..columnar.arrow_bridge import device_to_arrow
+        sbs, total = [], 0
+        over = False
+        stream = fused_batches(self, ctx)
+        for b in stream:
+            total += b.device_size_bytes()
+            sbs.append(ctx.mm.register(b))
+            if total > ctx.mm.budget // 2:
+                over = True
+                break
+        if over:
+            def downloaded():
+                for sb in sbs:
+                    rb = sb.get_host()
+                    sb.release()
+                    yield rb
+                for b in stream:  # continue the same device stream
+                    yield device_to_arrow(b)
+            for rb in self._cpu_aggregate(downloaded(), ctx):
+                yield arrow_to_device(rb, self._schema)
+            return
         t0 = time.perf_counter()
+        batches = []
+        for sb in sbs:
+            batches.append(sb.get())
+            sb.release()
         if not batches:
             if self.group_exprs:
                 return
@@ -382,7 +413,12 @@ class TpuHashAggregateExec(UnaryExec):
     # --- CPU oracle -------------------------------------------------------
 
     def execute_cpu(self, ctx: ExecCtx):
-        rbs = list(self.child.execute_cpu(ctx))
+        yield from self._cpu_aggregate(self.child.execute_cpu(ctx), ctx)
+
+    def _cpu_aggregate(self, rbs, ctx: ExecCtx):
+        """CPU grouping over an iterable of RecordBatches in the child's
+        output schema (the oracle body; also the over-budget collect_*
+        fallback's sink for already-computed device batches)."""
         groups: Dict[tuple, list] = {}
         key_values: Dict[tuple, tuple] = {}
 

@@ -156,12 +156,15 @@ class TpuShuffleExchangeExec(UnaryExec):
                 b = shrink_batch(TpuBatch(b.columns, b.schema, n), k)
             return device_to_arrow(b)
 
-        batches = list(self.child.execute(ctx))
-        self.partitioning.compute_bounds(
-            [prefix_sample(b) for b in batches], ctx.eval_ctx)
-        # the materialized child is registered spillable for the replay:
-        # a child larger than HBM spills instead of OOMing here
-        sbs = [ctx.mm.register(b) for b in batches]
+        # each batch registers spillable AS PRODUCED, so a child larger
+        # than HBM spills instead of OOMing during materialization too
+        # (the sample downloads the prefix before the batch can be
+        # evicted; replay re-uploads on demand) — ADVICE r3 #3
+        sbs, samples = [], []
+        for b in self.child.execute(ctx):
+            samples.append(prefix_sample(b))
+            sbs.append(ctx.mm.register(b))
+        self.partitioning.compute_bounds(samples, ctx.eval_ctx)
 
         def replay():
             for sb in sbs:

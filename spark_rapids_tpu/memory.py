@@ -134,33 +134,52 @@ class SpillableBatch:
     def on_disk(self) -> bool:
         return self._disk_path is not None
 
-    def spill(self, cascade: bool = True):
+    def spill(self, cascade: bool = True, best_effort: bool = False):
         """Download to host Arrow, drop the device buffers (XLA frees),
-        and credit the ledger; host pressure cascades to the disk tier
-        (cascade=False when the caller already holds the ledger lock —
-        disk IO must never run under it)."""
-        with self._state_lock:
+        and credit the ledger; host pressure cascades to the disk tier.
+
+        Lock order: this batch's _state_lock, then (briefly) the ledger
+        lock. Eviction paths pass ``best_effort=True``: the state lock is
+        only try-acquired, so a thread that already holds ANOTHER batch's
+        state lock (get()/register mid-flight) can never enter a
+        hold-and-wait cycle across batches — a busy batch is simply a
+        poor spill victim and is skipped (ADVICE r3 #1)."""
+        acquired = self._state_lock.acquire(blocking=not best_effort)
+        if not acquired:
+            return
+        try:
             if self._device is None:
                 return
             from .columnar.arrow_bridge import device_to_arrow
-            self._host = device_to_arrow(self._device)
-            self._device = None
-            self.spill_count += 1
-            self.host_nbytes = self._host.nbytes
+            host = device_to_arrow(self._device)
             with self._mgr._lock:
-                if id(self) in self._mgr._catalog:
-                    self._mgr.device_bytes -= self.nbytes
-                    self._mgr.spill_bytes += self.nbytes
-                    self._mgr.host_bytes += self.host_nbytes
+                if id(self) not in self._mgr._catalog:
+                    return  # released concurrently; drop the download
+                self._host = host
+                self._device = None
+                self.spill_count += 1
+                self.host_nbytes = host.nbytes
+                self._mgr.device_bytes -= self.nbytes
+                self._mgr.spill_bytes += self.nbytes
+                self._mgr.host_bytes += self.host_nbytes
+        finally:
+            self._state_lock.release()
         if cascade:
             self._mgr._evict_host_to_disk()
 
-    def spill_to_disk(self):
+    def spill_to_disk(self, best_effort: bool = False):
         """Host Arrow -> Arrow IPC file in spark.rapids.memory.spillDir
-        (disk tier, SURVEY.md:143)."""
-        with self._state_lock:
+        (disk tier, SURVEY.md:143). best_effort: see spill()."""
+        acquired = self._state_lock.acquire(blocking=not best_effort)
+        if not acquired:
+            return
+        try:
             if self._host is None or self._disk_path is not None:
                 return
+            with self._mgr._lock:
+                # released concurrently: don't write an orphan spill file
+                if id(self) not in self._mgr._catalog:
+                    return
             import os
             import uuid
 
@@ -176,6 +195,8 @@ class SpillableBatch:
             with self._mgr._lock:
                 self._mgr.host_bytes -= self.host_nbytes
                 self._mgr.disk_spill_bytes += self.host_nbytes
+        finally:
+            self._state_lock.release()
 
     def _read_disk(self):
         import os
@@ -230,15 +251,19 @@ class SpillableBatch:
         self._mgr.unpin(self)
 
     def release(self):
-        self._mgr._release(self)
-        if self._disk_path is not None:
-            import contextlib
-            import os
-            with contextlib.suppress(OSError):
-                os.unlink(self._disk_path)
-            self._disk_path = None
-        self._device = None
-        self._host = None
+        # under the state lock: a concurrent spill()/spill_to_disk() must
+        # not write files or move tiers while the batch is being dropped
+        # (ADVICE r3 #2)
+        with self._state_lock:
+            self._mgr._release(self)
+            if self._disk_path is not None:
+                import contextlib
+                import os
+                with contextlib.suppress(OSError):
+                    os.unlink(self._disk_path)
+                self._disk_path = None
+            self._device = None
+            self._host = None
 
 
 class DeviceMemoryManager:
@@ -358,8 +383,7 @@ class DeviceMemoryManager:
                 # the allocation site being reported
                 self._alloc_sites[id(sb)] = "".join(
                     traceback.format_stack(limit=6)[:-1]).strip()
-            self._evict_to_fit()
-        self._evict_host_to_disk()  # disk IO outside the ledger lock
+        self._evict_to_fit(exclude=id(sb) if pinned else None)
         self._debug("register", sb)
         return sb
 
@@ -367,8 +391,11 @@ class DeviceMemoryManager:
         with self._lock:
             self.device_bytes += nbytes
             self._catalog[id(sb)] = sb
-            self._evict_to_fit(exclude=id(sb))
-        self._evict_host_to_disk()  # disk IO outside the ledger lock
+        # exclude this batch from BOTH eviction tiers: the caller
+        # (get()) holds its state lock, and a same-thread best-effort
+        # acquire on an RLock would succeed — the batch would tier
+        # itself to disk mid-re-upload and skew the host ledger
+        self._evict_to_fit(exclude=id(sb))
 
     def _touch(self, sb: SpillableBatch):
         with self._lock:
@@ -386,32 +413,59 @@ class DeviceMemoryManager:
             self._alloc_sites.pop(id(sb), None)
         self._debug("release", sb)
 
-    def _evict_host_to_disk(self):
+    def _evict_host_to_disk(self, exclude: Optional[int] = None):
         """Cascade the host tier to disk when past
         spark.rapids.memory.host.spillStorageSize (the reference's
-        host-store overflow-to-disk ladder)."""
+        host-store overflow-to-disk ladder). Victim state locks are only
+        try-acquired (see SpillableBatch.spill lock-order note);
+        ``exclude`` shields the batch the calling thread itself holds."""
         with self._lock:
             if self.host_bytes <= self.host_limit:
                 return
             victims = [sb for sb in self._catalog.values()
-                       if sb._host is not None and not sb.on_device]
+                       if sb._host is not None and not sb.on_device
+                       and id(sb) != exclude]
         for sb in victims:
             if self.host_bytes <= self.host_limit:
                 break
-            sb.spill_to_disk()
+            sb.spill_to_disk(best_effort=True)
 
-    def _evict_to_fit(self, exclude: Optional[int] = None):
-        """LRU device->host spill until under budget (the
-        DeviceMemoryEventHandler synchronous-spill analog)."""
-        if self.device_bytes <= self.budget:
-            return
-        for key in list(self._catalog):
-            if self.device_bytes <= self.budget:
+    def _select_victims(self, exclude: Optional[int] = None) \
+            -> List[SpillableBatch]:
+        """Pick LRU device->host spill victims. Called under the ledger
+        lock; the spills themselves (device downloads) run OUTSIDE it via
+        _spill_victims — holding the ledger lock across device IO both
+        serialized unrelated tasks and inverted the lock order against
+        get()/_charge (ADVICE r3 #1)."""
+        victims: List[SpillableBatch] = []
+        projected = self.device_bytes
+        if projected <= self.budget:
+            return victims
+        for key, sb in self._catalog.items():
+            if projected <= self.budget:
                 break
             if key == exclude or self._pin_counts.get(key, 0) > 0:
                 continue
-            # no disk cascade here: the ledger lock is held
-            self._catalog[key].spill(cascade=False)
+            if sb.on_device:
+                victims.append(sb)
+                projected -= sb.nbytes
+        return victims
+
+    @staticmethod
+    def _spill_victims(victims: List[SpillableBatch]):
+        for v in victims:
+            # best_effort: skip victims whose state lock is held by a
+            # concurrent task (they are being used right now anyway)
+            v.spill(cascade=False, best_effort=True)
+
+    def _evict_to_fit(self, exclude: Optional[int] = None):
+        """The eviction protocol: select under the ledger lock, spill
+        outside it, cascade host->disk. Shared by register/_charge and
+        direct pressure-relief callers."""
+        with self._lock:
+            victims = self._select_victims(exclude)
+        self._spill_victims(victims)
+        self._evict_host_to_disk(exclude=exclude)
 
     def pin(self, sb: SpillableBatch):
         """Refcounted: a batch shared by several consumers (a broadcast
