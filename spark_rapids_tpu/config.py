@@ -192,6 +192,34 @@ MAX_PARTITION_BYTES = register(
     "spark.sql.files.maxPartitionBytes", 128 << 20,
     "Split files into partitions of at most this many bytes.",
     conv=_bytes_conv)
+# --- AQE ------------------------------------------------------------------
+ADAPTIVE_ENABLED = register(
+    "spark.sql.adaptive.enabled", False,
+    "Adaptive re-planning at shuffle stage boundaries (partition "
+    "coalescing + skew split). Default OFF here: the stats readback is "
+    "a host sync, which permanently degrades tunneled devices to "
+    "synchronous dispatch; co-located deployments should enable it.")
+ADAPTIVE_COALESCE = register(
+    "spark.sql.adaptive.coalescePartitions.enabled", True,
+    "With AQE: merge adjacent shuffle partitions below the advisory "
+    "size into one device batch (GpuShuffleCoalesceExec analog).")
+ADAPTIVE_ADVISORY_BYTES = register(
+    "spark.sql.adaptive.advisoryPartitionSizeInBytes", 64 << 20,
+    "Target post-shuffle partition size for AQE coalescing/splitting.",
+    conv=_bytes_conv)
+ADAPTIVE_SKEW_FACTOR = register(
+    "spark.sql.adaptive.skewJoin.skewedPartitionFactor", 5,
+    "With AQE: a partition this many times the median is skewed.")
+ADAPTIVE_SKEW_THRESHOLD = register(
+    "spark.sql.adaptive.skewJoin.skewedPartitionThresholdInBytes",
+    256 << 20,
+    "With AQE: minimum size for skew handling to kick in.",
+    conv=_bytes_conv)
+SCAN_PREFETCH_BATCHES = register(
+    "spark.rapids.sql.scan.prefetchBatches", 2,
+    "Decoded batches uploaded ahead of the consumer: host->device "
+    "transfer of batch N+1 overlaps device compute on batch N "
+    "(SURVEY.md §7.3.4). 0 disables the upload pipeline.")
 
 # --- UDF ------------------------------------------------------------------
 UDF_COMPILER_ENABLED = register(

@@ -37,14 +37,11 @@ from ..ops.sort_keys import normalize_float_key_col as _normalize_float_keys
 
 
 def _segment_starts(seg: jax.Array) -> jax.Array:
-    """starts[g] = first sorted position of segment g. seg is sorted, so
-    group starts are the boundary positions, and the g-th boundary is a
-    stream compaction — sort-based, no scatter (slow on TPU)."""
-    from ..ops.gather import compaction_indices
-    boundary = jnp.concatenate([jnp.ones((1,), jnp.bool_),
-                                seg[1:] != seg[:-1]])
-    starts, _ = compaction_indices(boundary)
-    return starts
+    """starts[g] = first sorted position of segment g — a searchsorted
+    over the sorted ids (ops/segments.py), replacing the former
+    compaction that paid a full 2-lane sort per aggregate batch."""
+    from ..ops.segments import segment_starts_sorted
+    return segment_starts_sorted(seg, seg.shape[0])
 
 
 def _unalias(e: Expression) -> Tuple[AggregateFunction, str]:
@@ -246,7 +243,9 @@ class TpuHashAggregateExec(UnaryExec):
                     break
                 group.append(partials.pop(0))
                 gbytes += nb
-            merged = self._jit_merge(concat_batches(group), ctx.eval_ctx)
+            from ..ops.concat import concat_batches_bounded
+            merged = self._jit_merge(concat_batches_bounded(group),
+                                     ctx.eval_ctx)
             ng = merged.num_rows  # sync: shrink to live groups
             merged = shrink_batch(merged, bucket_rows(max(ng, 128)))
             partials.append(merged)
@@ -403,7 +402,12 @@ class TpuHashAggregateExec(UnaryExec):
                 > ctx.mm.budget // 4:
             merged = self._merge_bounded(partials, ctx)
         else:
-            merged = concat_batches(partials)
+            # capacity-bounded concat: sync-free (no row-count readback).
+            # The first readback permanently degrades tunneled devices to
+            # synchronous dispatch, so the whole partial->final pipeline
+            # must not sync; the final's sort tolerates the extra padding
+            from ..ops.concat import concat_batches_bounded
+            merged = concat_batches_bounded(partials)
         out = self._jit_final(merged, ctx.eval_ctx)
         if ctx.sync_metrics:
             out.block_until_ready()

@@ -1,0 +1,131 @@
+"""Adaptive query execution at shuffle stage boundaries.
+
+TPU analog of the reference's AQE integration (`GpuShuffleCoalesceExec`,
+`GpuCustomShuffleReaderExec`, skew-join handling — SURVEY.md:161, 228;
+reference mount empty). Spark's AQE re-plans whole stages on the driver;
+this engine's equivalent decision point is the materialized shuffle
+stage: `TpuAQEShuffleReadExec` sits above an exchange, reads the
+per-partition byte statistics the transport gathered during the write
+phase, and
+
+- COALESCES runs of adjacent partitions below the advisory size into a
+  single device batch (fewer, fuller programs downstream — the
+  coalesce-reader analog), and
+- SPLITS skewed partitions (> factor x median, above the threshold) into
+  capacity-halved sub-batches so one hot key cannot blow a downstream
+  operator's memory cliff (the skew-join split analog; the sub-batches
+  stream through the same consumer).
+
+The stats readback is ONE small device->host transfer per exchange —
+the price of adaptivity; `spark.sql.adaptive.enabled` defaults false
+because that sync also flips tunneled devices out of pipelined dispatch.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..config import (ADAPTIVE_ADVISORY_BYTES, ADAPTIVE_COALESCE,
+                      ADAPTIVE_SKEW_FACTOR, ADAPTIVE_SKEW_THRESHOLD)
+from .base import ExecCtx, TpuExec, UnaryExec
+from .exchange import TpuShuffleExchangeExec
+
+__all__ = ["TpuAQEShuffleReadExec", "plan_partition_groups"]
+
+
+def plan_partition_groups(stats: List[int], advisory: int,
+                          skew_factor: int, skew_threshold: int,
+                          coalesce: bool):
+    """Pure planning: partition indices -> list of (kind, members) with
+    kind in {'coalesced', 'skewed', 'plain'}. Separated from execution so
+    tests can drive it with synthetic stats."""
+    n = len(stats)
+    live = sorted(v for v in stats if v > 0)
+    median = live[len(live) // 2] if live else 0
+    skew_cut = max(skew_factor * median, skew_threshold)
+    groups = []
+    run: List[int] = []
+    run_bytes = 0
+    for p in range(n):
+        if stats[p] >= skew_cut and median > 0:
+            if run:
+                groups.append(("coalesced" if len(run) > 1 else "plain",
+                               run))
+                run, run_bytes = [], 0
+            groups.append(("skewed", [p]))
+            continue
+        if not coalesce:
+            groups.append(("plain", [p]))
+            continue
+        if run and run_bytes + stats[p] > advisory:
+            groups.append(("coalesced" if len(run) > 1 else "plain", run))
+            run, run_bytes = [], 0
+        run.append(p)
+        run_bytes += stats[p]
+    if run:
+        groups.append(("coalesced" if len(run) > 1 else "plain", run))
+    return groups
+
+
+class TpuAQEShuffleReadExec(UnaryExec):
+    """Adaptive reader over a shuffle exchange (see module docstring).
+    Inserted by the planner when spark.sql.adaptive.enabled; transparent
+    to the CPU oracle (partition boundaries carry no row semantics for
+    the single downstream consumer)."""
+
+    def __init__(self, child: TpuShuffleExchangeExec):
+        super().__init__(child)
+        self.last_groups = None  # exposed for tests/metrics
+
+    def describe(self):
+        return "AQEShuffleReadExec"
+
+    def execute(self, ctx: ExecCtx):
+        from ..memory import split_batch
+        from ..ops.concat import concat_batches_bounded
+        handle = self.child.materialize(ctx)
+        coalesced_m = ctx.metric(self, "numCoalescedPartitions")
+        skew_m = ctx.metric(self, "numSkewSplits")
+        try:
+            stats = handle.partition_stats()
+            if stats is None:
+                for p in range(handle.num_partitions):
+                    yield from handle.read(p)
+                return
+            conf = ctx.conf
+            advisory = conf.get(ADAPTIVE_ADVISORY_BYTES)
+            groups = plan_partition_groups(
+                stats, advisory, conf.get(ADAPTIVE_SKEW_FACTOR),
+                conf.get(ADAPTIVE_SKEW_THRESHOLD),
+                conf.get(ADAPTIVE_COALESCE))
+            self.last_groups = groups
+            for kind, members in groups:
+                if kind == "coalesced":
+                    batches = [b for p in members for b in handle.read(p)]
+                    coalesced_m.value += len(members)
+                    if not batches:
+                        continue
+                    yield concat_batches_bounded(batches)
+                elif kind == "skewed":
+                    def halves_in_order(piece):
+                        # recursive in-order emission: the exchange's
+                        # map-order-within-partition contract must
+                        # survive the split (a LIFO stack would yield
+                        # second halves first)
+                        if piece.device_size_bytes() > advisory and \
+                                piece.capacity >= 2:
+                            skew_m.value += 1
+                            b1, b2 = split_batch(piece)
+                            yield from halves_in_order(b1)
+                            yield from halves_in_order(b2)
+                        else:
+                            yield piece
+                    for b in handle.read(members[0]):
+                        yield from halves_in_order(b)
+                else:
+                    for p in members:
+                        yield from handle.read(p)
+        finally:
+            handle.close()
+
+    def execute_cpu(self, ctx: ExecCtx):
+        yield from self.child.execute_cpu(ctx)

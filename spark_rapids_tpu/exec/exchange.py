@@ -26,9 +26,32 @@ from ..shuffle.transport import LocalShuffleTransport, ShuffleTransport
 from .base import ExecCtx, TpuExec, UnaryExec
 
 __all__ = ["TpuShuffleExchangeExec", "TpuBroadcastExchangeExec",
-           "TpuCoalesceBatchesExec"]
+           "TpuCoalesceBatchesExec", "ShuffleStageHandle"]
 
 _shuffle_ids = itertools.count()
+
+
+class ShuffleStageHandle:
+    """Reduce-side view of a materialized shuffle stage (the
+    QueryStageExec boundary analog): read partitions, ask for stats,
+    release the store."""
+
+    def __init__(self, transport: ShuffleTransport, sid: int, n: int):
+        self.transport = transport
+        self.sid = sid
+        self.num_partitions = n
+
+    def partition_stats(self) -> Optional[List[int]]:
+        """Approximate bytes per partition, or None when the transport
+        cannot provide them (AQE then passes through)."""
+        fn = getattr(self.transport, "partition_stats", None)
+        return fn(self.sid) if fn is not None else None
+
+    def read(self, p: int):
+        yield from self.transport.read_partition(self.sid, p)
+
+    def close(self):
+        self.transport.unregister_shuffle(self.sid)
 
 
 class TpuShuffleExchangeExec(UnaryExec):
@@ -92,7 +115,11 @@ class TpuShuffleExchangeExec(UnaryExec):
     def _pids(self, batch: TpuBatch, ectx):
         return self.partitioning.partition_ids_device(batch, ectx)
 
-    def execute(self, ctx: ExecCtx):
+    def materialize(self, ctx: ExecCtx) -> "ShuffleStageHandle":
+        """Run the WRITE phase (map side) and return a handle exposing the
+        reduce side — the stage boundary AQE observes: per-partition stats
+        become available here, before any partition is read
+        (SURVEY.md:161)."""
         transport = self._resolve_transport(ctx)
         unsplit = getattr(transport, "supports_unsplit", False)
         if hasattr(transport, "set_memory_manager"):
@@ -123,11 +150,15 @@ class TpuShuffleExchangeExec(UnaryExec):
                     writer.write(p, parts[p])
             op_time.value += time.perf_counter() - t0
             writer.close()
+        return ShuffleStageHandle(transport, sid, n)
+
+    def execute(self, ctx: ExecCtx):
+        handle = self.materialize(ctx)
         try:
-            for p in range(n):
-                yield from transport.read_partition(sid, p)
+            for p in range(handle.num_partitions):
+                yield from handle.read(p)
         finally:
-            transport.unregister_shuffle(sid)
+            handle.close()
 
     # sampled rows per map batch feeding the range-bound computation
     _RANGE_SAMPLE_ROWS = 4096

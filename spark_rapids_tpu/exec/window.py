@@ -59,16 +59,18 @@ _I64 = jnp.int64
 _SENTINEL = jnp.iinfo(jnp.int64).max
 
 
+# native cumulative HLOs: same results as lax.associative_scan networks
+# but ~8 s to compile instead of 200+ s on the axon backend (measured)
 def _scan_max(x):
-    return jax.lax.associative_scan(jnp.maximum, x)
+    return jax.lax.cummax(x)
 
 
 def _scan_min_rev(x):
-    return jax.lax.associative_scan(jnp.minimum, x, reverse=True)
+    return jax.lax.cummin(x, reverse=True)
 
 
 def _scan_add(x):
-    return jax.lax.associative_scan(jnp.add, x)
+    return jax.lax.cumsum(x)
 
 
 def _lex_lt(a, b):

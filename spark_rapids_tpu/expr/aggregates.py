@@ -50,22 +50,29 @@ def _out_cap(seg):
     return GLOBAL_LANES if seg is None else seg.shape[0]
 
 
+# seg is ALWAYS the sorted segment ids from segment_ids_for_keys here
+# (the aggregate exec sorts by keys first), so the reductions use the
+# scatter-free sorted-segment kernels — jax.ops.segment_* scatters cost
+# ~100ms/2M rows on TPU and dominated the whole join+agg pipeline.
+from ..ops.segments import seg_reduce_sorted
+
+
 def _seg_sum(vals, seg, cap):
     if seg is None:
         return _lane0(jnp.sum(vals), vals.dtype)
-    return jax.ops.segment_sum(vals, seg, num_segments=cap)
+    return seg_reduce_sorted(vals, seg, cap, "sum")
 
 
 def _seg_min(vals, seg, cap):
     if seg is None:
         return _lane0(jnp.min(vals), vals.dtype)
-    return jax.ops.segment_min(vals, seg, num_segments=cap)
+    return seg_reduce_sorted(vals, seg, cap, "min")
 
 
 def _seg_max(vals, seg, cap):
     if seg is None:
         return _lane0(jnp.max(vals), vals.dtype)
-    return jax.ops.segment_max(vals, seg, num_segments=cap)
+    return seg_reduce_sorted(vals, seg, cap, "max")
 
 
 def _type_extreme(np_dtype, largest: bool):

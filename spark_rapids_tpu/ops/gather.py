@@ -16,17 +16,17 @@ from ..columnar.column import TpuColumnVector
 from .strings import gather_strings
 
 __all__ = ["compaction_indices", "exclusive_cumsum", "invert_permutation",
-           "gather_column", "gather_batch", "compact_batch",
-           "ensure_compacted", "shrink_batch"]
+           "gather_column", "gather_batch", "gather_columns",
+           "compact_batch", "ensure_compacted", "shrink_batch"]
 
 
 def inclusive_int_cumsum(x: jax.Array) -> jax.Array:
-    """Inclusive int32 prefix sum via an explicit log-depth
-    associative_scan. jnp.cumsum on int lowers to a serial loop on TPU
-    (~100ms for 2M elements) and f64 cumsum is only f32 there (exact to
-    just 2^24 — too small for char/element offsets); the scan network is
-    parallel AND exact to 2^31."""
-    return jax.lax.associative_scan(jnp.add, x.astype(jnp.int32))
+    """Inclusive int32 prefix sum via the native cumulative-sum HLO.
+    Measured on the v5e (2M elements): 0.08 ms run / ~7 s compile — the
+    previous `lax.associative_scan` network ran equally fast but cost
+    200+ s of XLA compile per program on the axon backend. Int cumsum is
+    exact to 2^31 (f64 would be f32 on TPU — only 2^24)."""
+    return jnp.cumsum(x.astype(jnp.int32))
 
 
 def exclusive_cumsum(x: jax.Array) -> jax.Array:
@@ -119,22 +119,31 @@ def gather_column(col: TpuColumnVector, indices: jax.Array,
 
 def gather_batch(batch: TpuBatch, indices: jax.Array, count,
                  char_capacities=None) -> TpuBatch:
-    """Reorder/compact a whole batch by row indices (count = live rows).
+    """Reorder/compact a whole batch by row indices (count = live rows),
+    prefix layout. See gather_columns for the packed-gather mechanics."""
+    out_live = row_mask(indices.shape[0], count)
+    cols = gather_columns(batch.columns, indices, out_live,
+                          char_capacities)
+    return TpuBatch(cols, batch.schema, count)
+
+
+def gather_columns(columns, indices: jax.Array, out_live: jax.Array,
+                   char_capacities=None):
+    """Reorder a list of columns by row indices with an arbitrary
+    live-output mask (need not be a prefix — the join fast path gathers
+    build rows into match positions).
 
     All fixed-width data lanes are bitcast to int32 words and packed —
     together with the validity bits (one int32 bitfield lane per 32
-    columns) — into a single (rows, words) matrix, so the whole batch
+    columns) — into a single (rows, words) matrix, so the whole set
     moves in ONE row gather: N separate 1-D gathers cost ~30ms each on
     TPU, a packed 2-D row gather is ~free."""
-    import numpy as np
-    n = batch.capacity          # input rows (packing side)
-    n_out = indices.shape[0]    # output rows (gather side)
-    out_live = row_mask(n_out, count)
+    n = columns[0].capacity if columns else 0  # input rows (packing side)
 
     lanes = []          # (n, w) int32 blocks to pack
     col_lanes = []      # per column: (kind, lane_offset, width)
     off = 0
-    for c in batch.columns:
+    for c in columns:
         if c.is_string_like or c.data is None or c.children is not None:
             col_lanes.append(("special", 0, 0))
             continue
@@ -158,11 +167,11 @@ def gather_batch(batch: TpuBatch, indices: jax.Array, count,
         col_lanes.append(("packed", off, w.shape[1]))
         off += w.shape[1]
     # validity bitfields: 32 columns per int32 lane
-    ncols = len(batch.columns)
+    ncols = len(columns)
     vwords = []
     for base in range(0, ncols, 32):
         word = jnp.zeros((n,), jnp.int32)
-        for bit, c in enumerate(batch.columns[base: base + 32]):
+        for bit, c in enumerate(columns[base: base + 32]):
             word = word | (c.validity.astype(jnp.int32) << bit)
         vwords.append(word[:, None])
     vbase = off
@@ -173,7 +182,7 @@ def gather_batch(batch: TpuBatch, indices: jax.Array, count,
     gathered = packed[indices] if packed is not None else None
 
     cols = []
-    for i, c in enumerate(batch.columns):
+    for i, c in enumerate(columns):
         word = gathered[:, vbase + i // 32]
         validity = (((word >> (i % 32)) & 1) != 0) & out_live
         kind, loff, width = col_lanes[i]
@@ -206,7 +215,7 @@ def gather_batch(batch: TpuBatch, indices: jax.Array, count,
             data = i64 if d.dtype == jnp.int64 else \
                 jax.lax.bitcast_convert_type(i64, d.dtype)
         cols.append(c.with_arrays(data=data, validity=validity))
-    return TpuBatch(cols, batch.schema, count)
+    return cols
 
 
 def compact_batch(batch: TpuBatch, keep: jax.Array) -> TpuBatch:

@@ -31,7 +31,9 @@ from .sort_keys import (normalize_float_key_col, orderable_int,
                         string_order_ranks_multi)
 
 __all__ = ["JOIN_TYPES", "union_group_ids", "JoinPlanA", "join_counts",
-           "join_total", "join_indices", "join_gather"]
+           "join_total", "join_indices", "join_gather",
+           "join_output_bytes", "unique_build_analysis",
+           "unique_build_probe", "probe_unique", "unique_union_lookup"]
 
 JOIN_TYPES = ("inner", "left_outer", "right_outer", "full_outer",
               "left_semi", "left_anti", "cross")
@@ -96,7 +98,7 @@ class JoinPlanA:
     """Results of stage A, a pytree of device arrays + static shapes."""
 
     def __init__(self, g_l, g_r, matches, starts_g, perm_r, eligible_l,
-                 eligible_r, matched_r, live_l, live_r):
+                 eligible_r, matched_r, live_l, live_r, times_r):
         self.g_l = g_l
         self.g_r = g_r
         self.matches = matches          # per left row, 0 for null-key/dead
@@ -107,11 +109,13 @@ class JoinPlanA:
         self.matched_r = matched_r      # right rows with >=1 left match
         self.live_l = live_l
         self.live_r = live_r
+        self.times_r = times_r          # per right row: # left pair matches
 
     def tree_flatten(self):
         return ((self.g_l, self.g_r, self.matches, self.starts_g,
                  self.perm_r, self.eligible_l, self.eligible_r,
-                 self.matched_r, self.live_l, self.live_r), None)
+                 self.matched_r, self.live_l, self.live_r,
+                 self.times_r), None)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -152,8 +156,9 @@ def join_counts(left_keys, right_keys, live_l, live_r,
                                    jnp.where(eligible_l, g_l, gcap - 1),
                                    num_segments=gcap)
     matched_r = eligible_r & (counts_l[g_r] > 0)
+    times_r = jnp.where(eligible_r, counts_l[g_r], 0)
     return JoinPlanA(g_l, g_r, matches, starts_g, perm_r, eligible_l,
-                     eligible_r, matched_r, live_l, live_r)
+                     eligible_r, matched_r, live_l, live_r, times_r)
 
 
 def join_total(plan: JoinPlanA, join_type: str) -> jax.Array:
@@ -175,6 +180,126 @@ def join_total(plan: JoinPlanA, join_type: str) -> jax.Array:
     if join_type == "left_anti":
         return jnp.sum((plan.live_l & (m == 0)).astype(jnp.int32))
     raise ValueError(join_type)
+
+
+def join_output_bytes(plan: JoinPlanA, left: TpuBatch, right: TpuBatch,
+                      join_type: str) -> jax.Array:
+    """Per-string-column output byte counts from stage-A algebra alone —
+    no output indices needed, so sizing folds into the stage-A program
+    and the whole staged join pays ONE host sync per batch instead of
+    two (VERDICT r3 #1). Column order: left string cols then (except
+    semi/anti) right string cols — matching the char-cap order the
+    gather stage consumes."""
+    m = plan.matches
+    if join_type in ("inner", "cross", "right_outer"):
+        emit_l = m
+    elif join_type in ("left_outer", "full_outer"):
+        emit_l = jnp.where(plan.live_l, jnp.maximum(m, 1), 0)
+    elif join_type == "left_semi":
+        emit_l = (plan.live_l & (m > 0)).astype(jnp.int32)
+    else:  # left_anti
+        emit_l = (plan.live_l & (m == 0)).astype(jnp.int32)
+    counts = []
+    for c in left.columns:
+        if c.is_string_like:
+            lens = c.offsets[1:] - c.offsets[:-1]
+            counts.append(jnp.sum(emit_l * lens))
+    if join_type not in ("left_semi", "left_anti"):
+        times = plan.times_r
+        if join_type in ("right_outer", "full_outer"):
+            times = times + (plan.live_r
+                             & ~plan.matched_r).astype(jnp.int32)
+        for c in right.columns:
+            if c.is_string_like:
+                lens = c.offsets[1:] - c.offsets[:-1]
+                counts.append(jnp.sum(times * lens))
+    return jnp.stack(counts) if counts else jnp.zeros((0,), jnp.int32)
+
+
+def unique_build_analysis(right_keys: Sequence[TpuColumnVector],
+                          live_r: jax.Array,
+                          payload: Sequence[TpuColumnVector]) -> jax.Array:
+    """Build-side facts for the sync-free fast path, ONE small device
+    vector (a single host readback per build, not per stream batch):
+    [max_dup, max_live_len(payload string col 0), ...]. max_dup <= 1
+    means every key appears at most once among eligible build rows, so
+    a stream batch of capacity N joins into capacity N — a static bound
+    with no per-batch size sync (SURVEY.md §7.3.1 applied at build
+    granularity)."""
+    from .sort_keys import segment_ids_for_keys
+    cap = live_r.shape[0]
+    eligible = live_r & ~_any_null_key(right_keys, cap)
+    keys = [_norm_key_col(k) for k in right_keys]
+    perm, seg, _ = segment_ids_for_keys(keys, eligible)
+    live_sorted = eligible[perm]
+    counts = jax.ops.segment_sum(live_sorted.astype(jnp.int32), seg,
+                                 num_segments=cap)
+    parts = [jnp.max(counts, initial=0)]
+    for c in payload:
+        if c.is_string_like:
+            lens = c.offsets[1:] - c.offsets[:-1]
+            parts.append(jnp.max(jnp.where(live_r, lens, 0), initial=0))
+    return jnp.stack(parts)
+
+
+def unique_build_probe(rkey: TpuColumnVector, live_r: jax.Array):
+    """Presort a single fixed-width build key ONCE per build:
+    (rk_sorted, perm, n_eligible). Stream batches then probe by
+    searchsorted — no per-batch sort of the build side, no union sort at
+    all (the TPU answer to a reusable hash table: a reusable sorted
+    array)."""
+    rk = _norm_key_col(rkey)
+    eligible = live_r & rk.validity
+    v = orderable_int(rk)
+    # ineligible rows take the dtype's max BEFORE the sort so the WHOLE
+    # sorted array is ascending (searchsorted requires global order, not
+    # just an ordered prefix); a real key equal to the max still matches
+    # because the probe guards with pos < n_eligible and eligible rows
+    # sort before sentinels via the eligibility lane
+    v = jnp.where(eligible, v, jnp.array(jnp.iinfo(v.dtype).max, v.dtype))
+    elig_lane = jnp.where(eligible, jnp.int8(0), jnp.int8(1))
+    idx = jnp.arange(v.shape[0], dtype=jnp.int32)
+    _, rk_sorted, perm = jax.lax.sort((elig_lane, v, idx), num_keys=3)
+    n_elig = jnp.sum(eligible.astype(jnp.int32))
+    return rk_sorted, perm, n_elig
+
+
+def probe_unique(lkey: TpuColumnVector, eligible_l: jax.Array,
+                 rk_sorted: jax.Array, perm_r: jax.Array,
+                 n_elig: jax.Array):
+    """(ridx, matched) for a unique build via binary search into the
+    presorted key array. O(N log M) gathers, fully vectorized."""
+    v = orderable_int(_norm_key_col(lkey))
+    if v.dtype != rk_sorted.dtype:
+        tgt = jnp.promote_types(v.dtype, rk_sorted.dtype)
+        v = v.astype(tgt)
+        rk_sorted = rk_sorted.astype(tgt)
+    pos = jnp.searchsorted(rk_sorted, v, side="left").astype(jnp.int32)
+    pos_c = jnp.clip(pos, 0, rk_sorted.shape[0] - 1)
+    matched = eligible_l & (pos < n_elig) & (rk_sorted[pos_c] == v)
+    return perm_r[pos_c], matched
+
+
+def unique_union_lookup(left_keys, right_keys, live_l, live_r,
+                        eligible_l, eligible_r):
+    """(ridx, matched) for a unique build with multi-column or string
+    keys: the shared-group-id machinery, but with <=1 right row per
+    group the first (only) member IS the match — no output expansion,
+    no size sync."""
+    nl, nr = live_l.shape[0], live_r.shape[0]
+    gcap = nl + nr
+    g_l, g_r = union_group_ids(left_keys, right_keys, live_l, live_r)
+    g_r_sort = jnp.where(eligible_r, g_r, gcap)
+    idx_r = jnp.arange(nr, dtype=jnp.int32)
+    _, perm_r = jax.lax.sort((g_r_sort, idx_r), num_keys=2)
+    counts = jax.ops.segment_sum(eligible_r.astype(jnp.int32),
+                                 jnp.where(eligible_r, g_r, gcap - 1),
+                                 num_segments=gcap)
+    from .gather import exclusive_cumsum
+    starts_g = exclusive_cumsum(counts)
+    matched = eligible_l & (counts[g_l] > 0)
+    ridx = perm_r[jnp.clip(starts_g[g_l], 0, nr - 1)]
+    return ridx, matched
 
 
 def join_indices(plan: JoinPlanA, join_type: str, out_cap: int):

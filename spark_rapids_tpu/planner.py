@@ -237,8 +237,22 @@ class TpuOverrides:
                         built, target_rows=self.conf.get(BATCH_SIZE_ROWS))
             elif not meta.on_device and c.on_device:
                 built = DeviceToHostExec(built)
+            built = self._maybe_aqe(c, built)
             new_children.append(built)
         return meta.node.with_new_children(new_children)
+
+    def _maybe_aqe(self, meta: NodeMeta, built: TpuExec) -> TpuExec:
+        """With spark.sql.adaptive.enabled, wrap device-side shuffle
+        exchanges in the adaptive reader (coalesce + skew split,
+        exec/aqe.py) — inserted like transitions, below the consumer."""
+        from .config import ADAPTIVE_ENABLED
+        from .exec.exchange import TpuShuffleExchangeExec
+        if not self.conf.get(ADAPTIVE_ENABLED):
+            return built
+        if meta.on_device and isinstance(built, TpuShuffleExchangeExec):
+            from .exec.aqe import TpuAQEShuffleReadExec
+            return TpuAQEShuffleReadExec(built)
+        return built
 
     def apply(self, plan: TpuExec) -> PhysicalPlan:
         meta = self._wrap(plan)

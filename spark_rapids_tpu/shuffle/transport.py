@@ -134,8 +134,10 @@ class LocalShuffleTransport(ShuffleTransport):
 
     def __init__(self):
         self._shuffles: Dict[int, Dict] = {}
+        self._nparts: Dict[int, int] = {}
         self._lock = threading.Lock()
         self._mm = None
+        self._stats_jit: Dict[tuple, object] = {}
 
     def set_memory_manager(self, mm) -> None:
         """Attach the spill catalog; subsequent writes are spillable."""
@@ -144,6 +146,53 @@ class LocalShuffleTransport(ShuffleTransport):
     def register_shuffle(self, shuffle_id: int, num_partitions: int):
         with self._lock:
             self._shuffles.setdefault(shuffle_id, {})
+            self._nparts[shuffle_id] = num_partitions
+
+    def partition_stats(self, shuffle_id: int):
+        """Approximate bytes per partition for AQE: per map entry, live
+        row counts per partition (sorted pids + searchsorted — no
+        scatter) scaled to the entry's byte size; ONE host readback per
+        shuffle, paid only when an AQE read asks (SURVEY.md:161)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        n = self._nparts.get(shuffle_id)
+        store = self._shuffles.get(shuffle_id, {})
+        if n is None:
+            return None
+        sizes = np.zeros(n, dtype=np.int64)
+        # pre-split path: per-partition batches have exact sizes
+        for p, entries in store.items():
+            if p is None:
+                continue
+            for _, b in entries:
+                sizes[p] += b.device_size_bytes()
+        whole = store.get(None, [])
+        counts_parts = []
+        total_bytes = []
+        for _, entry in whole:
+            b = entry._sb.get() if entry._sb is not None else entry._raw
+            key = (b.capacity, n)
+            fn = self._stats_jit.get(key)
+            if fn is None:
+                def rows_per_pid(bb):
+                    pidcol = bb.columns[-1]
+                    live = bb.live_mask()
+                    sp = jax.lax.sort(
+                        jnp.where(live, pidcol.data, jnp.int32(n)))
+                    edges = jnp.searchsorted(
+                        sp, jnp.arange(n + 1, dtype=jnp.int32))
+                    return edges[1:] - edges[:-1]
+                fn = jax.jit(rows_per_pid)
+                self._stats_jit[key] = fn
+            counts_parts.append(fn(b))
+            total_bytes.append(b.device_size_bytes())
+        if counts_parts:
+            host = np.asarray(jax.device_get(jnp.stack(counts_parts)))
+            for cnts, nbytes in zip(host, total_bytes):
+                tot = max(int(cnts.sum()), 1)
+                sizes += (cnts.astype(np.int64) * nbytes) // tot
+        return [int(v) for v in sizes]
 
     def writer(self, shuffle_id: int, map_id: int) -> ShuffleWriteHandle:
         with self._lock:
@@ -163,5 +212,6 @@ class LocalShuffleTransport(ShuffleTransport):
     def unregister_shuffle(self, shuffle_id: int):
         with self._lock:
             store = self._shuffles.pop(shuffle_id, None)
+            self._nparts.pop(shuffle_id, None)
         for _, entry in (store or {}).get(None, []):
             entry.release()
