@@ -261,14 +261,15 @@ class TpuHashAggregateExec(UnaryExec):
 
     # --- single-pass path (collect_list/collect_set) ----------------------
 
-    def _collect_column(self, agg, scol, seg, sorted_live, out_cap,
-                        out_live):
-        """Build one collect_list/set ARRAY column from the group-sorted
-        value column: one more sort puts (valid, group, value) in order,
-        compaction drops nulls (and set-duplicates), and per-group
-        offsets are a searchsorted over the kept rows' group ids —
-        sort/scan/gather only, no scatters (SURVEY.md §7.1.3)."""
-        from ..ops.gather import compaction_indices, gather_column
+    @staticmethod
+    def _value_sorted_groups(scol, seg, sorted_live, dedupe: bool):
+        """Shared single-pass layout (collect_* AND approx_percentile):
+        one more sort puts (valid, group, value) in order, compaction
+        drops nulls (and set-duplicates), and kept rows' group ids are
+        searchsorted-able — sort/scan/gather only, no scatters
+        (SURVEY.md §7.1.3). Returns (perm2, cidx, ccount, kseg,
+        elem_live)."""
+        from ..ops.gather import compaction_indices
         from ..ops.sort_keys import orderable_int, string_order_ranks
         cap = sorted_live.shape[0]
         valid = scol.validity & sorted_live
@@ -285,18 +286,26 @@ class TpuHashAggregateExec(UnaryExec):
         sdrop, sseg, slane, perm2 = jax.lax.sort(
             (drop, segl, lane, idx), num_keys=4)
         keep = sdrop == 0
-        if agg.dedupe:
+        if dedupe:
             first = jnp.concatenate([
                 jnp.ones((1,), jnp.bool_),
                 (sseg[1:] != sseg[:-1]) | (slane[1:] != slane[:-1])])
             keep = keep & first
         cidx, ccount = compaction_indices(keep)
         elem_live = idx < ccount
-        final_idx = perm2[cidx]
-        elem = gather_column(scol, final_idx, elem_live)
         # kept rows' group ids in compact prefix; padding pinned past
         # every group so searchsorted lands on ccount
         kseg = jnp.where(elem_live, sseg[cidx], jnp.int32(cap))
+        return perm2, cidx, ccount, kseg, elem_live
+
+    def _collect_column(self, agg, scol, seg, sorted_live, out_cap,
+                        out_live):
+        """collect_list/set ARRAY column over the shared single-pass
+        layout; per-group offsets are a searchsorted over kseg."""
+        from ..ops.gather import gather_column
+        perm2, cidx, _, kseg, elem_live = self._value_sorted_groups(
+            scol, seg, sorted_live, agg.dedupe)
+        elem = gather_column(scol, perm2[cidx], elem_live)
         offsets = jnp.searchsorted(
             kseg, jnp.arange(out_cap + 1, dtype=jnp.int32),
             side="left").astype(jnp.int32)
@@ -316,14 +325,50 @@ class TpuHashAggregateExec(UnaryExec):
         if skeys:
             starts = _segment_starts(seg)
             out_cols = [gather_column(k, starts, out_live) for k in skeys]
+        from ..expr.aggregates import ApproxPercentile
         for a, sv in zip(self.aggs, svals):
-            if getattr(a, "single_pass", False):
+            if isinstance(a, ApproxPercentile):
+                out_cols.append(self._percentile_column(
+                    a, sv[0], seg, sorted_live, out_cap, out_live))
+            elif getattr(a, "single_pass", False):
                 out_cols.append(self._collect_column(
                     a, sv[0], seg, sorted_live, out_cap, out_live))
             else:
                 bufs = a.update_device(sv, seg, sorted_live, out_live)
                 out_cols.append(a.evaluate_device(bufs))
         return TpuBatch(out_cols, self._schema, ng)
+
+    def _percentile_column(self, agg, scol, seg, sorted_live, out_cap,
+                           out_live):
+        """approx_percentile over the shared single-pass layout: group
+        edges come from searchsorted over the kept rows' group ids, and
+        each requested percentile is a rank gather at edge+rank — exact,
+        no sketch (expr/aggregates.py ApproxPercentile docstring)."""
+        from ..ops.gather import gather_column
+        cap = sorted_live.shape[0]
+        perm2, cidx, _, kseg, _ = self._value_sorted_groups(
+            scol, seg, sorted_live, dedupe=False)
+        g = jnp.arange(out_cap, dtype=jnp.int32)
+        lo = jnp.searchsorted(kseg, g, side="left").astype(jnp.int32)
+        hi = jnp.searchsorted(kseg, g, side="right").astype(jnp.int32)
+        n_g = hi - lo
+        picked = []
+        for p in agg.percentages:
+            # Spark's ceil(p*n) 1-based rank (ApproxPercentile.rank0)
+            r0 = jnp.clip(jnp.ceil(p * n_g).astype(jnp.int32) - 1, 0,
+                          jnp.maximum(n_g - 1, 0))
+            pos = jnp.clip(lo + r0, 0, cap - 1)
+            picked.append(perm2[jnp.clip(cidx[pos], 0, cap - 1)])
+        has_vals = out_live & (n_g > 0)
+        if not agg.is_list:
+            return gather_column(scol, picked[0], has_vals)
+        k = len(agg.percentages)
+        src = jnp.stack(picked, axis=1).reshape(-1)  # (out_cap*k,)
+        elem_valid = jnp.repeat(has_vals, k)
+        elem = gather_column(scol, src, elem_valid)
+        offsets = (jnp.arange(out_cap + 1, dtype=jnp.int32) * k)
+        return TpuColumnVector(agg.dtype, validity=has_vals,
+                               offsets=offsets, children=[elem])
 
     def _execute_single_pass(self, ctx: ExecCtx):
         """collect_* cannot partial/merge (variable-length buffers have

@@ -227,13 +227,18 @@ class TpuShuffleExchangeExec(UnaryExec):
 
 
 class TpuBroadcastExchangeExec(UnaryExec):
-    """Materialize the child once as a single device batch (the build-side
-    table). Single-process: concat; multi-chip: replicate over ICI. The
+    """Materialize the child once as the build-side table. With a device
+    mesh, each child batch is a per-device block and the table is
+    REPLICATED via the ICI all-gather collective (shuffle/ici.py:
+    ici_broadcast_batches) — no chip ever holds the only copy
+    (SURVEY.md:227). Without a mesh (single-process): device concat. The
     payload is registered in the spill catalog so an idle broadcast
     yields its HBM under pressure and re-uploads on next use."""
 
-    def __init__(self, child: TpuExec):
+    def __init__(self, child: TpuExec, mesh=None, axis: str = "x"):
         super().__init__(child)
+        self.mesh = mesh
+        self.axis = axis
         self._sb = None  # SpillableBatch
 
     def tpu_supported(self):
@@ -252,7 +257,15 @@ class TpuBroadcastExchangeExec(UnaryExec):
             batches = list(self.child.execute(ctx))
             if not batches:
                 return None
-            self._sb = ctx.mm.register(concat_batches(batches))
+            if self.mesh is not None:
+                from ..shuffle.ici import ici_broadcast_batches
+                gathered = ici_broadcast_batches(self.mesh, batches,
+                                                 self.axis)
+                payload = gathered[0] if len(gathered) == 1 else \
+                    concat_batches(gathered)
+            else:
+                payload = concat_batches(batches)
+            self._sb = ctx.mm.register(payload)
             # the catalog holds a strong ref; without this the payload
             # would outlive the plan in the process-shared ledger
             import weakref

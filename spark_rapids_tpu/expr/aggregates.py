@@ -30,7 +30,7 @@ from .base import Expression
 
 __all__ = ["AggregateFunction", "Sum", "Count", "Min", "Max", "Average",
            "First", "Last", "StddevSamp", "StddevPop", "VarianceSamp",
-           "VariancePop"]
+           "VariancePop", "CollectList", "CollectSet", "ApproxPercentile"]
 
 _I64 = jnp.int64
 _F64 = jnp.float64
@@ -678,3 +678,69 @@ class CollectList(_Collect):
 
 class CollectSet(_Collect):
     dedupe = True
+
+
+class ApproxPercentile(AggregateFunction):
+    """approx_percentile(col, percentage[, accuracy]) — reference:
+    GpuApproximatePercentile over a t-digest sketch (SURVEY.md:177).
+
+    The TPU build is sort-based and EXACT: the single-pass group-sort
+    pipeline (exec/aggregate.py) already orders each group's values, so
+    the percentile is a rank gather — rank error 0, within any accuracy
+    bound the caller requests (the t-digest exists in the reference to
+    avoid a sort that this engine performs anyway). `accuracy` is
+    accepted for API parity and recorded, not needed. Percentage may be
+    a scalar (returns the input type) or a list (returns
+    array<input type>); rank semantics match Spark's smallest-value-
+    with-rank >= ceil(p*n) definition on exact data."""
+
+    single_pass = True
+
+    def __init__(self, child: Expression, percentage,
+                 accuracy: int = 10000):
+        self.children = (child,)
+        self.is_list = isinstance(percentage, (list, tuple))
+        ps = list(percentage) if self.is_list else [percentage]
+        for p in ps:
+            if not (0.0 <= float(p) <= 1.0):
+                raise ValueError(f"percentage {p} not in [0, 1]")
+        self.percentages = tuple(float(p) for p in ps)
+        self.accuracy = accuracy
+
+    @property
+    def dtype(self):
+        t = self.children[0].dtype
+        return dt.ArrayType(t) if self.is_list else t
+
+    @property
+    def buffer_fields(self):
+        return []  # no partial buffers: single-pass only
+
+    def tpu_supported(self):
+        t = self.children[0].dtype
+        if t.is_variable_width or dt.is_nested(t) \
+                or isinstance(t, (dt.BooleanType, dt.NullType)):
+            return (f"approx_percentile over "
+                    f"{t.simple_string()} not supported")
+        return None
+
+    @staticmethod
+    def rank0(p: float, n: int) -> int:
+        """0-based rank of percentile p among n ordered values (Spark's
+        ceil(p*n) 1-based, clamped) — the single definition both the
+        device kernel and the CPU oracle use."""
+        import math as _m
+        return min(max(int(_m.ceil(p * n)) - 1, 0), n - 1)
+
+    def cpu_agg(self, values, ectx=None):
+        vals = [v for v in values if v is not None]
+
+        def key(v):
+            if isinstance(v, float):
+                return (1, 0.0) if math.isnan(v) else (0, v + 0.0)
+            return (0, v)
+        vals.sort(key=key)
+        if not vals:
+            return None
+        out = [vals[self.rank0(p, len(vals))] for p in self.percentages]
+        return out if self.is_list else out[0]

@@ -231,15 +231,33 @@ class Like(Expression):
             return ("contains", parts[1])
         return None
 
+    def _device_regex(self):
+        """Compiled device program for non-simple patterns (ops/regex.py
+        transpiler), or None."""
+        if getattr(self, "_rx_prog", "unset") == "unset":
+            from ..ops.regex import (RegexUnsupported, compile_pattern,
+                                     like_to_regex)
+            try:
+                self._rx_prog = compile_pattern(
+                    like_to_regex(self.pattern, self.escape))
+            except RegexUnsupported:
+                self._rx_prog = None
+        return self._rx_prog
+
     def tpu_supported(self):
-        if self._simple_shape() is None:
-            return f"LIKE pattern {self.pattern!r} requires host regex"
+        if self._simple_shape() is None and self._device_regex() is None:
+            return (f"LIKE pattern {self.pattern!r} outside the device "
+                    "regex dialect")
         return None
 
     def eval_tpu(self, batch, ctx):
         c = self.children[0].eval_tpu(batch, ctx)
         shape = self._simple_shape()
-        assert shape is not None
+        if shape is None:
+            # general wildcard pattern -> transpiled anchored regex
+            from ..ops.regex import regex_match_device
+            m = regex_match_device(c, self._device_regex())
+            return TpuColumnVector(dt.BOOL, data=m, validity=c.validity)
         kind = shape[0]
         if kind == "all":
             m = jnp.ones((batch.capacity,), jnp.bool_)
@@ -377,8 +395,13 @@ class StringReplace(Expression):
 
 
 class RegExpLike(Expression):
-    """rlike — host regex engine (Java-dialect approximated with python re;
-    the reference transpiles to cudf's dialect, same partial-support idea)."""
+    """rlike. Patterns inside the device dialect (ops/regex.py: literals,
+    classes, escapes, anchors, * + ?, top-level alternation) run as a
+    position automaton ON DEVICE — the reference's transpile-to-cudf
+    idea rebuilt for XLA (SURVEY.md:175); everything else stays on the
+    host regex engine with a tagged reason. Device matching is over
+    UTF-8 bytes (`.` = one byte): identical to host for ASCII data, the
+    documented divergence otherwise."""
 
     def __init__(self, child, pattern: str):
         self.children = (child,)
@@ -388,8 +411,27 @@ class RegExpLike(Expression):
     def dtype(self):
         return dt.BOOL
 
+    def _device_prog(self):
+        if getattr(self, "_rx_prog", "unset") == "unset":
+            from ..ops.regex import RegexUnsupported, compile_pattern
+            try:
+                self._rx_prog = compile_pattern(self.pattern)
+            except RegexUnsupported as e:
+                self._rx_prog = None
+                self._rx_reason = str(e)
+        return self._rx_prog
+
     def tpu_supported(self):
-        return "regular expressions run on host"
+        if self._device_prog() is None:
+            return (f"regexp {self.pattern!r} outside the device "
+                    f"dialect ({self._rx_reason}); runs on host")
+        return None
+
+    def eval_tpu(self, batch, ctx):
+        from ..ops.regex import regex_match_device
+        c = self.children[0].eval_tpu(batch, ctx)
+        m = regex_match_device(c, self._device_prog())
+        return TpuColumnVector(dt.BOOL, data=m, validity=c.validity)
 
     def eval_cpu(self, rb, ctx):
         a = self.children[0].eval_cpu(rb, ctx)

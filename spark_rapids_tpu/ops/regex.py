@@ -1,0 +1,336 @@
+"""Device regular expressions: a restricted-dialect transpiler.
+
+TPU analog of the reference's regex transpiler (CUDA regex via cudf +
+a Java->cudf dialect translator, SURVEY.md:175, 613-614; reference mount
+empty). The supported dialect — literals, escapes (\\d \\w \\s and
+upper-case negations), character classes with ranges/negation, `.`,
+anchors `^`/`$`, quantifiers `* + ?` on single atoms, and top-level
+alternation — covers the pattern shapes NDS-style queries use; anything
+else reports unsupported and the expression stays on host (the same
+partial-support contract the reference ships).
+
+Compilation (host, per expression): each alternation branch of
+single-char atoms becomes a Glushkov position automaton — position i's
+character class, the follow relation (which positions may consume the
+next byte), first sets (positions legal at a match start) and last sets
+(positions completing a match). Branch automata union into one table
+set, <= _MAX_STATES positions.
+
+Simulation (device, per batch): byte-parallel over all rows in
+lockstep — a `lax.while_loop` steps j through byte positions up to the
+LIVE maximum length (dynamic trip count, static shapes — the
+string-rank machinery's trick), each step doing an (n, S) x (S, S)
+masked transition product (MXU-shaped) plus accept tests. Unanchored
+search re-injects floating first-positions every step; `$`-anchored
+accepts fire only at each row's last byte.
+
+Byte semantics: matching is over UTF-8 BYTES. Patterns must be ASCII
+(enforced); `.` matches any byte except \\n, so on non-ASCII input a
+multi-byte character counts as several `.` positions — the documented
+device-dialect divergence (the reference's cudf regex has analogous
+incompat caveats).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["RegexUnsupported", "compile_pattern", "regex_match_device",
+           "like_to_regex"]
+
+_MAX_STATES = 48
+
+
+class RegexUnsupported(Exception):
+    """Pattern outside the device dialect — caller falls back to host."""
+
+
+def _class_for_escape(ch: str) -> np.ndarray:
+    m = np.zeros(256, bool)
+    if ch == "d":
+        m[ord("0"):ord("9") + 1] = True
+    elif ch == "w":
+        m[ord("0"):ord("9") + 1] = True
+        m[ord("a"):ord("z") + 1] = True
+        m[ord("A"):ord("Z") + 1] = True
+        m[ord("_")] = True
+    elif ch == "s":
+        for c in " \t\n\r\f\v":
+            m[ord(c)] = True
+    elif ch in "DWS":
+        m = ~_class_for_escape(ch.lower())
+    elif ch == "n":
+        m[ord("\n")] = True
+    elif ch == "t":
+        m[ord("\t")] = True
+    elif ch == "r":
+        m[ord("\r")] = True
+    elif ch in ".^$*+?()[]{}|\\/-":
+        m[ord(ch)] = True
+    else:
+        raise RegexUnsupported(f"escape \\{ch} not in device dialect")
+    return m
+
+
+def _parse_class(p: str, i: int) -> Tuple[np.ndarray, int]:
+    """[...] starting at p[i] == '['; returns (256-bool mask, next i)."""
+    i += 1
+    neg = i < len(p) and p[i] == "^"
+    if neg:
+        i += 1
+    m = np.zeros(256, bool)
+    first = True
+    while i < len(p) and (p[i] != "]" or first):
+        first = False
+        if p[i] == "\\":
+            if i + 1 >= len(p):
+                raise RegexUnsupported("dangling escape in class")
+            sub = _class_for_escape(p[i + 1])
+            m |= sub
+            i += 2
+            continue
+        lo = p[i]
+        if i + 2 < len(p) and p[i + 1] == "-" and p[i + 2] != "]":
+            hi = p[i + 2]
+            if ord(lo) > ord(hi):
+                raise RegexUnsupported(f"bad range {lo}-{hi}")
+            m[ord(lo):ord(hi) + 1] = True
+            i += 3
+        else:
+            m[ord(lo)] = True
+            i += 1
+    if i >= len(p):
+        raise RegexUnsupported("unterminated character class")
+    i += 1  # skip ]
+    return (~m if neg else m), i
+
+
+def _parse_branch(branch: str):
+    """-> (anchored_start, anchored_end, [(class, quant)]).
+    quant in '1?*+'."""
+    i = 0
+    anchored_start = branch.startswith("^")
+    if anchored_start:
+        i = 1
+    anchored_end = branch.endswith("$") and not branch.endswith("\\$")
+    end = len(branch) - 1 if anchored_end else len(branch)
+    atoms: List[Tuple[np.ndarray, str]] = []
+    while i < end:
+        c = branch[i]
+        if c in "(){":
+            raise RegexUnsupported(f"'{c}' (groups/bounded repeats) not "
+                                   "in device dialect")
+        if c in "^$":
+            raise RegexUnsupported("mid-pattern anchor")
+        if c == "[":
+            m, i = _parse_class(branch, i)
+        elif c == "\\":
+            if i + 1 >= end:
+                raise RegexUnsupported("dangling escape")
+            m = _class_for_escape(branch[i + 1])
+            i += 2
+        elif c == ".":
+            m = np.ones(256, bool)
+            m[ord("\n")] = False  # Java default: . excludes newline
+            i += 1
+        elif c in "*+?":
+            raise RegexUnsupported("quantifier without atom")
+        else:
+            if ord(c) > 127:
+                raise RegexUnsupported("non-ASCII pattern byte")
+            m = np.zeros(256, bool)
+            m[ord(c)] = True
+            i += 1
+        quant = "1"
+        if i < end and branch[i] in "*+?":
+            quant = branch[i]
+            i += 1
+            if i < end and branch[i] in "*+?":
+                raise RegexUnsupported("stacked quantifiers")
+        atoms.append((m, quant))
+    return anchored_start, anchored_end, atoms
+
+
+class RegexProgram:
+    """Compiled position-automaton tables (numpy, embedded as constants
+    into the device program)."""
+
+    __slots__ = ("acc", "follow", "first_anchored", "first_floating",
+                 "accept_any", "accept_end", "always_match",
+                 "empty_only_match", "n_states")
+
+    def __init__(self):
+        self.n_states = 0
+        self.acc = np.zeros((256, 0), bool)
+        self.follow = np.zeros((0, 0), bool)
+        self.first_anchored = np.zeros(0, bool)
+        self.first_floating = np.zeros(0, bool)
+        self.accept_any = np.zeros(0, bool)
+        self.accept_end = np.zeros(0, bool)
+        self.always_match = False     # matches every (non-null) string
+        self.empty_only_match = False  # ^$-style: matches len==0 rows
+
+
+def _split_alternation(p: str) -> List[str]:
+    out, cur, i = [], [], 0
+    depth = 0
+    while i < len(p):
+        c = p[i]
+        if c == "\\":
+            cur.append(p[i:i + 2])
+            i += 2
+            continue
+        if c == "[":
+            j = i + 1
+            if j < len(p) and p[j] == "^":
+                j += 1
+            if j < len(p) and p[j] == "]":
+                j += 1
+            while j < len(p) and p[j] != "]":
+                j += 2 if p[j] == "\\" else 1
+            cur.append(p[i:j + 1])
+            i = j + 1
+            continue
+        if c == "|" and depth == 0:
+            out.append("".join(cur))
+            cur = []
+            i += 1
+            continue
+        cur.append(c)
+        i += 1
+    out.append("".join(cur))
+    return out
+
+
+def compile_pattern(pattern: str) -> RegexProgram:
+    """Compile, or raise RegexUnsupported."""
+    if any(ord(c) > 127 for c in pattern):
+        raise RegexUnsupported("non-ASCII pattern")
+    prog = RegexProgram()
+    branches = [_parse_branch(b) for b in _split_alternation(pattern)]
+    n = sum(len(atoms) for _, _, atoms in branches)
+    if n > _MAX_STATES:
+        raise RegexUnsupported(f"{n} positions > {_MAX_STATES}")
+    prog.n_states = n
+    prog.acc = np.zeros((256, n), bool)
+    prog.follow = np.zeros((n, n), bool)
+    prog.first_anchored = np.zeros(n, bool)
+    prog.first_floating = np.zeros(n, bool)
+    prog.accept_any = np.zeros(n, bool)
+    prog.accept_end = np.zeros(n, bool)
+
+    base = 0
+    for a_start, a_end, atoms in branches:
+        k = len(atoms)
+        nullable = [q in "*?" for _, q in atoms]
+        if k == 0 or all(nullable):
+            # empty-matchable branch: unanchored/half-anchored search
+            # always finds the empty match; fully anchored matches only
+            # empty strings
+            if a_start and a_end:
+                prog.empty_only_match = True
+            else:
+                prog.always_match = True
+        for i, (m, q) in enumerate(atoms):
+            s = base + i
+            prog.acc[:, s] = m
+            # firsts: everything before i nullable
+            if all(nullable[:i]):
+                (prog.first_anchored if a_start
+                 else prog.first_floating)[s] = True
+            # lasts: everything after i nullable
+            if all(nullable[i + 1:]):
+                (prog.accept_end if a_end else prog.accept_any)[s] = True
+            # follow: self-loop for * and +
+            if q in "*+":
+                prog.follow[s, s] = True
+            # follow: j > i with the gap nullable
+            for j in range(i + 1, k):
+                if all(nullable[i + 1:j]):
+                    prog.follow[s, base + j] = True
+                if not nullable[j]:
+                    break
+        base += k
+    return prog
+
+
+def like_to_regex(pattern: str, escape: str = "\\") -> str:
+    """SQL LIKE -> the device regex dialect, fully anchored. LIKE
+    wildcards match ANY character including newlines (unlike regex `.`,
+    which follows Java's no-DOTALL default), so % and _ translate to
+    the all-bytes class [\\s\\S], not dot. Raises RegexUnsupported for
+    non-ASCII."""
+    out = ["^"]
+    i = 0
+    specials = ".^$*+?()[]{}|\\/"
+    while i < len(pattern):
+        c = pattern[i]
+        if c == escape and i + 1 < len(pattern):
+            nxt = pattern[i + 1]
+            out.append("\\" + nxt if nxt in specials else nxt)
+            i += 2
+            continue
+        if c == "%":
+            out.append("[\\s\\S]*")
+        elif c == "_":
+            out.append("[\\s\\S]")
+        elif c in specials:
+            out.append("\\" + c)
+        else:
+            if ord(c) > 127:
+                raise RegexUnsupported("non-ASCII LIKE pattern")
+            out.append(c)
+        i += 1
+    out.append("$")
+    return "".join(out)
+
+
+def regex_match_device(col, prog: RegexProgram):
+    """(n,) bool: does the compiled pattern match (search semantics)
+    each row's bytes. Caller masks validity."""
+    import jax
+    import jax.numpy as jnp
+    offs = col.offsets
+    lens = (offs[1:] - offs[:-1]).astype(jnp.int32)
+    n = lens.shape[0]
+    ccap = max(col.chars.shape[0], 1)
+    chars = col.chars if col.chars.shape[0] else jnp.zeros((1,), jnp.uint8)
+    live_lens = jnp.where(col.validity, lens, 0)
+    max_len = jnp.max(live_lens, initial=0)
+
+    acc = jnp.asarray(prog.acc)                    # (256, S)
+    follow = jnp.asarray(prog.follow, jnp.float32)  # (S, S) for the MXU
+    first_a = jnp.asarray(prog.first_anchored)
+    first_f = jnp.asarray(prog.first_floating)
+    accept_any = jnp.asarray(prog.accept_any)
+    accept_end = jnp.asarray(prog.accept_end)
+
+    matched0 = jnp.full((n,), bool(prog.always_match))
+    if prog.empty_only_match:
+        matched0 = matched0 | (lens == 0)
+    active0 = jnp.broadcast_to(first_a | first_f,
+                               (n, prog.n_states))
+
+    def cond(state):
+        j, active, matched = state
+        # stop at the live max length, when no position can fire again
+        # (fully-anchored patterns drain), or when every row matched
+        return (j < max_len) & jnp.any(active) & jnp.any(~matched)
+
+    def body(state):
+        j, active, matched = state
+        c = chars[jnp.clip(offs[:-1] + j, 0, ccap - 1)]
+        in_row = j < live_lens
+        fired = active & acc[c] & in_row[:, None]
+        matched = matched | jnp.any(fired & accept_any, axis=1)
+        at_end = (j == live_lens - 1)
+        matched = matched | (jnp.any(fired & accept_end, axis=1)
+                             & at_end)
+        nxt = (fired.astype(jnp.float32) @ follow) > 0
+        nxt = nxt | first_f[None, :]
+        return j + 1, nxt, matched
+
+    _, _, matched = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), active0, matched0))
+    return matched

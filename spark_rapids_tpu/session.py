@@ -126,6 +126,70 @@ class GroupedData:
         node = TpuHashAggregateExec(self._keys, list(agg_exprs), child)
         return DataFrame(node, df._session)
 
+    def pivot(self, pivot_col, values=None) -> "PivotedData":
+        """Spark's pivot: rewritten into one conditional aggregate per
+        pivot value (the Analyzer's pivot rewrite — no dedicated exec
+        needed, exactly how Spark lowers it; SURVEY.md:177). With
+        `values=None` the distinct pivot values are collected first
+        (one extra engine query, like Spark's implicit-values mode)."""
+        pe = self._df._bind(pivot_col)
+        if values is None:
+            from .expr.aggregates import Count
+            from .expr.base import Alias
+            distinct = GroupedData(self._df, [pe]).agg(
+                Alias(Count(), "__n__")).collect()
+            values = sorted(v for v in distinct.column(0).to_pylist()
+                            if v is not None)
+        return PivotedData(self._df, self._keys, pe, list(values))
+
+
+class PivotedData:
+    def __init__(self, df: "DataFrame", keys, pivot_expr, values):
+        self._df = df
+        self._keys = keys
+        self._pivot = pivot_expr
+        self._values = values
+
+    def agg(self, *agg_exprs) -> "DataFrame":
+        """One output column per (pivot value x aggregate): each
+        aggregate's inputs are masked to the pivot value via If — the
+        standard Spark rewrite. Column naming follows Spark: a single
+        aggregate names columns by the value alone; multiple aggregates
+        use value_aggname."""
+        import copy as _copy
+
+        from . import datatypes as dt
+        from .expr.aggregates import AggregateFunction
+        from .expr.base import Alias, Literal
+        from .expr.conditional import If
+        from .expr.predicates import EqualTo
+        out = []
+        multi = len(agg_exprs) > 1
+        for v in self._values:
+            cond = EqualTo(self._pivot, Literal(v, self._pivot.dtype))
+            for e in agg_exprs:
+                if isinstance(e, Alias):
+                    fn, nm = e.child, e.name
+                else:
+                    fn, nm = e, e.pretty_name().lower()
+                if not isinstance(fn, AggregateFunction):
+                    raise TypeError(f"pivot agg must be an aggregate: "
+                                    f"{e!r}")
+                clone = _copy.copy(fn)
+                if fn.children:
+                    # bind against the frame first: the null literal's
+                    # type comes from the (resolved) child
+                    bound = [self._df._bind(c) for c in fn.children]
+                    clone.children = tuple(
+                        If(cond, c, Literal(None, c.dtype))
+                        for c in bound)
+                else:  # count(*): count rows matching the pivot value
+                    clone = type(fn)(If(cond, Literal(1, dt.INT32),
+                                        Literal(None, dt.INT32)))
+                name = f"{v}_{nm}" if multi else str(v)
+                out.append(Alias(clone, name))
+        return GroupedData(self._df, self._keys).agg(*out)
+
 
 class DataFrame:
     def __init__(self, node: TpuExec, session: "TpuSession"):

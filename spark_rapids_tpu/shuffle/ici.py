@@ -35,7 +35,8 @@ from ..columnar.batch import TpuBatch, bucket_bytes
 from ..columnar.column import TpuColumnVector
 from .transport import ShuffleTransport, ShuffleWriteHandle
 
-__all__ = ["make_ici_all_to_all", "IciShuffleTransport"]
+__all__ = ["make_ici_all_to_all", "make_ici_broadcast",
+           "IciShuffleTransport", "ici_broadcast_batches"]
 
 
 def _local_exchange(ndev: int, axis: str, datas, valids, pids, live):
@@ -118,6 +119,210 @@ def make_ici_all_to_all(mesh: Mesh, axis: str = "x"):
         return cache[key](datas, tuple(valids), pids, live)
 
     return fn
+
+
+def make_ici_broadcast(mesh: Mesh, axis: str = "x"):
+    """Build the jitted SPMD one-to-all replication: each device
+    contributes its local block and receives the CONCATENATION of every
+    device's block via `jax.lax.all_gather` riding ICI — the build-side
+    replication for broadcast joins (SURVEY.md:227, §2.6
+    'Broadcast/replication'); no single chip ever holds the only copy.
+
+    fn(datas, valids, live) with shapes (D, cap[, B]) returns
+    (out_datas, out_valids, out_live) of shape (D, D*cap[, B]) where
+    every device's shard holds the FULL gathered table."""
+    ndev = mesh.shape[axis]
+    cache: Dict[Tuple[int, ...], object] = {}
+
+    def build(ndims: Tuple[int, ...]):
+        def spmd(datas, valids, live):
+            sq = lambda a: a.reshape(a.shape[1:])
+            ex = lambda a: a.reshape((1,) + a.shape)
+            od = tuple(ex(jax.lax.all_gather(sq(d), axis, tiled=True))
+                       for d in datas)
+            ov = tuple(ex(jax.lax.all_gather(sq(v), axis, tiled=True))
+                       for v in valids)
+            ol = ex(jax.lax.all_gather(sq(live), axis, tiled=True))
+            return od, ov, ol
+
+        lane = lambda nd: P(axis, *([None] * (nd - 1)))
+        in_specs = (tuple(lane(nd) for nd in ndims),
+                    tuple(P(axis, None) for _ in ndims), P(axis, None))
+        out_specs = (tuple(lane(nd) for nd in ndims),
+                     tuple(P(axis, None) for _ in ndims), P(axis, None))
+        return jax.jit(jax.shard_map(spmd, mesh=mesh, in_specs=in_specs,
+                                     out_specs=out_specs))
+
+    def fn(datas, valids, live):
+        datas = tuple(datas)
+        key = tuple(d.ndim for d in datas)
+        if key not in cache:
+            cache[key] = build(key)
+        return cache[key](datas, tuple(valids), live)
+
+    return fn
+
+
+def _discover_widths(blocks: List[TpuBatch], str_cols,
+                     jit_cache: Dict[tuple, object]) -> Dict[int, int]:
+    """Static byte width per string column across blocks: ONE jitted
+    reduction + ONE small device readback (round 3 paid a per-column,
+    per-map readback). Shared by the all-to-all and broadcast paths."""
+    if not str_cols:
+        return {}
+    caps_key = tuple(b.capacity for b in blocks) + (tuple(str_cols),)
+    fn = jit_cache.get(caps_key)
+    if fn is None:
+        def widths_fn(bs):
+            outs = []
+            for ci in str_cols:
+                w = jnp.int32(0)
+                for b in bs:
+                    c = b.column(ci)
+                    lens = c.offsets[1:] - c.offsets[:-1]
+                    lens = jnp.where(b.live_mask(), lens, 0)
+                    w = jnp.maximum(w, jnp.max(lens, initial=0))
+                outs.append(w)
+            return jnp.stack(outs)
+        fn = jax.jit(widths_fn)
+        jit_cache[caps_key] = fn
+    vals = np.asarray(jax.device_get(fn(blocks)))
+    return {ci: bucket_bytes(max(int(v), 1), minimum=8)
+            for ci, v in zip(str_cols, vals)}
+
+
+def _lane_layout(schema, widths: Dict[int, int]):
+    """(lane_meta, empty lane_datas/lane_valids lists): one fixed lane
+    per plain column, (byte-matrix, lengths) lane pair per string."""
+    lane_datas: List[List[jax.Array]] = []
+    lane_valids: List[List[jax.Array]] = []
+    lane_meta: List[Tuple[int, str]] = []
+    for ci, _ in enumerate(schema.fields):
+        if ci in widths:
+            lane_meta.extend([(ci, "str_mat"), (ci, "str_len")])
+            lane_datas.extend(([], []))
+            lane_valids.extend(([], []))
+        else:
+            lane_meta.append((ci, "fixed"))
+            lane_datas.append([])
+            lane_valids.append([])
+    return lane_meta, lane_datas, lane_valids
+
+
+def _pack_block(b: Optional[TpuBatch], schema, cap: int,
+                widths: Dict[int, int], lane_datas, lane_valids):
+    """Append one block's (possibly None = empty slot) column lanes."""
+    for li_base, ci, f in _cols_in_lane_order(schema, widths):
+        col = b.column(ci) if b is not None \
+            else TpuColumnVector.nulls(f.dtype, cap)
+        valid = _pad1(col.validity, cap)
+        if ci in widths:
+            w = widths[ci]
+            mat, lens = _string_to_matrix(col, col.capacity, w)
+            lane_datas[li_base].append(_pad2(mat, cap, w))
+            lane_valids[li_base].append(valid)
+            lane_datas[li_base + 1].append(_pad1(lens, cap))
+            lane_valids[li_base + 1].append(valid)
+        else:
+            lane_datas[li_base].append(_pad1(col.data, cap))
+            lane_valids[li_base].append(valid)
+
+
+def _cols_in_lane_order(schema, widths):
+    li = 0
+    for ci, f in enumerate(schema.fields):
+        yield li, ci, f
+        li += 2 if ci in widths else 1
+
+
+def _mesh_shard(mesh: Mesh, axis: str):
+    return lambda a: jax.device_put(a, NamedSharding(
+        mesh, P(axis, *([None] * (a.ndim - 1)))))
+
+
+def _unpack_device(schema, lane_meta, out_datas, out_valids, d: int,
+                   live_d, char_caps: Dict[int, int]):
+    """Rebuild one device's landed columns from exchanged lanes;
+    char_caps maps str-lane index -> chars capacity. Returns (cols,
+    pid_lane or None)."""
+    cols: List[Optional[TpuColumnVector]] = [None] * len(schema.fields)
+    pid_lane = None
+    li = 0
+    while li < len(lane_meta):
+        ci, kind = lane_meta[li]
+        if kind == "pid":
+            pid_lane = out_datas[li][d]
+            li += 1
+            continue
+        f = schema.fields[ci]
+        if kind == "str_mat":
+            offs, chars = _matrix_to_string(
+                out_datas[li][d], out_datas[li + 1][d], live_d,
+                char_caps[li])
+            cols[ci] = TpuColumnVector(f.dtype, validity=out_valids[li][d],
+                                       offsets=offs, chars=chars)
+            li += 2
+        else:
+            cols[ci] = TpuColumnVector(f.dtype, data=out_datas[li][d],
+                                       validity=out_valids[li][d])
+            li += 1
+    return cols, pid_lane
+
+
+_broadcast_width_jits: Dict[tuple, object] = {}
+
+
+def ici_broadcast_batches(mesh: Mesh, batches: List[TpuBatch],
+                          axis: str = "x") -> List[TpuBatch]:
+    """Replicate `batches` over the mesh via all_gather epochs (one per
+    ceil(len/D) groups of blocks) and return one gathered batch per
+    epoch — every device's shard of the outputs holds ALL rows, so a
+    broadcast-hash-join build side exists everywhere without a
+    one-chip materialization. Strings ride as (byte-matrix, lengths)
+    lanes like the shuffle; one small per-epoch readback sizes the
+    reassembled char buffers (the broadcast is a materialization point
+    anyway)."""
+    ndev = mesh.shape[axis]
+    bcast = make_ici_broadcast(mesh, axis)
+    schema = batches[0].schema
+    out: List[TpuBatch] = []
+    shard = _mesh_shard(mesh, axis)
+    for e0 in range(0, len(batches), ndev):
+        blocks = batches[e0:e0 + ndev]
+        cap = max(b.capacity for b in blocks)
+        str_cols = [ci for ci, f in enumerate(schema.fields)
+                    if blocks[0].column(ci).is_string_like]
+        widths = _discover_widths(blocks, str_cols, _broadcast_width_jits)
+        lane_meta, lane_datas, lane_valids = _lane_layout(schema, widths)
+        lives = []
+        for slot in range(ndev):
+            b = blocks[slot] if slot < len(blocks) else None
+            lives.append(_pad1(b.live_mask(), cap) if b is not None
+                         else jnp.zeros((cap,), jnp.bool_))
+            _pack_block(b, schema, cap, widths, lane_datas, lane_valids)
+
+        datas = tuple(shard(jnp.stack(ls)) for ls in lane_datas)
+        valids = tuple(shard(jnp.stack(ls)) for ls in lane_valids)
+        od, ov, ol = bcast(datas, valids, shard(jnp.stack(lives)))
+
+        # every shard holds the full table; shard 0's view builds the
+        # engine-facing batch. One readback for all char totals.
+        live_full = ol[0]
+        char_caps: Dict[int, int] = {}
+        str_lanes = [li for li, (_, k) in enumerate(lane_meta)
+                     if k == "str_mat"]
+        if str_lanes:
+            sums = jnp.stack([
+                jnp.sum(jnp.where(live_full, od[li + 1][0], 0))
+                for li in str_lanes])
+            host = np.asarray(jax.device_get(sums))
+            char_caps = {li: bucket_bytes(max(int(v), 1), minimum=16)
+                         for li, v in zip(str_lanes, host)}
+        cols, _ = _unpack_device(schema, lane_meta, od, ov, 0, live_full,
+                                 char_caps)
+        out.append(TpuBatch(cols, schema, ndev * cap,
+                            selection=live_full))
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -254,33 +459,6 @@ class IciShuffleTransport(ShuffleTransport):
             self._results[sid] = results
             self._pending.pop(sid, None)
 
-    def _block_widths(self, blocks, str_cols):
-        """Static byte width per string column across this epoch's
-        blocks: ONE jitted reduction + ONE small device readback (the
-        round-3 code paid a per-column, per-map readback)."""
-        if not str_cols:
-            return {}
-        caps_key = tuple(b.capacity for _, b, _ in blocks) + (
-            tuple(str_cols),)
-        fn = self._jit_widths.get(caps_key)
-        if fn is None:
-            def widths_fn(bs):
-                outs = []
-                for ci in str_cols:
-                    w = jnp.int32(0)
-                    for b in bs:
-                        c = b.column(ci)
-                        lens = c.offsets[1:] - c.offsets[:-1]
-                        lens = jnp.where(b.live_mask(), lens, 0)
-                        w = jnp.maximum(w, jnp.max(lens, initial=0))
-                    outs.append(w)
-                return jnp.stack(outs)
-            fn = jax.jit(widths_fn)
-            self._jit_widths[caps_key] = fn
-        vals = np.asarray(jax.device_get(fn([b for _, b, _ in blocks])))
-        return {ci: bucket_bytes(max(int(v), 1), minimum=8)
-                for ci, v in zip(str_cols, vals)}
-
     def _run_epoch(self, blocks, nparts: int, results):
         schema = blocks[0][1].schema
         ndev = self.ndev
@@ -288,23 +466,12 @@ class IciShuffleTransport(ShuffleTransport):
         cap = max(b.capacity for _, b, _ in blocks)
         str_cols = [ci for ci, f in enumerate(schema.fields)
                     if blocks[0][1].column(ci).is_string_like]
-        widths = self._block_widths(blocks, str_cols)
+        widths = _discover_widths([b for _, b, _ in blocks], str_cols,
+                                  self._jit_widths)
 
-        # lane layout: per column (str -> matrix+len lanes), plus with
-        # folding one extra lane carrying the ORIGINAL partition id
-        lane_datas: List[List[jax.Array]] = []
-        lane_valids: List[List[jax.Array]] = []
-        lane_meta: List[Tuple[int, str]] = []  # (col idx, kind)
-        for ci, f in enumerate(schema.fields):
-            if ci in widths:
-                lane_meta.append((ci, "str_mat"))
-                lane_meta.append((ci, "str_len"))
-                lane_datas.extend(([], []))
-                lane_valids.extend(([], []))
-            else:
-                lane_meta.append((ci, "fixed"))
-                lane_datas.append([])
-                lane_valids.append([])
+        # shared lane layout, plus with folding one extra lane carrying
+        # the ORIGINAL partition id
+        lane_meta, lane_datas, lane_valids = _lane_layout(schema, widths)
         if fold:
             lane_meta.append((-1, "pid"))
             lane_datas.append([])
@@ -323,31 +490,12 @@ class IciShuffleTransport(ShuffleTransport):
             # routing: partition p belongs to device p mod D
             pids_all.append(pids % ndev if fold else pids)
             live_all.append(live)
-            li = 0
-            for ci, f in enumerate(schema.fields):
-                if b is None:
-                    col = TpuColumnVector.nulls(f.dtype, cap)
-                else:
-                    col = b.column(ci)
-                valid = _pad1(col.validity, cap)
-                if ci in widths:
-                    w = widths[ci]
-                    mat, lens = _string_to_matrix(col, col.capacity, w)
-                    lane_datas[li].append(_pad2(mat, cap, w))
-                    lane_valids[li].append(valid)
-                    lane_datas[li + 1].append(_pad1(lens, cap))
-                    lane_valids[li + 1].append(valid)
-                    li += 2
-                else:
-                    lane_datas[li].append(_pad1(col.data, cap))
-                    lane_valids[li].append(valid)
-                    li += 1
+            _pack_block(b, schema, cap, widths, lane_datas, lane_valids)
             if fold:
-                lane_datas[li].append(pids)
-                lane_valids[li].append(live)
+                lane_datas[-1].append(pids)
+                lane_valids[-1].append(live)
 
-        shard = lambda a: jax.device_put(a, NamedSharding(
-            self.mesh, P(self.axis, *([None] * (a.ndim - 1)))))
+        shard = _mesh_shard(self.mesh, self.axis)
         datas = tuple(shard(jnp.stack(ls)) for ls in lane_datas)
         valids = tuple(shard(jnp.stack(ls)) for ls in lane_valids)
         pids_g = shard(jnp.stack(pids_all))
@@ -358,48 +506,25 @@ class IciShuffleTransport(ShuffleTransport):
 
         # ONE readback for everything host sizing needs this epoch:
         # per-device landed row counts + per-device live char totals
-        sizes = [out_rc]
-        for li, (ci, kind) in enumerate(lane_meta):
-            if kind == "str_len":
-                lens = out_datas[li]
-                sizes.append(jnp.sum(
-                    jnp.where(out_live, lens, 0), axis=1))
+        str_lanes = [li for li, (_, k) in enumerate(lane_meta)
+                     if k == "str_len"]
+        sizes = [out_rc] + [
+            jnp.sum(jnp.where(out_live, out_datas[li], 0), axis=1)
+            for li in str_lanes]
         sizes_host = np.asarray(jax.device_get(jnp.stack(sizes)))
 
         for d in range(ndev):
             if sizes_host[0][d] == 0:
                 continue
-            live_d = out_live[d]
-            cols: List[Optional[TpuColumnVector]] = [None] * len(
-                schema.fields)
-            pid_lane = None
-            li = 0
-            si = 1
-            while li < len(lane_meta):
-                ci, kind = lane_meta[li]
-                if kind == "pid":
-                    pid_lane = out_datas[li][d]
-                    li += 1
-                    continue
-                f = schema.fields[ci]
-                if kind == "str_mat":
-                    mat = out_datas[li][d]
-                    lens = out_datas[li + 1][d]
-                    valid = out_valids[li][d]
-                    ccap = bucket_bytes(max(int(sizes_host[si][d]), 1),
-                                        minimum=16)
-                    si += 1
-                    offs, chars = _matrix_to_string(mat, lens, live_d,
-                                                    ccap)
-                    cols[ci] = TpuColumnVector(f.dtype, validity=valid,
-                                               offsets=offs, chars=chars)
-                    li += 2
-                else:
-                    cols[ci] = TpuColumnVector(
-                        f.dtype, data=out_datas[li][d],
-                        validity=out_valids[li][d])
-                    li += 1
-            landed = TpuBatch(cols, schema, ndev * cap, selection=live_d)
+            char_caps = {
+                li - 1: bucket_bytes(max(int(sizes_host[1 + si][d]), 1),
+                                     minimum=16)
+                for si, li in enumerate(str_lanes)}
+            cols, pid_lane = _unpack_device(
+                schema, lane_meta, out_datas, out_valids, d, out_live[d],
+                char_caps)
+            landed = TpuBatch(cols, schema, ndev * cap,
+                              selection=out_live[d])
             if not fold:
                 results[d].append(landed)
             else:

@@ -328,3 +328,53 @@ def test_collect_global_no_keys():
         plan = TpuHashAggregateExec([], [Alias(cls(col("c0")), "vals")],
                                     src)
         assert_tpu_and_cpu_plan_equal(plan)
+
+
+# --- approx_percentile (SURVEY.md:177; exact sort-based build) ------------
+
+def _percentile_plan(gen, pcts, n=300, keys=True):
+    from spark_rapids_tpu.expr.aggregates import ApproxPercentile
+    src = HostBatchSourceExec(
+        [gen_table([IntegerGen(min_val=0, max_val=6, nullable=False),
+                    gen], n, seed=17, names=["k", "v"])])
+    keyexprs = [col("k")] if keys else []
+    return TpuHashAggregateExec(
+        keyexprs, [Alias(ApproxPercentile(col("v"), pcts), "p")], src)
+
+
+@pytest.mark.parametrize("gen", [IntegerGen(null_frac=0.2), LongGen(),
+                                 DoubleGen(null_frac=0.1),
+                                 FloatGen(dt.FLOAT32)],
+                         ids=lambda g: g.dtype.simple_string())
+def test_approx_percentile_scalar(gen):
+    plan = _percentile_plan(gen, 0.5)
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+def test_approx_percentile_list_and_edges():
+    plan = _percentile_plan(DoubleGen(null_frac=0.15),
+                            [0.0, 0.25, 0.5, 0.9, 1.0])
+    assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+def test_approx_percentile_global_and_all_null():
+    from spark_rapids_tpu.expr.aggregates import ApproxPercentile
+    import pyarrow as pa
+    rb = pa.record_batch({"v": pa.array([None] * 8, pa.float64())})
+    src = HostBatchSourceExec([rb])
+    plan = TpuHashAggregateExec(
+        [], [Alias(ApproxPercentile(col("v"), [0.5, 0.9]), "p")], src)
+    assert_tpu_and_cpu_plan_equal(plan)
+    plan2 = _percentile_plan(LongGen(nullable=False), 0.99, keys=False)
+    assert_tpu_and_cpu_plan_equal(plan2)
+
+
+def test_approx_percentile_rejects_strings():
+    from spark_rapids_tpu.expr.aggregates import ApproxPercentile
+    src = HostBatchSourceExec([gen_table([StringGen()], 10, 1,
+                                         names=["s"])])
+    plan = TpuHashAggregateExec(
+        [], [Alias(ApproxPercentile(col("s"), 0.5), "p")], src)
+    from spark_rapids_tpu.planner import TpuOverrides
+    pp = TpuOverrides().apply(plan)
+    assert pp.fallback_nodes(), "string percentile must fall back"

@@ -184,8 +184,13 @@ def test_like_simple(pattern):
 
 
 def test_like_complex_host_only():
+    # round 4: `_` wildcards transpile to the device regex dialect;
+    # only patterns outside it (non-ASCII) stay host-only
     e = Like(col("a"), "a_c")
-    assert e.tpu_supported() is not None
+    assert e.tpu_supported() is None
+    # non-ASCII + non-simple: outside both the literal shapes and the
+    # device regex dialect
+    assert Like(col("a"), "caf\u00e9_x").tpu_supported() is not None
     import pyarrow as pa
     from spark_rapids_tpu.expr.base import bind_expr, EvalCtx
     from spark_rapids_tpu.columnar.arrow_bridge import engine_schema

@@ -1,0 +1,138 @@
+"""Device regex transpiler tests (reference: regex transpiler +
+cudf-dialect gating — SURVEY.md:175; dual-run + placement asserts)."""
+import re
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import datatypes as dt
+from spark_rapids_tpu.exec import HostBatchSourceExec
+from spark_rapids_tpu.exec.basic import TpuProjectExec
+from spark_rapids_tpu.expr import UnresolvedColumn as col
+from spark_rapids_tpu.expr.base import Alias
+from spark_rapids_tpu.expr.strings import Like, RegExpLike
+from spark_rapids_tpu.ops.regex import (RegexUnsupported, compile_pattern,
+                                        like_to_regex)
+from spark_rapids_tpu.planner import TpuOverrides
+
+from asserts import assert_tpu_and_cpu_plan_equal
+
+DIALECT_PATTERNS = [
+    "abc", "^abc", "abc$", "^abc$", "a.c", "ab*c", "ab+c", "ab?c",
+    "[abc]x", "[^abc]x", "[a-f0-9]+", "\\d+", "\\w+z", "\\s",
+    "cat|dog|bird", "^(?:)?".replace("(?:)?", "x*"), "a[b-d]*e$",
+    "^\\d\\d-\\d\\d", "x.*y", ".*", "a*", "^$", "colou?r",
+    "[A-Z][a-z]+", "end\\.$", "a|", "\\.com$",
+]
+
+STRINGS = ["", "abc", "xabc", "abcx", "a c", "abbbc", "ac", "bx", "zx",
+           "deadbeef", "12-34x", "x123y", "cat", "hotdog", "birds",
+           "color", "colour", "Widget", "a.c", "end.", "foo.com",
+           "aaa", "cde", None, "CAT", "42", " ", "ab\ncd"]
+
+
+def _source():
+    return HostBatchSourceExec(
+        [pa.record_batch({"s": pa.array(STRINGS, pa.string())})])
+
+
+@pytest.mark.parametrize("pattern", DIALECT_PATTERNS)
+def test_rlike_device_matches_host_re(pattern):
+    plan = TpuProjectExec(
+        [Alias(RegExpLike(col("s"), pattern), "m")], _source())
+    pp = TpuOverrides().apply(plan)
+    assert not pp.fallback_nodes(), \
+        f"{pattern!r} should be on device: {pp.explain('ALL')}"
+    got = pp.collect().column("m").to_pylist()
+    want = [None if s is None else bool(re.search(pattern, s))
+            for s in STRINGS]
+    assert got == want, (pattern, list(zip(STRINGS, got, want)))
+
+
+@pytest.mark.parametrize("pattern", [
+    "(ab)+", "a{2,3}", "(?i)abc", "a(?=b)", "\\bword", "a|b|(cd)",
+    "café",
+])
+def test_rlike_outside_dialect_falls_back(pattern):
+    plan = TpuProjectExec(
+        [Alias(RegExpLike(col("s"), pattern), "m")], _source())
+    pp = TpuOverrides().apply(plan)
+    assert pp.fallback_nodes(), f"{pattern!r} must fall back"
+    # the planner-placed (host) path still answers like the oracle
+    from spark_rapids_tpu.exec.base import collect_arrow_cpu
+    got = pp.collect().column("m").to_pylist()
+    want = collect_arrow_cpu(plan).column("m").to_pylist()
+    assert got == want
+
+
+def test_rlike_dual_run_generated_strings():
+    from data_gen import StringGen, gen_table
+    rb = gen_table([StringGen(max_len=12, charset="abc01 .",
+                              null_frac=0.15)], 300, seed=9,
+                   names=["s"])
+    for pattern in ("^a", "b$", "[ab]+c", "\\d\\d", "a.*c", "c|0"):
+        plan = TpuProjectExec(
+            [Alias(RegExpLike(col("s"), pattern), "m")],
+            HostBatchSourceExec([rb]))
+        assert_tpu_and_cpu_plan_equal(plan, label=pattern)
+
+
+def test_like_general_patterns_on_device():
+    # beyond the literal shapes: _ wildcards and mixed %_% now device
+    from data_gen import StringGen, gen_table
+    rb = gen_table([StringGen(max_len=10, charset="abcx_%",
+                              null_frac=0.1)], 200, seed=3, names=["s"])
+    for pattern in ("a_c", "%a_c%", "a%b%c", "_bc%", "%a%b%"):
+        plan = TpuProjectExec(
+            [Alias(Like(col("s"), pattern), "m")],
+            HostBatchSourceExec([rb]))
+        pp = TpuOverrides().apply(plan)
+        assert not pp.fallback_nodes(), pattern
+        assert_tpu_and_cpu_plan_equal(plan, label=pattern)
+
+
+def test_like_to_regex_translation():
+    assert like_to_regex("a%b_c") == "^a[\\s\\S]*b[\\s\\S]c$"
+    assert like_to_regex("100\\%") == "^100%$"
+    assert like_to_regex("a.b") == "^a\\.b$"
+
+
+def test_like_wildcards_match_newlines_on_device():
+    # SQL LIKE wildcards cross newlines; regex '.' would not (the bug a
+    # review pass caught): device and CPU must agree on \n-bearing rows
+    rb = pa.record_batch({"s": pa.array(
+        ["a\nb", "a\nb\nc", "axb", "ab", None])})
+    for pattern in ("a_b", "a%b%c", "%\n%"):
+        plan = TpuProjectExec(
+            [Alias(Like(col("s"), pattern), "m")],
+            HostBatchSourceExec([rb]))
+        pp = TpuOverrides().apply(plan)
+        assert not pp.fallback_nodes(), pattern
+        assert_tpu_and_cpu_plan_equal(plan, label=pattern)
+
+
+def test_compile_rejects_and_fuzz_parity():
+    for bad in ("(a)", "a{2}", "a**", "[z-a]", "\\q"):
+        with pytest.raises(RegexUnsupported):
+            compile_pattern(bad)
+    # randomized parity sweep on the dialect
+    rng = np.random.default_rng(0)
+    alphabet = "abc0 ."
+    strings = ["".join(rng.choice(list(alphabet),
+                                  rng.integers(0, 10)).tolist())
+               for _ in range(60)]
+    import jax
+    from spark_rapids_tpu.columnar.arrow_bridge import arrow_to_device
+    batch = arrow_to_device(
+        pa.record_batch({"s": pa.array(strings, pa.string())}))
+    from spark_rapids_tpu.ops.regex import regex_match_device
+    for pattern in ("a+b", "[ab]c*", "^c|0$", "\\d", "\\s", "a.b",
+                    "b?c", "[^a]+$"):
+        prog = compile_pattern(pattern)
+        got = np.asarray(jax.device_get(
+            regex_match_device(batch.column(0), prog)))[:len(strings)]
+        want = np.array([bool(re.search(pattern, s)) for s in strings])
+        assert (got == want).all(), \
+            (pattern, [s for s, g, w in zip(strings, got, want)
+                       if g != w])

@@ -125,3 +125,57 @@ def test_range(spark):
 def test_explain_renders(spark):
     text = _df(spark).filter(GreaterThan(col("v"), Literal(1))).explain()
     assert "will run on TPU" in text
+
+
+# --- pivot (Spark's conditional-aggregate rewrite) ------------------------
+
+def _pivot_frame(session):
+    import numpy as np
+    import pyarrow as pa
+    rng = np.random.default_rng(8)
+    n = 400
+    return session.create_dataframe(pa.table({
+        "dept": pa.array(rng.choice(["eng", "ops", "fin"], n).tolist()),
+        "year": pa.array(rng.choice([2023, 2024], n)),
+        "pay": pa.array(rng.integers(50, 200, n).astype("int64")),
+    }))
+
+
+def test_pivot_explicit_values():
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.expr import UnresolvedColumn as col
+    from spark_rapids_tpu.expr.base import Alias
+    s = TpuSession()
+    df = _pivot_frame(s)
+    got = df.group_by("dept").pivot("year", [2023, 2024]).agg(
+        Alias(Sum(col("pay")), "s")).collect().to_pandas() \
+        .sort_values("dept").reset_index(drop=True)
+    pdf = df.collect().to_pandas()
+    want = pdf.pivot_table(index="dept", columns="year", values="pay",
+                           aggfunc="sum").reset_index()
+    want.columns = ["dept", "2023", "2024"]
+    want = want.sort_values("dept").reset_index(drop=True)
+    import pandas.testing as pdt
+    pdt.assert_frame_equal(got, want, check_dtype=False,
+                           check_names=False)
+
+
+def test_pivot_inferred_values_and_multi_agg():
+    from spark_rapids_tpu.expr.aggregates import Count, Max
+    from spark_rapids_tpu.expr import UnresolvedColumn as col
+    from spark_rapids_tpu.expr.base import Alias
+    s = TpuSession()
+    df = _pivot_frame(s)
+    got = df.group_by("year").pivot("dept").agg(
+        Alias(Count(), "n"), Alias(Max(col("pay")), "m")).collect()
+    assert sorted(got.column_names) == sorted(
+        ["year", "eng_n", "eng_m", "ops_n", "ops_m", "fin_n", "fin_m"])
+    pdf = df.collect().to_pandas()
+    g = got.to_pandas().sort_values("year").reset_index(drop=True)
+    for dept in ("eng", "ops", "fin"):
+        sub = pdf[pdf.dept == dept].groupby("year").agg(
+            n=("pay", "size"), m=("pay", "max")).reset_index() \
+            .sort_values("year").reset_index(drop=True)
+        assert (g["year"] == sub["year"]).all()
+        assert (g[f"{dept}_n"] == sub["n"]).all()
+        assert (g[f"{dept}_m"] == sub["m"]).all()
