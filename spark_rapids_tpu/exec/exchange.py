@@ -100,6 +100,12 @@ class TpuShuffleExchangeExec(UnaryExec):
             if dt.is_nested(e.dtype):
                 return (f"partitioning by nested type "
                         f"{e.dtype.simple_string()} not on device")
+        from ..shuffle.ici import IciShuffleTransport, _lane_spec
+        if isinstance(self.transport, IciShuffleTransport):
+            try:  # payload shapes the ICI lanes can't carry: plan-time
+                _lane_spec(self.child.output_schema)
+            except NotImplementedError as e:
+                return str(e)
         return None
 
     def _split(self, batch: TpuBatch, ectx):
@@ -242,11 +248,15 @@ class TpuBroadcastExchangeExec(UnaryExec):
         self._sb = None  # SpillableBatch
 
     def tpu_supported(self):
-        from ..ops.concat import device_concat_supported
-        for f in self.child.output_schema.fields:
-            if not device_concat_supported(f.dtype):
-                return (f"broadcast of nested column {f.name} not on "
-                        "device (no nested device concat yet)")
+        if self.mesh is not None:
+            # the collective path carries column trees as lanes; shapes
+            # it can't encode must fall back at PLAN time, not raise
+            # mid-query
+            from ..shuffle.ici import _lane_spec
+            try:
+                _lane_spec(self.child.output_schema)
+            except NotImplementedError as e:
+                return str(e)
         return None
 
     def spillable(self, ctx: ExecCtx):

@@ -163,7 +163,11 @@ class TpuSortExec(UnaryExec):
                 yield from self._sort_out_of_core(batches, orders, ctx)
                 return
             t0 = time.perf_counter()
-            merged = concat_batches(batches)
+            # bounded concat: sync-free (an exact-size readback here
+            # would flip tunneled devices to synchronous dispatch for
+            # the whole query — it cost NDS order_by queries ~100x)
+            from ..ops.concat import concat_batches_bounded
+            merged = concat_batches_bounded(batches)
             out = self._jitted(merged, orders, ctx.eval_ctx)
             if ctx.sync_metrics:
                 out.block_until_ready()
@@ -316,20 +320,24 @@ class TpuLocalLimitExec(UnaryExec):
         return f"LocalLimitExec [{self.limit}]"
 
     def execute(self, ctx: ExecCtx):
+        """Sync-free truncation: a device-resident cumulative row count
+        clamps each batch's row_count to the rows still allowed — no
+        host readback of batch sizes (the old per-batch num_rows sync
+        put every downstream dispatch into the tunnel's synchronous
+        regime). Batches past the limit flow through with zero live
+        rows instead of an early break — the no-sync trade."""
+        import jax.numpy as jnp
+
         from ..ops.gather import ensure_compacted
-        remaining = self.limit
+        seen = jnp.int32(0)
         for batch in self.child.execute(ctx):
-            if remaining <= 0:
-                return
             batch = ensure_compacted(batch)  # truncation needs prefix rows
-            n = batch.num_rows
-            if n <= remaining:
-                remaining -= n
-                yield batch
-            else:
-                yield batch.with_columns(batch.columns,
-                                         row_count=remaining)
-                return
+            start = seen
+            rc = batch.row_count
+            seen = seen + rc.astype(jnp.int32)
+            allowed = jnp.clip(jnp.int32(self.limit) - start, 0,
+                               rc.astype(jnp.int32))
+            yield batch.with_columns(batch.columns, row_count=allowed)
 
     def execute_cpu(self, ctx: ExecCtx):
         remaining = self.limit

@@ -1,0 +1,81 @@
+"""Pallas TPU kernels — the hand-written tier below XLA.
+
+SURVEY.md §7.1.3 left open whether hand-written Pallas kernels beat
+XLA's fusion for this engine's hot loops (the reference's answer is
+libcudf CUDA kernels for everything; the TPU bet was that XLA fusion
+covers most of it). This module carries the measured answer
+(VERDICT r3 item 10): `masked_product_sum` is the q6 inner loop —
+filter conjuncts + product + reduction in ONE pass over VMEM tiles —
+implemented with explicit Pallas tiling, A/B-benchmarked against the
+identical jnp/XLA formulation in bench.py (`pallas_ab`).
+
+The kernel grids over row tiles reshaped to (rows/128, 128) lanes; each
+step reduces its tile into a (1, 1) accumulator ref (sequential grid
+steps on TPU make the += safe). `interpret=True` keeps it runnable on
+the CPU test mesh.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["masked_product_sum_pallas", "masked_product_sum_xla"]
+
+_TILE_ROWS = 2048
+_LANES = 128
+
+
+def masked_product_sum_xla(quantity, price, discount, shipdate):
+    """The q6 inner loop as XLA sees it (what the engine's fused
+    filter->project->agg pipeline lowers to)."""
+    mask = ((shipdate >= 8766) & (shipdate < 9131)
+            & (discount >= 0.05) & (discount <= 0.07)
+            & (quantity < 24.0))
+    return jnp.sum(jnp.where(mask, price * discount, 0.0),
+                   dtype=jnp.float32)
+
+
+def _kernel(q_ref, p_ref, d_ref, s_ref, out_ref):
+    q = q_ref[...]
+    p = p_ref[...]
+    d = d_ref[...]
+    s = s_ref[...]
+    mask = ((s >= 8766) & (s < 9131) & (d >= 0.05) & (d <= 0.07)
+            & (q < 24.0))
+    vals = jnp.where(mask, p * d, 0.0)
+    # reduce the (TILE_ROWS, 128) tile to a min-tile (8, 128) partial —
+    # a (1, 1) accumulator is below the f32 tile floor and fails Mosaic
+    out_ref[...] = jnp.sum(vals.reshape(-1, 8, _LANES), axis=0,
+                           dtype=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def masked_product_sum_pallas(quantity, price, discount, shipdate,
+                              interpret: bool = False):
+    """Pallas edition: one grid-free kernel invocation per VMEM-sized
+    chunk (the axon remote compiler 500s on any GRIDDED Mosaic kernel —
+    bisected empirically — so chunking happens at the XLA level:
+    several pallas_call ops composed under one jit, partial (8, 128)
+    tiles summed outside). Row count must be a multiple of
+    _TILE_ROWS*_LANES (the bench pads; engine batches are power-of-two
+    capacities anyway)."""
+    from jax.experimental import pallas as pl
+    n = quantity.shape[0]
+    rows = n // _LANES
+    chunks = rows // _TILE_ROWS
+    call = pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((8, _LANES), jnp.float32),
+        interpret=interpret)
+    parts = []
+    for c in range(chunks):
+        lo = c * _TILE_ROWS * _LANES
+        hi = lo + _TILE_ROWS * _LANES
+        shape2d = (_TILE_ROWS, _LANES)
+        parts.append(call(quantity[lo:hi].reshape(shape2d),
+                          price[lo:hi].reshape(shape2d),
+                          discount[lo:hi].reshape(shape2d),
+                          shipdate[lo:hi].reshape(shape2d)))
+    return jnp.sum(jnp.stack(parts), dtype=jnp.float32)

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..columnar.batch import TpuBatch, bucket_bytes
+from ..columnar.batch import TpuBatch, bucket_bytes, bucket_rows
 from ..columnar.column import TpuColumnVector
 from .transport import ShuffleTransport, ShuffleWriteHandle
 
@@ -163,22 +163,75 @@ def make_ici_broadcast(mesh: Mesh, axis: str = "x"):
     return fn
 
 
-def _discover_widths(blocks: List[TpuBatch], str_cols,
-                     jit_cache: Dict[tuple, object]) -> Dict[int, int]:
-    """Static byte width per string column across blocks: ONE jitted
-    reduction + ONE small device readback (round 3 paid a per-column,
-    per-map readback). Shared by the all-to-all and broadcast paths."""
-    if not str_cols:
+def _node_at(col: TpuColumnVector, path) -> TpuColumnVector:
+    for k in path:
+        col = col.children[k]
+    return col
+
+
+def _lane_spec(schema):
+    """Flatten each top-level column's TYPE TREE into lane descriptors
+    (ci, path, kind, node dtype): structs contribute a validity lane
+    plus their children's lanes (paths index through struct fields, so
+    every var-width node stays row-aligned); strings ride as
+    (byte-matrix, lengths); arrays of fixed-width elements as (element
+    matrix, element-validity matrix, lengths). Maps and deeper nesting
+    raise NotImplementedError -> the planner keeps such plans off this
+    transport."""
+    from .. import datatypes as dt
+    lanes: List[tuple] = []
+
+    def walk(ci, path, t):
+        if isinstance(t, dt.MapType):
+            raise NotImplementedError(
+                "map columns cannot ride the ICI collective yet")
+        if isinstance(t, dt.NullType):
+            lanes.append((ci, path, "null", t))
+        elif t.is_variable_width and not dt.is_nested(t):  # string/binary
+            lanes.append((ci, path, "str_mat", t))
+            lanes.append((ci, path, "str_len", t))
+        elif isinstance(t, dt.ArrayType):
+            et = t.element_type
+            if et.np_dtype is None or dt.is_nested(et) \
+                    or isinstance(et, dt.NullType):
+                raise NotImplementedError(
+                    f"array<{et.simple_string()}> cannot ride the ICI "
+                    "collective yet (fixed-width elements only)")
+            lanes.append((ci, path, "arr_mat", t))
+            lanes.append((ci, path, "arr_vmat", t))
+            lanes.append((ci, path, "arr_len", t))
+        elif isinstance(t, dt.StructType):
+            lanes.append((ci, path, "node_valid", t))
+            for k, f in enumerate(t.fields):
+                walk(ci, path + (k,), f.dtype)
+        else:
+            lanes.append((ci, path, "fixed", t))
+
+    for ci, f in enumerate(schema.fields):
+        walk(ci, (), f.dtype)
+    return lanes
+
+
+def _discover_widths(blocks: List[TpuBatch], spec,
+                     jit_cache: Dict[tuple, object]) -> Dict[tuple, int]:
+    """Static matrix width per var-width node ((ci, path) keyed: max
+    live byte/element count) across blocks: ONE jitted reduction + ONE
+    small device readback (round 3 paid a per-column, per-map readback).
+    Shared by the all-to-all and broadcast paths."""
+    var_nodes = [(ci, path, kind) for ci, path, kind, _ in spec
+                 if kind in ("str_mat", "arr_mat")]
+    if not var_nodes:
         return {}
-    caps_key = tuple(b.capacity for b in blocks) + (tuple(str_cols),)
+    caps_key = tuple(b.capacity for b in blocks) + (tuple(
+        (ci, path) for ci, path, _ in var_nodes),)
     fn = jit_cache.get(caps_key)
     if fn is None:
         def widths_fn(bs):
             outs = []
-            for ci in str_cols:
+            for ci, path, _ in var_nodes:
                 w = jnp.int32(0)
                 for b in bs:
-                    c = b.column(ci)
+                    c = _node_at(b.column(ci), path)
                     lens = c.offsets[1:] - c.offsets[:-1]
                     lens = jnp.where(b.live_mask(), lens, 0)
                     w = jnp.maximum(w, jnp.max(lens, initial=0))
@@ -187,52 +240,53 @@ def _discover_widths(blocks: List[TpuBatch], str_cols,
         fn = jax.jit(widths_fn)
         jit_cache[caps_key] = fn
     vals = np.asarray(jax.device_get(fn(blocks)))
-    return {ci: bucket_bytes(max(int(v), 1), minimum=8)
-            for ci, v in zip(str_cols, vals)}
+    return {(ci, path): bucket_bytes(max(int(v), 1), minimum=8)
+            for (ci, path, _), v in zip(var_nodes, vals)}
 
 
-def _lane_layout(schema, widths: Dict[int, int]):
-    """(lane_meta, empty lane_datas/lane_valids lists): one fixed lane
-    per plain column, (byte-matrix, lengths) lane pair per string."""
-    lane_datas: List[List[jax.Array]] = []
-    lane_valids: List[List[jax.Array]] = []
-    lane_meta: List[Tuple[int, str]] = []
-    for ci, _ in enumerate(schema.fields):
-        if ci in widths:
-            lane_meta.extend([(ci, "str_mat"), (ci, "str_len")])
-            lane_datas.extend(([], []))
-            lane_valids.extend(([], []))
-        else:
-            lane_meta.append((ci, "fixed"))
-            lane_datas.append([])
-            lane_valids.append([])
+def _lane_layout(spec):
+    lane_datas: List[List[jax.Array]] = [[] for _ in spec]
+    lane_valids: List[List[jax.Array]] = [[] for _ in spec]
+    lane_meta = list(spec)
     return lane_meta, lane_datas, lane_valids
 
 
 def _pack_block(b: Optional[TpuBatch], schema, cap: int,
-                widths: Dict[int, int], lane_datas, lane_valids):
+                widths: Dict[tuple, int], lane_datas, lane_valids,
+                spec):
     """Append one block's (possibly None = empty slot) column lanes."""
-    for li_base, ci, f in _cols_in_lane_order(schema, widths):
-        col = b.column(ci) if b is not None \
-            else TpuColumnVector.nulls(f.dtype, cap)
-        valid = _pad1(col.validity, cap)
-        if ci in widths:
-            w = widths[ci]
-            mat, lens = _string_to_matrix(col, col.capacity, w)
-            lane_datas[li_base].append(_pad2(mat, cap, w))
-            lane_valids[li_base].append(valid)
-            lane_datas[li_base + 1].append(_pad1(lens, cap))
-            lane_valids[li_base + 1].append(valid)
+    for li, (ci, path, kind, t) in enumerate(spec):
+        if b is not None:
+            node = _node_at(b.column(ci), path)
         else:
-            lane_datas[li_base].append(_pad1(col.data, cap))
-            lane_valids[li_base].append(valid)
-
-
-def _cols_in_lane_order(schema, widths):
-    li = 0
-    for ci, f in enumerate(schema.fields):
-        yield li, ci, f
-        li += 2 if ci in widths else 1
+            node = TpuColumnVector.nulls(t, cap)
+        valid = _pad1(node.validity, cap)
+        lane_valids[li].append(valid)
+        if kind == "fixed":
+            lane_datas[li].append(_pad1(node.data, cap))
+        elif kind in ("null", "node_valid"):
+            # validity rides the lane-valid channel; the data channel is
+            # a zero-width matrix so nothing redundant crosses the mesh
+            lane_datas[li].append(jnp.zeros((cap, 0), jnp.int8))
+        elif kind == "str_mat":
+            w = widths[(ci, path)]
+            mat, _ = _ragged_to_matrix(node.offsets, node.chars,
+                                       node.capacity, w)
+            lane_datas[li].append(_pad2(mat, cap, w))
+        elif kind == "arr_mat":
+            w = widths[(ci, path)]
+            mat, _ = _ragged_to_matrix(node.offsets, node.children[0].data,
+                                       node.capacity, w)
+            lane_datas[li].append(_pad2(mat, cap, w))
+        elif kind == "arr_vmat":
+            w = widths[(ci, path)]
+            mat, _ = _ragged_to_matrix(node.offsets,
+                                       node.children[0].validity,
+                                       node.capacity, w)
+            lane_datas[li].append(_pad2(mat, cap, w))
+        else:  # str_len / arr_len
+            lens = (node.offsets[1:] - node.offsets[:-1]).astype(jnp.int32)
+            lane_datas[li].append(_pad1(lens, cap))
 
 
 def _mesh_shard(mesh: Mesh, axis: str):
@@ -240,32 +294,68 @@ def _mesh_shard(mesh: Mesh, axis: str):
         mesh, P(axis, *([None] * (a.ndim - 1)))))
 
 
-def _unpack_device(schema, lane_meta, out_datas, out_valids, d: int,
-                   live_d, char_caps: Dict[int, int]):
+def _len_lane_indices(spec):
+    """Lane indices whose landed live sums size the ragged rebuilds."""
+    return [li for li, (_, _, kind, _) in enumerate(spec)
+            if kind in ("str_len", "arr_len")]
+
+
+def _unpack_device(schema, spec, out_datas, out_valids, d: int,
+                   live_d, flat_caps: Dict[int, int]):
     """Rebuild one device's landed columns from exchanged lanes;
-    char_caps maps str-lane index -> chars capacity. Returns (cols,
-    pid_lane or None)."""
-    cols: List[Optional[TpuColumnVector]] = [None] * len(schema.fields)
+    flat_caps maps a mat-lane index -> flat payload capacity. Returns
+    (cols, pid_lane or None)."""
+    from .. import datatypes as dt
+    nodes: Dict[tuple, TpuColumnVector] = {}
     pid_lane = None
     li = 0
-    while li < len(lane_meta):
-        ci, kind = lane_meta[li]
-        if kind == "pid":
+    while li < len(spec):
+        entry = spec[li]
+        if entry[2] == "pid":
             pid_lane = out_datas[li][d]
             li += 1
             continue
-        f = schema.fields[ci]
-        if kind == "str_mat":
-            offs, chars = _matrix_to_string(
-                out_datas[li][d], out_datas[li + 1][d], live_d,
-                char_caps[li])
-            cols[ci] = TpuColumnVector(f.dtype, validity=out_valids[li][d],
-                                       offsets=offs, chars=chars)
-            li += 2
-        else:
-            cols[ci] = TpuColumnVector(f.dtype, data=out_datas[li][d],
-                                       validity=out_valids[li][d])
+        ci, path, kind, t = entry
+        if kind == "fixed":
+            nodes[(ci, path)] = TpuColumnVector(
+                t, data=out_datas[li][d], validity=out_valids[li][d])
             li += 1
+        elif kind in ("null", "node_valid"):
+            nodes[(ci, path)] = TpuColumnVector(
+                t, validity=out_valids[li][d])
+            li += 1
+        elif kind == "str_mat":
+            offs, chars = _matrix_to_ragged(
+                out_datas[li][d], out_datas[li + 1][d], live_d,
+                flat_caps[li])
+            nodes[(ci, path)] = TpuColumnVector(
+                t, validity=out_valids[li][d], offsets=offs, chars=chars)
+            li += 2
+        else:  # arr_mat (+ arr_vmat + arr_len)
+            ecap = flat_caps[li]
+            lens = out_datas[li + 2][d]
+            offs, elems = _matrix_to_ragged(out_datas[li][d], lens,
+                                            live_d, ecap)
+            _, evalid = _matrix_to_ragged(out_datas[li + 1][d], lens,
+                                          live_d, ecap)
+            et = t.element_type
+            elem_col = TpuColumnVector(et, data=elems, validity=evalid)
+            nodes[(ci, path)] = TpuColumnVector(
+                t, validity=out_valids[li][d], offsets=offs,
+                children=[elem_col])
+            li += 3
+
+    def assemble(ci, path, t):
+        if isinstance(t, dt.StructType):
+            base = nodes[(ci, path)]
+            children = [assemble(ci, path + (k,), f.dtype)
+                        for k, f in enumerate(t.fields)]
+            return TpuColumnVector(t, validity=base.validity,
+                                   children=children)
+        return nodes[(ci, path)]
+
+    cols = [assemble(ci, (), f.dtype)
+            for ci, f in enumerate(schema.fields)]
     return cols, pid_lane
 
 
@@ -287,39 +377,42 @@ def ici_broadcast_batches(mesh: Mesh, batches: List[TpuBatch],
     schema = batches[0].schema
     out: List[TpuBatch] = []
     shard = _mesh_shard(mesh, axis)
+    spec = _lane_spec(schema)
     for e0 in range(0, len(batches), ndev):
         blocks = batches[e0:e0 + ndev]
         cap = max(b.capacity for b in blocks)
-        str_cols = [ci for ci, f in enumerate(schema.fields)
-                    if blocks[0].column(ci).is_string_like]
-        widths = _discover_widths(blocks, str_cols, _broadcast_width_jits)
-        lane_meta, lane_datas, lane_valids = _lane_layout(schema, widths)
+        widths = _discover_widths(blocks, spec, _broadcast_width_jits)
+        lane_meta, lane_datas, lane_valids = _lane_layout(spec)
         lives = []
         for slot in range(ndev):
             b = blocks[slot] if slot < len(blocks) else None
             lives.append(_pad1(b.live_mask(), cap) if b is not None
                          else jnp.zeros((cap,), jnp.bool_))
-            _pack_block(b, schema, cap, widths, lane_datas, lane_valids)
+            _pack_block(b, schema, cap, widths, lane_datas, lane_valids,
+                        spec)
 
         datas = tuple(shard(jnp.stack(ls)) for ls in lane_datas)
         valids = tuple(shard(jnp.stack(ls)) for ls in lane_valids)
         od, ov, ol = bcast(datas, valids, shard(jnp.stack(lives)))
 
         # every shard holds the full table; shard 0's view builds the
-        # engine-facing batch. One readback for all char totals.
+        # engine-facing batch. One readback for all payload totals.
         live_full = ol[0]
-        char_caps: Dict[int, int] = {}
-        str_lanes = [li for li, (_, k) in enumerate(lane_meta)
-                     if k == "str_mat"]
-        if str_lanes:
+        flat_caps: Dict[int, int] = {}
+        len_lanes = _len_lane_indices(spec)
+        if len_lanes:
             sums = jnp.stack([
-                jnp.sum(jnp.where(live_full, od[li + 1][0], 0))
-                for li in str_lanes])
+                jnp.sum(jnp.where(live_full, od[li][0], 0))
+                for li in len_lanes])
             host = np.asarray(jax.device_get(sums))
-            char_caps = {li: bucket_bytes(max(int(v), 1), minimum=16)
-                         for li, v in zip(str_lanes, host)}
+            for li, v in zip(len_lanes, host):
+                total = max(int(v), 1)
+                if spec[li][2] == "str_len":
+                    flat_caps[li - 1] = bucket_bytes(total, minimum=16)
+                else:
+                    flat_caps[li - 2] = bucket_rows(total)
         cols, _ = _unpack_device(schema, lane_meta, od, ov, 0, live_full,
-                                 char_caps)
+                                 flat_caps)
         out.append(TpuBatch(cols, schema, ndev * cap,
                             selection=live_full))
     return out
@@ -329,32 +422,43 @@ def ici_broadcast_batches(mesh: Mesh, batches: List[TpuBatch],
 # Transport-seam integration
 # --------------------------------------------------------------------------
 
-def _string_to_matrix(col: TpuColumnVector, cap: int, width: int):
-    """(offsets, chars) -> ((cap, width) byte matrix, (cap,) lengths)."""
-    offs = col.offsets
-    lengths = (offs[1:] - offs[:-1]).astype(jnp.int32)
+def _ragged_to_matrix(offsets, values, cap: int, width: int):
+    """(offsets, flat values) -> ((cap, width) matrix, (cap,) lengths).
+    Works for string chars (uint8) and array elements (any fixed
+    dtype) alike — ragged payloads ride the collective as padded
+    matrices."""
+    lengths = (offsets[1:] - offsets[:-1]).astype(jnp.int32)
     j = jnp.arange(width, dtype=jnp.int32)[None, :]
-    src = jnp.clip(offs[:-1, None] + j, 0, max(col.chars.shape[0] - 1, 0))
-    if col.chars.shape[0] == 0:
-        mat = jnp.zeros((cap, width), jnp.uint8)
-    else:
-        mat = jnp.where(j < lengths[:, None], col.chars[src], jnp.uint8(0))
+    vcap = values.shape[0]
+    if vcap == 0:
+        return jnp.zeros((cap, width), values.dtype), lengths
+    src = jnp.clip(offsets[:-1, None] + j, 0, vcap - 1)
+    mat = jnp.where(j < lengths[:, None], values[src],
+                    jnp.zeros((), values.dtype))
     return mat, lengths
 
 
+def _string_to_matrix(col: TpuColumnVector, cap: int, width: int):
+    return _ragged_to_matrix(col.offsets, col.chars, cap, width)
+
+
 @partial(jax.jit, static_argnums=(3,))
-def _matrix_to_string(mat, lengths, live, char_cap: int):
-    """Inverse: ((n, B), (n,), (n,)) -> (offsets (n+1,), chars)."""
+def _matrix_to_ragged(mat, lengths, live, flat_cap: int):
+    """Inverse: ((n, B), (n,), (n,)) -> (offsets (n+1,), flat values)."""
     n = lengths.shape[0]
     ll = jnp.where(live, lengths, 0)
     offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                                jnp.cumsum(ll).astype(jnp.int32)])
     total = offsets[-1]
-    k = jnp.arange(char_cap, dtype=jnp.int32)
+    k = jnp.arange(flat_cap, dtype=jnp.int32)
     row = jnp.clip(jnp.searchsorted(offsets, k, side="right") - 1, 0, n - 1)
     colk = jnp.clip(k - offsets[row], 0, mat.shape[1] - 1)
-    chars = jnp.where(k < total, mat[row, colk], jnp.uint8(0))
-    return offsets, chars
+    flat = jnp.where(k < total, mat[row, colk],
+                     jnp.zeros((), mat.dtype))
+    return offsets, flat
+
+
+_matrix_to_string = _matrix_to_ragged
 
 
 class _IciWriter(ShuffleWriteHandle):
@@ -370,12 +474,8 @@ class _IciWriter(ShuffleWriteHandle):
             "the per-partition write path belongs to host transports")
 
     def write_unsplit(self, batch: TpuBatch, pids) -> None:
-        for c, f in zip(batch.columns, batch.schema.fields):
-            if c.children is not None:
-                raise NotImplementedError(
-                    f"nested column {f.name} "
-                    f"({f.dtype.simple_string()}) cannot ride the ICI "
-                    "collective yet (fixed-width and string lanes only)")
+        _lane_spec(batch.schema)  # raises NotImplementedError early for
+        # shapes the lanes can't carry (maps, nested arrays)
         nbytes = batch.device_size_bytes()
         # the conf is a PER-SHARD ceiling; a map batch spreads over the
         # whole mesh, so the whole-batch bound is ceiling x mesh size
@@ -464,16 +564,15 @@ class IciShuffleTransport(ShuffleTransport):
         ndev = self.ndev
         fold = nparts != ndev
         cap = max(b.capacity for _, b, _ in blocks)
-        str_cols = [ci for ci, f in enumerate(schema.fields)
-                    if blocks[0][1].column(ci).is_string_like]
-        widths = _discover_widths([b for _, b, _ in blocks], str_cols,
+        spec = _lane_spec(schema)
+        widths = _discover_widths([b for _, b, _ in blocks], spec,
                                   self._jit_widths)
 
         # shared lane layout, plus with folding one extra lane carrying
         # the ORIGINAL partition id
-        lane_meta, lane_datas, lane_valids = _lane_layout(schema, widths)
+        lane_meta, lane_datas, lane_valids = _lane_layout(spec)
         if fold:
-            lane_meta.append((-1, "pid"))
+            lane_meta.append((-1, (), "pid", None))
             lane_datas.append([])
             lane_valids.append([])
 
@@ -490,7 +589,8 @@ class IciShuffleTransport(ShuffleTransport):
             # routing: partition p belongs to device p mod D
             pids_all.append(pids % ndev if fold else pids)
             live_all.append(live)
-            _pack_block(b, schema, cap, widths, lane_datas, lane_valids)
+            _pack_block(b, schema, cap, widths, lane_datas, lane_valids,
+                        spec)
             if fold:
                 lane_datas[-1].append(pids)
                 lane_valids[-1].append(live)
@@ -505,24 +605,26 @@ class IciShuffleTransport(ShuffleTransport):
             datas, valids, pids_g, live_g)
 
         # ONE readback for everything host sizing needs this epoch:
-        # per-device landed row counts + per-device live char totals
-        str_lanes = [li for li, (_, k) in enumerate(lane_meta)
-                     if k == "str_len"]
+        # per-device landed row counts + per-device live payload totals
+        len_lanes = _len_lane_indices(spec)
         sizes = [out_rc] + [
             jnp.sum(jnp.where(out_live, out_datas[li], 0), axis=1)
-            for li in str_lanes]
+            for li in len_lanes]
         sizes_host = np.asarray(jax.device_get(jnp.stack(sizes)))
 
         for d in range(ndev):
             if sizes_host[0][d] == 0:
                 continue
-            char_caps = {
-                li - 1: bucket_bytes(max(int(sizes_host[1 + si][d]), 1),
-                                     minimum=16)
-                for si, li in enumerate(str_lanes)}
+            flat_caps = {}
+            for si, li in enumerate(len_lanes):
+                total = max(int(sizes_host[1 + si][d]), 1)
+                if spec[li][2] == "str_len":
+                    flat_caps[li - 1] = bucket_bytes(total, minimum=16)
+                else:  # arr_len sits after (arr_mat, arr_vmat)
+                    flat_caps[li - 2] = bucket_rows(total)
             cols, pid_lane = _unpack_device(
                 schema, lane_meta, out_datas, out_valids, d, out_live[d],
-                char_caps)
+                flat_caps)
             landed = TpuBatch(cols, schema, ndev * cap,
                               selection=out_live[d])
             if not fold:

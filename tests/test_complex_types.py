@@ -192,3 +192,110 @@ def test_explode_nested_passthrough_falls_back():
     got = pp.collect()
     want = collect_arrow_cpu(plan, ExecCtx())
     assert got.to_pylist() == want.to_pylist()
+
+
+# --- round 4: nested types ride the engine (VERDICT r3 item 6) ------------
+
+def test_device_concat_arrays_and_structs():
+    from spark_rapids_tpu.exec.exchange import TpuCoalesceBatchesExec
+    from data_gen import (ArrayGen, IntegerGen, LongGen, StringGen,
+                          StructGen, gen_table)
+    rbs = [gen_table([ArrayGen(IntegerGen(null_frac=0.2), null_frac=0.2),
+                      StructGen([("a", LongGen()),
+                                 ("b", StringGen(max_len=6))]),
+                      StringGen(max_len=5)], 60, seed=30 + i)
+           for i in range(4)]
+    plan = TpuCoalesceBatchesExec(HostBatchSourceExec(rbs),
+                                  target_rows=150)
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_broadcast_of_array_column():
+    from spark_rapids_tpu.exec.exchange import TpuBroadcastExchangeExec
+    from data_gen import ArrayGen, DoubleGen, IntegerGen, gen_table
+    rbs = [gen_table([IntegerGen(), ArrayGen(DoubleGen(null_frac=0.1))],
+                     40, seed=60 + i) for i in range(3)]
+    plan = TpuBroadcastExchangeExec(HostBatchSourceExec(rbs))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_ici_exchange_nested_lanes():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.shuffle import HashPartitioning
+    from spark_rapids_tpu.shuffle.ici import IciShuffleTransport
+    from data_gen import (ArrayGen, IntegerGen, LongGen, StringGen,
+                          StructGen, gen_table)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("x",))
+    rbs = [gen_table([IntegerGen(nullable=False),
+                      ArrayGen(LongGen(null_frac=0.2), null_frac=0.15),
+                      StructGen([("p", IntegerGen()),
+                                 ("q", StringGen(max_len=7))])],
+                     30, seed=80 + i) for i in range(8)]
+    plan = TpuShuffleExchangeExec(
+        HashPartitioning([col("c0")], 8), HostBatchSourceExec(rbs),
+        transport=IciShuffleTransport(mesh))
+    assert_tpu_and_cpu_plan_equal(plan)
+
+
+def test_explode_shuffle_agg_over_mesh():
+    # THE done-criterion shape: array column scans to device, explodes,
+    # rides the ICI exchange, aggregates — through the planner on the
+    # mesh (SURVEY.md:179)
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.exec.generate import TpuGenerateExec
+    from spark_rapids_tpu.expr.aggregates import Count, Sum
+    from spark_rapids_tpu.expr.base import Alias
+    from spark_rapids_tpu.shuffle import HashPartitioning
+    from spark_rapids_tpu.shuffle.ici import IciShuffleTransport
+    from spark_rapids_tpu.planner import TpuOverrides
+    from spark_rapids_tpu.exec.base import collect_arrow_cpu
+    from data_gen import ArrayGen, IntegerGen, gen_table
+    mesh = Mesh(np.array(jax.devices()[:8]), ("x",))
+    rbs = [gen_table([ArrayGen(IntegerGen(min_val=0, max_val=12,
+                                          null_frac=0.1),
+                               null_frac=0.1)], 40, seed=90 + i,
+                     names=["xs"]) for i in range(8)]
+    gen = TpuGenerateExec(col("xs"), HostBatchSourceExec(rbs),
+                          outer=False, element_name="x")
+    ex = TpuShuffleExchangeExec(HashPartitioning([col("x")], 8), gen,
+                                transport=IciShuffleTransport(mesh))
+    agg = TpuHashAggregateExec([col("x")], [Alias(Count(), "n")], ex)
+    plan = TpuOverrides().apply(agg)
+    assert not plan.fallback_nodes(), plan.explain("ALL")
+    got = plan.collect().to_pandas().sort_values("x").reset_index(
+        drop=True)
+    want = collect_arrow_cpu(agg).to_pandas().sort_values(
+        "x").reset_index(drop=True)
+    import pandas.testing as pdt
+    pdt.assert_frame_equal(got, want, check_dtype=False)
+
+
+def test_hive_partition_values_on_read(tmp_path):
+    import pyarrow as pa
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession()
+    tbl = pa.table({
+        "k": pa.array([1, 2, 3, 4, 5, 6], pa.int64()),
+        "region": pa.array(["eu", "us", "eu", "us", "eu", "us"]),
+        "yr": pa.array([2023, 2023, 2024, 2024, 2023, 2024]),
+    })
+    df = s.create_dataframe(tbl)
+    paths = df.write(str(tmp_path / "t"), partition_by=["region", "yr"])
+    back = s.read_parquet(paths)
+    got = back.collect().to_pandas().sort_values("k").reset_index(
+        drop=True)
+    assert sorted(got.columns) == ["k", "region", "yr"]
+    want = tbl.to_pandas().sort_values("k").reset_index(drop=True)
+    import pandas.testing as pdt
+    pdt.assert_frame_equal(got[["k", "region", "yr"]],
+                           want[["k", "region", "yr"]],
+                           check_dtype=False)
+    # partition type inference: yr came back integral
+    assert str(got["yr"].dtype).startswith("int")

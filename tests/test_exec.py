@@ -180,3 +180,20 @@ def test_sample_deterministic():
     a = collect_arrow(TpuSampleExec(0.5, 7, src), ExecCtx())
     b = collect_arrow(TpuSampleExec(0.5, 7, src), ExecCtx())
     assert a.to_pylist() == b.to_pylist()
+
+
+def test_pallas_masked_product_sum_matches_xla():
+    # interpret mode on the CPU mesh; the real-chip A/B lives in bench.py
+    import numpy as np
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops.pallas_kernels import (
+        masked_product_sum_pallas, masked_product_sum_xla)
+    n = 2048 * 128
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.uniform(1, 50, n).astype(np.float32))
+    p = jnp.asarray(rng.uniform(900, 105000, n).astype(np.float32))
+    d = jnp.asarray((rng.integers(0, 11, n) / 100.0).astype(np.float32))
+    s = jnp.asarray(rng.integers(8000, 10600, n).astype(np.int32))
+    want = float(masked_product_sum_xla(q, p, d, s))
+    got = float(masked_product_sum_pallas(q, p, d, s, True))
+    assert abs(got - want) <= 1e-3 * max(1.0, abs(want)), (got, want)

@@ -1,0 +1,46 @@
+"""NDS subset dual-run: every corpus query through the planner-built
+device path vs its pandas oracle (reference: integration_tests NDS job
+definitions — SURVEY.md §6)."""
+import numpy as np
+import pandas.testing as pdt
+import pytest
+
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.tools.nds import (QUERIES, build_query, gen_tables,
+                                        pandas_oracle)
+
+TABLES = gen_tables(n_sales=1 << 14)
+
+
+@pytest.mark.parametrize("name", sorted(QUERIES))
+def test_nds_query_matches_pandas(name):
+    s = TpuSession()
+    df = build_query(name, s, TABLES)
+    got = df.collect().to_pandas().reset_index(drop=True)
+    want = pandas_oracle(name, TABLES).reset_index(drop=True)
+    want.columns = [str(c) for c in want.columns]
+    assert list(got.columns) == list(want.columns), \
+        (got.columns, want.columns)
+    # numeric tolerance: device float aggregation order differs
+    for c in got.columns:
+        if np.issubdtype(np.asarray(want[c]).dtype, np.floating):
+            assert np.allclose(got[c].to_numpy(dtype=float),
+                               want[c].to_numpy(dtype=float),
+                               rtol=1e-6, atol=1e-6), c
+        else:
+            pdt.assert_series_equal(got[c], want[c], check_dtype=False,
+                                    check_names=False)
+
+
+def test_nds_plans_fully_on_device():
+    # every corpus query must place every operator on the TPU: any
+    # fallback is a coverage regression the suite should catch
+    from spark_rapids_tpu.planner import TpuOverrides
+    s = TpuSession()
+    for name in sorted(QUERIES):
+        df = build_query(name, s, TABLES)
+        pp = TpuOverrides(s.conf).apply(df._plan()._node
+                                        if hasattr(df._plan(), "_node")
+                                        else df._node)
+        assert not pp.fallback_nodes(), \
+            f"{name}: {pp.explain('NOT_ON_GPU')}"
