@@ -1,0 +1,468 @@
+"""Multi-process execution: driver + worker OS processes.
+
+TPU analog of the reference's executor model (SURVEY.md:186-189, §3.4:
+separate executor JVMs exchanging shuffle blocks; reference mount empty).
+This is rung 1 of the blueprint's shuffle ladder verbatim — "plain Spark
+host shuffle of Arrow-serialized batches, works day one, any topology"
+(SURVEY.md:524-527): each worker is a real OS process with its own
+device runtime; stages exchange through the HOST transport's Arrow-IPC
+files on a shared filesystem; the driver is the scheduler.
+
+Execution model (Spark's, §2.6 data parallelism):
+  - the driver splits the physical plan at shuffle-exchange boundaries
+    into stages, deepest first;
+  - a map stage ships each worker a pickled plan slice (a partition of
+    the stage's leaf input) + the exchange's Partitioning; workers
+    execute on their own device runtime and write per-(map, partition)
+    Arrow IPC files via `HostShuffleTransport`;
+  - the next stage's plan reads those files through
+    `ProcessShuffleReadExec` (each worker owns a partition range);
+  - the final stage's per-partition results concatenate on the driver.
+
+Scheduling/rendezvous is filesystem-based (task pickles + done/err
+markers) — no sockets to configure, matching how Spark's shuffle files
+need only shared storage. Task pickles carry only plan structure (plans
+are pickled BEFORE any execution, so jit caches are empty).
+"""
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+import time
+import traceback
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+
+from . import datatypes as dt
+from .config import RapidsConf
+from .exec.base import ExecCtx, LeafExec, TpuExec
+
+__all__ = ["TpuProcessCluster", "ProcessShuffleReadExec",
+           "run_process_query"]
+
+
+class ProcessShuffleReadExec(LeafExec):
+    """Reduce-side leaf: streams the Arrow-IPC partition files a map
+    stage wrote (the RapidsCachingReader / shuffle-fetch analog for the
+    file transport — SURVEY.md §2.2-D)."""
+
+    def __init__(self, shuffle_root: str, shuffle_id: int,
+                 partitions: Sequence[int], schema: dt.Schema):
+        super().__init__()
+        self.shuffle_root = shuffle_root
+        self.shuffle_id = shuffle_id
+        self.partitions = list(partitions)
+        self._schema = schema
+
+    @property
+    def output_schema(self):
+        return self._schema
+
+    def describe(self):
+        return (f"ProcessShuffleReadExec [s{self.shuffle_id} "
+                f"p={self.partitions}]")
+
+    def tpu_supported(self):
+        return None
+
+    def _files(self, pid: int) -> List[str]:
+        d = os.path.join(self.shuffle_root, f"s{self.shuffle_id}")
+        if not os.path.isdir(d):
+            return []
+        suffix = f"_p{pid}.arrow"
+        return [os.path.join(d, n) for n in sorted(os.listdir(d))
+                if n.endswith(suffix)]
+
+    def _host_batches(self):
+        for pid in self.partitions:
+            for path in self._files(pid):
+                with pa.OSFile(path, "rb") as f:
+                    table = pa.ipc.open_file(f).read_all()
+                for rb in table.combine_chunks().to_batches():
+                    if rb.num_rows:
+                        yield rb
+
+    def execute(self, ctx: ExecCtx):
+        from .columnar.arrow_bridge import arrow_to_device
+        for rb in self._host_batches():
+            yield arrow_to_device(rb, self._schema)
+
+    def execute_cpu(self, ctx: ExecCtx):
+        yield from self._host_batches()
+
+
+# --- worker-side task execution (one function per task kind) ---------------
+
+def _run_map_task(payload: Dict) -> None:
+    """Execute a map plan slice and write its partitions as Arrow IPC
+    files (HostShuffleTransport is the writer; batch i of this slice is
+    map id base+i so multi-batch slices never collide)."""
+    from .shuffle.host import HostShuffleTransport
+    conf = RapidsConf(payload["conf"])
+    plan: TpuExec = payload["plan"]
+    partitioning = payload["partitioning"].bind(plan.output_schema)
+    transport = HostShuffleTransport(conf, threads=0,
+                                     root=payload["shuffle_root"])
+    sid = payload["shuffle_id"]
+    transport.register_shuffle(sid, partitioning.num_partitions)
+    ctx = ExecCtx(conf)
+    base = payload["map_id_base"]
+    for i, batch in enumerate(plan.execute(ctx)):
+        pids = partitioning.partition_ids_device(batch, ctx.eval_ctx)
+        writer = transport.writer(sid, base + i)
+        writer.write_unsplit(batch, pids)
+        writer.close()
+
+
+def _run_collect_task(payload: Dict) -> None:
+    """Execute a (reduce/final) plan slice on this worker's device and
+    write the result as one Arrow IPC file."""
+    from .columnar.arrow_bridge import arrow_schema, device_to_arrow
+    conf = RapidsConf(payload["conf"])
+    plan: TpuExec = payload["plan"]
+    ctx = ExecCtx(conf)
+    rbs = [device_to_arrow(b) for b in plan.execute(ctx)]
+    target = arrow_schema(plan.output_schema)
+    out = payload["out"]
+    with pa.OSFile(out + ".tmp", "wb") as f, \
+            pa.ipc.new_file(f, target) as w:
+        for rb in rbs:
+            if rb.num_rows:
+                w.write_batch(rb)
+    os.replace(out + ".tmp", out)
+
+
+_TASK_KINDS = {"map": _run_map_task, "collect": _run_collect_task}
+
+
+def worker_main(root: str, worker_id: int, poll_s: float = 0.02) -> None:
+    """Worker process loop: claim task files addressed to this worker,
+    run them, write .ok/.err markers. Exits on root/shutdown."""
+    tasks_dir = os.path.join(root, "tasks")
+    while True:
+        if os.path.exists(os.path.join(root, "shutdown")):
+            return
+        ran = False
+        try:
+            names = sorted(os.listdir(tasks_dir))
+        except FileNotFoundError:
+            names = []
+        for name in names:
+            if not name.endswith(f".w{worker_id}.task"):
+                continue
+            path = os.path.join(tasks_dir, name)
+            done = path + ".ok"
+            err = path + ".err"
+            if os.path.exists(done) or os.path.exists(err):
+                continue
+            try:
+                with open(path, "rb") as f:
+                    kind, payload = pickle.load(f)
+                _TASK_KINDS[kind](payload)
+                with open(done + ".tmp", "w") as f:
+                    f.write("ok")
+                os.replace(done + ".tmp", done)
+            except BaseException:
+                with open(err + ".tmp", "w") as f:
+                    f.write(traceback.format_exc())
+                os.replace(err + ".tmp", err)
+            ran = True
+        if not ran:
+            time.sleep(poll_s)
+
+
+class TpuProcessCluster:
+    """Spawn N worker processes against a filesystem rendezvous root.
+    Workers run `python -m spark_rapids_tpu.cluster --root R --worker K`
+    with an isolated (CPU by default) JAX runtime each — genuinely
+    separate OS processes with nothing shared but the filesystem."""
+
+    def __init__(self, n_workers: int = 2, root: Optional[str] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 platform: str = "cpu"):
+        self.n_workers = n_workers
+        self.root = root or tempfile.mkdtemp(prefix="rapids_tpu_cluster_")
+        self._own_root = root is None
+        os.makedirs(os.path.join(self.root, "tasks"), exist_ok=True)
+        os.makedirs(os.path.join(self.root, "shuffle"), exist_ok=True)
+        os.makedirs(os.path.join(self.root, "results"), exist_ok=True)
+        wenv = dict(os.environ)
+        wenv["JAX_PLATFORMS"] = platform
+        # environments whose sitecustomize re-pins JAX_PLATFORMS at
+        # interpreter start (the axon tunnel does) need the worker to
+        # re-assert the platform after imports — carried separately
+        wenv["RAPIDS_TPU_WORKER_PLATFORM"] = platform
+        if env:
+            wenv.update(env)
+        self._procs = [
+            subprocess.Popen(
+                [sys.executable, "-m", "spark_rapids_tpu.cluster",
+                 "--root", self.root, "--worker", str(w)],
+                env=wenv, stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE)
+            for w in range(n_workers)]
+        self._task_seq = 0
+        self._sid_seq = 0
+
+    # --- task plumbing ----------------------------------------------------
+
+    def _submit(self, worker: int, kind: str, payload: Dict) -> str:
+        self._task_seq += 1
+        name = f"t{self._task_seq:05d}.w{worker}.task"
+        path = os.path.join(self.root, "tasks", name)
+        with open(path + ".tmp", "wb") as f:
+            pickle.dump((kind, payload), f, protocol=4)
+        os.replace(path + ".tmp", path)
+        return path
+
+    def _wait(self, paths: Sequence[str], timeout: float = 300.0) -> None:
+        deadline = time.time() + timeout
+        pending = set(paths)
+        while pending:
+            for p in list(pending):
+                if os.path.exists(p + ".ok"):
+                    pending.discard(p)
+                elif os.path.exists(p + ".err"):
+                    with open(p + ".err") as f:
+                        raise RuntimeError(
+                            f"worker task {os.path.basename(p)} failed:\n"
+                            + f.read())
+            for proc in self._procs:
+                if proc.poll() is not None:
+                    err = proc.stderr.read().decode(errors="replace") \
+                        if proc.stderr else ""
+                    raise RuntimeError(
+                        f"worker died rc={proc.returncode}: {err[-2000:]}")
+            if time.time() > deadline:
+                raise TimeoutError(f"tasks {pending} timed out")
+            if pending:
+                time.sleep(0.02)
+
+    def shutdown(self) -> None:
+        with open(os.path.join(self.root, "shutdown"), "w") as f:
+            f.write("1")
+        for p in self._procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if self._own_root:
+            import shutil
+            shutil.rmtree(self.root, ignore_errors=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    # --- query execution --------------------------------------------------
+
+    def run_query(self, plan: TpuExec,
+                  conf: Optional[RapidsConf] = None) -> pa.Table:
+        """Execute a physical plan across the worker processes: stages
+        split at shuffle exchanges, map outputs exchanged as Arrow IPC
+        files, final per-partition results concatenated here."""
+        conf = conf or RapidsConf()
+        settings = conf.items()
+        plan = copy.deepcopy(plan)
+        shuffle_root = os.path.join(self.root, "shuffle")
+        # run map stages deepest-first until no exchange remains
+        while True:
+            exch = _deepest_exchange(plan)
+            if exch is None:
+                break
+            self._sid_seq += 1
+            sid = self._sid_seq
+            slices = _split_leaf_input(exch.child, self.n_workers)
+            paths = []
+            for w, child_slice in enumerate(slices):
+                paths.append(self._submit(w % self.n_workers, "map", {
+                    "plan": child_slice,
+                    "partitioning": exch.partitioning,
+                    "shuffle_root": shuffle_root,
+                    "shuffle_id": sid,
+                    "map_id_base": w * 100_000,
+                    "conf": settings,
+                }))
+            self._wait(paths)
+            n = exch.partitioning.num_partitions
+            read = ProcessShuffleReadExec(shuffle_root, sid, list(range(n)),
+                                          exch.child.output_schema)
+            plan = _replace_node(plan, exch, read)
+        # final stage: split the partition ranges of every shuffle read
+        outs = []
+        paths = []
+        for w in range(self.n_workers):
+            final = _slice_partitions(copy.deepcopy(plan), w,
+                                      self.n_workers)
+            if final is None:
+                if w == 0:
+                    final = plan  # no shuffle read: one worker runs all
+                else:
+                    continue
+            out = os.path.join(self.root, "results",
+                               f"q{self._task_seq}_w{w}.arrow")
+            outs.append(out)
+            paths.append(self._submit(w, "collect",
+                                      {"plan": final, "out": out,
+                                       "conf": settings}))
+        self._wait(paths)
+        tables = []
+        for out in outs:
+            with pa.OSFile(out, "rb") as f:
+                tables.append(pa.ipc.open_file(f).read_all())
+        from .columnar.arrow_bridge import arrow_schema
+        target = arrow_schema(plan.output_schema)
+        tables = [t.cast(target) for t in tables if t.num_rows] \
+            or [pa.table({f.name: pa.array([], f.type) for f in target},
+                         schema=target)]
+        return pa.concat_tables(tables)
+
+
+def run_process_query(plan: TpuExec, n_workers: int = 2,
+                      conf: Optional[RapidsConf] = None) -> pa.Table:
+    """One-shot convenience: spin a cluster up, run, tear down."""
+    with TpuProcessCluster(n_workers) as cluster:
+        return cluster.run_query(plan, conf)
+
+
+# --- plan surgery ----------------------------------------------------------
+
+def _deepest_exchange(plan: TpuExec):
+    """A shuffle exchange with no exchange below it (next runnable map
+    stage), or None."""
+    from .exec.exchange import TpuShuffleExchangeExec
+    found = None
+
+    def walk(node):
+        nonlocal found
+        for c in getattr(node, "children", ()):
+            walk(c)
+        if isinstance(node, TpuShuffleExchangeExec) and found is None:
+            if not _contains_exchange(node.child):
+                found = node
+
+    walk(plan)
+    return found
+
+
+def _contains_exchange(plan: TpuExec) -> bool:
+    from .exec.exchange import TpuShuffleExchangeExec
+    if isinstance(plan, TpuShuffleExchangeExec):
+        return True
+    return any(_contains_exchange(c)
+               for c in getattr(plan, "children", ()))
+
+
+def _replace_node(plan: TpuExec, old: TpuExec, new: TpuExec) -> TpuExec:
+    if plan is old:
+        return new
+    kids = getattr(plan, "children", ())
+    if kids:
+        plan.children = tuple(_replace_node(c, old, new) for c in kids)
+    return plan
+
+
+def _split_leaf_input(plan: TpuExec, n: int) -> List[TpuExec]:
+    """Partition a map stage's input among n tasks: stages fed by an
+    earlier shuffle split by partition range; otherwise by splitting the
+    leaf (scan paths / host batches, round-robin). Un-splittable leaves
+    mean one map task — still a correct stage, just not parallel."""
+    from .exec.base import HostBatchSourceExec
+    from .io.scan import TpuFileScanExec
+
+    if _contains_read(plan):
+        out = []
+        for w in range(n):
+            p = _slice_partitions(copy.deepcopy(plan), w, n)
+            if p is not None:
+                out.append(p)
+        if out:
+            return out
+    leaf = plan
+    while getattr(leaf, "children", ()):
+        if len(leaf.children) != 1:
+            return [plan]  # joins below an exchange: single map task
+        leaf = leaf.children[0]
+    if isinstance(leaf, TpuFileScanExec) and len(leaf.paths) > 1:
+        groups = [leaf.paths[i::n] for i in range(n)]
+        out = []
+        for g in groups:
+            if not g:
+                continue
+            p = copy.deepcopy(plan)
+            lf = p
+            while getattr(lf, "children", ()):
+                lf = lf.children[0]
+            lf.paths = list(g)
+            out.append(p)
+        return out
+    if isinstance(leaf, HostBatchSourceExec) and len(leaf.batches) > 1:
+        out = []
+        for i in range(n):
+            g = leaf.batches[i::n]
+            if not g:
+                continue
+            p = copy.deepcopy(plan)
+            lf = p
+            while getattr(lf, "children", ()):
+                lf = lf.children[0]
+            lf.batches = list(g)
+            out.append(p)
+        return out
+    return [plan]
+
+
+def _contains_read(plan: TpuExec) -> bool:
+    if isinstance(plan, ProcessShuffleReadExec):
+        return True
+    return any(_contains_read(c) for c in getattr(plan, "children", ()))
+
+
+def _slice_partitions(plan: TpuExec, w: int, n: int):
+    """Restrict every ProcessShuffleReadExec to worker w's share of its
+    partitions; None when w gets no partitions anywhere."""
+    reads: List[ProcessShuffleReadExec] = []
+
+    def walk(node):
+        if isinstance(node, ProcessShuffleReadExec):
+            reads.append(node)
+        for c in getattr(node, "children", ()):
+            walk(c)
+
+    walk(plan)
+    if not reads:
+        return None
+    any_parts = False
+    for r in reads:
+        mine = r.partitions[w::n]
+        # joins: both sides must see the SAME partition slice (they
+        # were hash-partitioned by the same key count)
+        r.partitions = mine
+        if mine:
+            any_parts = True
+    return plan if any_parts else None
+
+
+def _main(argv: Sequence[str]) -> None:
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", required=True)
+    ap.add_argument("--worker", type=int, required=True)
+    args = ap.parse_args(argv)
+    plat = os.environ.get("RAPIDS_TPU_WORKER_PLATFORM")
+    if plat:
+        os.environ["JAX_PLATFORMS"] = plat
+        import jax
+        jax.config.update("jax_platforms", plat)
+    worker_main(args.root, args.worker)
+
+
+if __name__ == "__main__":
+    _main(sys.argv[1:])

@@ -179,6 +179,15 @@ PARQUET_READER_TYPE = register(
 PARQUET_MULTITHREADED_THREADS = register(
     "spark.rapids.sql.format.parquet.multiThreadedRead.numThreads", 8,
     "Reader thread pool size for MULTITHREADED parquet.")
+PARQUET_DEVICE_DECODE = register(
+    "spark.rapids.sql.format.parquet.deviceDecode.enabled", True,
+    "Decode Parquet pages on the device: encoded column chunks "
+    "(dictionary indices, RLE runs, PLAIN bytes) cross the host->device "
+    "link instead of fully-decoded columns, and PLAIN/DICTIONARY/"
+    "RLE-bitpacked expansion runs as an XLA program in HBM (the "
+    "GpuParquetScan-decodes-into-HBM analog). Column chunks outside "
+    "the supported envelope (nested, strings, v2 pages, DELTA_*, LZ4) "
+    "decode on host per chunk.")
 CSV_ENABLED = register(
     "spark.rapids.sql.format.csv.enabled", True,
     "Enable accelerated CSV reads.")
@@ -194,11 +203,28 @@ MAX_PARTITION_BYTES = register(
     conv=_bytes_conv)
 # --- AQE ------------------------------------------------------------------
 ADAPTIVE_ENABLED = register(
-    "spark.sql.adaptive.enabled", False,
-    "Adaptive re-planning at shuffle stage boundaries (partition "
-    "coalescing + skew split). Default OFF here: the stats readback is "
-    "a host sync, which permanently degrades tunneled devices to "
-    "synchronous dispatch; co-located deployments should enable it.")
+    "spark.sql.adaptive.enabled", True,
+    "Adaptive re-planning at shuffle stage boundaries: runtime "
+    "join-strategy switch (shuffled->broadcast when the materialized "
+    "build side is small), partition coalescing + skew split, exchange "
+    "reuse. On by default: the join switch decides from sync-free "
+    "capacity metadata, and partition stats are only consulted where "
+    "the transport gathered them for free (see "
+    "spark.rapids.sql.adaptive.freeStatsOnly).")
+ADAPTIVE_FREE_STATS = register(
+    "spark.rapids.sql.adaptive.freeStatsOnly", True,
+    "With AQE: only use per-partition statistics a transport already "
+    "has (the ICI exchange folds them into its existing epoch "
+    "readback); transports that would need a dedicated device->host "
+    "sync (which permanently degrades tunneled devices to synchronous "
+    "dispatch) report none and the reader passes through. Set false on "
+    "co-located hosts to let every transport sync for stats.")
+AUTO_BROADCAST_THRESHOLD = register(
+    "spark.sql.autoBroadcastJoinThreshold", 10 << 20,
+    "AQE demotes a shuffled hash join to broadcast when the "
+    "materialized build-side stage is at most this many bytes "
+    "(capacity-based estimate, no device sync). -1 disables.",
+    conv=_bytes_conv)
 ADAPTIVE_COALESCE = register(
     "spark.sql.adaptive.coalescePartitions.enabled", True,
     "With AQE: merge adjacent shuffle partitions below the advisory "
@@ -220,6 +246,24 @@ SCAN_PREFETCH_BATCHES = register(
     "Decoded batches uploaded ahead of the consumer: host->device "
     "transfer of batch N+1 overlaps device compute on batch N "
     "(SURVEY.md §7.3.4). 0 disables the upload pipeline.")
+
+APPROX_PERCENTILE_EXACT = register(
+    "spark.rapids.sql.approxPercentile.exact", True,
+    "approx_percentile strategy: true = exact rank over the single-pass "
+    "group sort (rank error 0; concatenates the whole input like "
+    "collect_*); false = mergeable fixed-width quantile summary "
+    "(t-digest-style) that partials/merges per batch and across the "
+    "mesh — rank error ~1/sqrt(accuracy) per merge level, bounded "
+    "memory.")
+
+JOIN_VERIFY_UNIQUE_HINT = register(
+    "spark.rapids.sql.join.verifyUniqueHint", True,
+    "Verify DataFrame.join(..., build_unique=True) hints: a false hint "
+    "would silently drop duplicate matches. When the build analysis "
+    "readback happens anyway the hint is validated for free (falling "
+    "back to the duplicate-correct staged path); on the zero-readback "
+    "fast path a device-side duplicate probe is recorded and raised at "
+    "the query's first natural download — no extra host sync.")
 
 # --- UDF ------------------------------------------------------------------
 UDF_COMPILER_ENABLED = register(

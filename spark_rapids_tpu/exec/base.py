@@ -80,6 +80,58 @@ class ExecCtx:
             m[name] = TpuMetric(name)
         return m[name]
 
+    # --- query-end cleanup ------------------------------------------------
+
+    def register_cleanup(self, fn) -> None:
+        """Run `fn` when the query finishes (shared exchange handles,
+        etc.). Invoked by the collect paths; idempotent."""
+        if not hasattr(self, "_cleanups"):
+            self._cleanups = []
+        self._cleanups.append(fn)
+
+    def run_cleanups(self) -> None:
+        fns = getattr(self, "_cleanups", None)
+        if not fns:
+            return
+        self._cleanups = []
+        for fn in fns:
+            fn()
+
+    # --- deferred device-side checks --------------------------------------
+    # Assertions whose predicate lives on the device (a bool scalar,
+    # True = violated). Reading it back eagerly would cost a host sync —
+    # which on tunneled devices permanently degrades dispatch to the
+    # synchronous regime — so violations are recorded here and raised at
+    # the query's first NATURAL readback (collect/download), before any
+    # result reaches the caller. Used by the join's build_unique hint
+    # probe and the regex engine's ASCII-data gate.
+
+    def add_deferred_check(self, flag, message: str) -> None:
+        if not hasattr(self, "deferred_checks"):
+            self.deferred_checks = []
+        self.deferred_checks.append((flag, message))
+
+    def discard_deferred(self) -> None:
+        """Drop pending checks without evaluating — called when a query
+        FAILED before its natural sync point, so a reused ctx does not
+        report the dead query's flags (and their device buffers are
+        released)."""
+        self.deferred_checks = []
+
+    def check_deferred(self) -> None:
+        """Evaluate and clear pending device-side checks; raises on the
+        first batch of violations (ONE fused readback for all flags)."""
+        checks = getattr(self, "deferred_checks", None)
+        if not checks:
+            return
+        import jax
+        self.deferred_checks = []
+        flags = jax.device_get([f for f, _ in checks])
+        bad = [msg for (_, msg), v in zip(checks, flags) if bool(v)]
+        if bad:
+            raise RuntimeError(
+                "deferred device checks failed:\n  " + "\n  ".join(bad))
+
 
 class TpuExec:
     """Base physical operator."""
@@ -305,8 +357,15 @@ class DeviceBatchSourceExec(LeafExec):
 def collect_arrow(plan: TpuExec, ctx: Optional[ExecCtx] = None) -> pa.Table:
     """Run the TPU path and download results as one Arrow table."""
     ctx = ctx or ExecCtx()
-    with ctx.mm.task_slot():  # admission control (GpuSemaphore analog)
-        batches = [device_to_arrow(b) for b in plan.execute(ctx)]
+    try:
+        with ctx.mm.task_slot():  # admission control (GpuSemaphore analog)
+            batches = [device_to_arrow(b) for b in plan.execute(ctx)]
+    except BaseException:
+        ctx.discard_deferred()  # a reused ctx must not report dead flags
+        raise
+    finally:
+        ctx.run_cleanups()
+    ctx.check_deferred()  # the download was the natural sync point
     from ..columnar.arrow_bridge import arrow_schema
     return pa.Table.from_batches(batches, schema=arrow_schema(
         plan.output_schema))

@@ -29,6 +29,11 @@ __all__ = ["TpuShuffleExchangeExec", "TpuBroadcastExchangeExec",
            "TpuCoalesceBatchesExec", "ShuffleStageHandle"]
 
 _shuffle_ids = itertools.count()
+# guards lazy creation of per-exchange shared locks (see
+# materialize_shared — instances must stay picklable, so no Lock in
+# __init__)
+import threading as _threading
+_SHARED_LOCK_INIT = _threading.Lock()
 
 
 class ShuffleStageHandle:
@@ -41,10 +46,30 @@ class ShuffleStageHandle:
         self.sid = sid
         self.num_partitions = n
 
-    def partition_stats(self) -> Optional[List[int]]:
+    def partition_stats(self, free_only: bool = False) \
+            -> Optional[List[int]]:
         """Approximate bytes per partition, or None when the transport
-        cannot provide them (AQE then passes through)."""
+        cannot provide them (AQE then passes through). With free_only,
+        only stats the transport gathered as part of work it already
+        did (no dedicated sync) are returned."""
+        import inspect
         fn = getattr(self.transport, "partition_stats", None)
+        if fn is None:
+            return None
+        # signature probe, not try/except TypeError: a genuine
+        # TypeError inside the transport's stats math must propagate
+        try:
+            has_kw = "free_only" in inspect.signature(fn).parameters
+        except (TypeError, ValueError):
+            has_kw = False
+        if has_kw:
+            return fn(self.sid, free_only=free_only)
+        return None if free_only else fn(self.sid)
+
+    def total_bytes(self) -> Optional[int]:
+        """Stage size from capacity metadata — NO device sync (the AQE
+        join-strategy switch's input). None when unknown."""
+        fn = getattr(self.transport, "stage_bytes", None)
         return fn(self.sid) if fn is not None else None
 
     def read(self, p: int):
@@ -66,6 +91,13 @@ class TpuShuffleExchangeExec(UnaryExec):
         # None = resolve from spark.rapids.shuffle.mode at execute
         self.transport = transport
         self._jit_split = None
+        # exchange reuse (AQE, SURVEY.md:161): when the planner sees the
+        # same exchange consumed twice (self-joins), it flags it shared;
+        # the stage then materializes once and the handle outlives each
+        # consumer (closed by the query-level cleanup)
+        self.shared = False
+        self._shared_handle: Optional["ShuffleStageHandle"] = None
+        self._shared_lock = None
 
     def _resolve_transport(self, ctx: ExecCtx) -> ShuffleTransport:
         if self.transport is None:
@@ -158,7 +190,38 @@ class TpuShuffleExchangeExec(UnaryExec):
             writer.close()
         return ShuffleStageHandle(transport, sid, n)
 
+    def materialize_shared(self, ctx: ExecCtx) -> "ShuffleStageHandle":
+        """Materialize once per query; subsequent consumers reuse the
+        handle (the ReusedExchangeExec analog). The handle closes via
+        the ctx cleanup hook, after every consumer finished. The
+        per-instance lock is created lazily under a module guard (a
+        Lock in __init__ would make the exec unpicklable for the
+        process-cluster path) — the guard closes the two-threads-
+        install-different-locks race."""
+        import threading
+        if self._shared_lock is None:
+            with _SHARED_LOCK_INIT:
+                if self._shared_lock is None:
+                    self._shared_lock = threading.Lock()
+        with self._shared_lock:
+            if self._shared_handle is None:
+                handle = self.materialize(ctx)
+                self._shared_handle = handle
+
+                def cleanup():
+                    self._shared_handle = None
+                    handle.close()
+                ctx.register_cleanup(cleanup)
+            else:
+                ctx.metric(self, "stageReuses").value += 1
+            return self._shared_handle
+
     def execute(self, ctx: ExecCtx):
+        if self.shared:
+            handle = self.materialize_shared(ctx)
+            for p in range(handle.num_partitions):
+                yield from handle.read(p)
+            return
         handle = self.materialize(ctx)
         try:
             for p in range(handle.num_partitions):

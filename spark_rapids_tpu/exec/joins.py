@@ -106,6 +106,7 @@ class _BaseJoinExec(TpuExec):
         self._jit_fast: Dict[tuple, object] = {}
         self._jit_analysis = None
         self._jit_probe = None
+        self._jit_dup = None
 
     @property
     def left(self):
@@ -266,7 +267,10 @@ class _BaseJoinExec(TpuExec):
         semi = jt in ("left_semi", "left_anti")
         has_strings = not semi and any(c.is_string_like
                                        for c in rbatch.columns)
+        from ..config import JOIN_VERIFY_UNIQUE_HINT
+        verify = ctx.conf.get(JOIN_VERIFY_UNIQUE_HINT)
         maxlens: List[int] = []
+        analyzed = False
         if not (self.build_unique_hint and not has_strings):
             if self._jit_analysis is None:
                 self._jit_analysis = jax.jit(
@@ -278,9 +282,28 @@ class _BaseJoinExec(TpuExec):
             facts = [int(v) for v in jax.device_get(
                 self._jit_analysis(rbatch, ctx.eval_ctx))]
             max_dup, maxlens = facts[0], facts[1:]
-            if max_dup > 1 and not self.build_unique_hint:
-                return None
+            analyzed = True
+            if max_dup > 1:
+                # a duplicated build key: the staged path is the one
+                # that handles duplicates. With a (false) hint this is
+                # the free eager validation — the analysis readback
+                # already happened (ADVICE r4 #4: the value was being
+                # computed and discarded). verifyUniqueHint=false keeps
+                # the trust-me contract symmetric with the zero-
+                # readback path: the hint is honored unchecked.
+                if self.build_unique_hint and not verify:
+                    pass  # documented unchecked mode
+                else:
+                    if self.build_unique_hint:
+                        import warnings
+                        warnings.warn(
+                            f"build_unique hint is FALSE on "
+                            f"{self.node_label()} (max key duplication "
+                            f"{max_dup}); reverting to the staged join "
+                            "path", RuntimeWarning)
+                    return None
         probe = None
+        dup_flag = None
         kd = self.right_keys[0].dtype
         if len(self.left_keys) == 1 and kd.np_dtype is not None \
                 and not dt.is_nested(kd) \
@@ -291,7 +314,30 @@ class _BaseJoinExec(TpuExec):
                         self.right_keys[0].eval_tpu(rb, ectx),
                         rb.live_mask()),
                     static_argnums=1)
-            probe = self._jit_probe(rbatch, ctx.eval_ctx)
+            rk_sorted, perm, n_elig, dup_flag = \
+                self._jit_probe(rbatch, ctx.eval_ctx)
+            probe = (rk_sorted, perm, n_elig)
+        if self.build_unique_hint and verify and not analyzed:
+            # zero-readback regime: record the device-side duplicate
+            # probe; a false hint raises at the query's first natural
+            # download instead of silently dropping matches
+            if dup_flag is None:
+                from ..ops.join import build_dup_flag
+                if self._jit_dup is None:
+                    self._jit_dup = jax.jit(
+                        lambda rb, ectx: build_dup_flag(
+                            [k.eval_tpu(rb, ectx)
+                             for k in self.right_keys],
+                            rb.live_mask()),
+                        static_argnums=1)
+                dup_flag = self._jit_dup(rbatch, ctx.eval_ctx)
+            ctx.add_deferred_check(
+                dup_flag,
+                f"build_unique hint violated on {self.node_label()}: "
+                "the build side has duplicate join keys, so fast-path "
+                "results dropped matches. Remove build_unique=True or "
+                "set spark.rapids.sql.join.verifyUniqueHint=false to "
+                "accept the hint unchecked.")
         return {"probe": probe, "maxlens": maxlens}
 
     def _fast_kernel(self, jt: str, char_caps: tuple, has_cond: bool,

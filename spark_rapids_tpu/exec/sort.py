@@ -312,6 +312,8 @@ class TpuLocalLimitExec(UnaryExec):
     """Per-stream limit (GpuLocalLimitExec analog): truncates row_count;
     contents past the limit become padding."""
 
+    _SYNC_EVERY = 8
+
     def __init__(self, limit: int, child: TpuExec):
         super().__init__(child)
         self.limit = limit
@@ -325,12 +327,18 @@ class TpuLocalLimitExec(UnaryExec):
         host readback of batch sizes (the old per-batch num_rows sync
         put every downstream dispatch into the tunnel's synchronous
         regime). Batches past the limit flow through with zero live
-        rows instead of an early break — the no-sync trade."""
+        rows instead of an early break — the no-sync trade. To keep
+        LIMIT n over a huge scan from doing O(input) work (ADVICE r4),
+        the device-side 'seen' counter syncs every _SYNC_EVERY batches
+        and breaks the loop once the limit is known reached; short
+        streams (the common case) finish before the first sync and stay
+        readback-free."""
+        import jax
         import jax.numpy as jnp
 
         from ..ops.gather import ensure_compacted
         seen = jnp.int32(0)
-        for batch in self.child.execute(ctx):
+        for i, batch in enumerate(self.child.execute(ctx)):
             batch = ensure_compacted(batch)  # truncation needs prefix rows
             start = seen
             rc = batch.row_count
@@ -338,6 +346,9 @@ class TpuLocalLimitExec(UnaryExec):
             allowed = jnp.clip(jnp.int32(self.limit) - start, 0,
                                rc.astype(jnp.int32))
             yield batch.with_columns(batch.columns, row_count=allowed)
+            if (i + 1) % self._SYNC_EVERY == 0 \
+                    and int(jax.device_get(seen)) >= self.limit:
+                return
 
     def execute_cpu(self, ctx: ExecCtx):
         remaining = self.limit

@@ -25,7 +25,7 @@ import concurrent.futures
 import queue
 import threading
 import time
-from typing import Iterator, List, Optional, Sequence, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import pyarrow as pa
 import pyarrow.parquet as pq
@@ -34,7 +34,7 @@ from .. import datatypes as dt
 from ..columnar.arrow_bridge import (arrow_schema, arrow_to_device,
                                      engine_schema)
 from ..config import (CSV_ENABLED, JSON_ENABLED, MAX_PARTITION_BYTES,
-                      ORC_ENABLED, PARQUET_ENABLED,
+                      ORC_ENABLED, PARQUET_DEVICE_DECODE, PARQUET_ENABLED,
                       PARQUET_MULTITHREADED_THREADS, PARQUET_READER_TYPE,
                       RapidsConf, SCAN_PREFETCH_BATCHES)
 from ..exec.base import ExecCtx, LeafExec
@@ -187,16 +187,18 @@ def _hive_partition_values(paths: Sequence[str]):
     if not keys:
         return {}, None
     NULLV = "__HIVE_DEFAULT_PARTITION__"
+    # strict numeric forms only: Python's float()/int() accept 'nan',
+    # 'inf' and '1_0', which Spark would type as string (ADVICE r4)
+    import re
+    _INT_RE = re.compile(r"[+-]?\d+\Z")
+    _FLOAT_RE = re.compile(r"[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?\Z")
 
     def infer(vals):
         nonnull = [v for v in vals if v is not None and v != NULLV]
-        for t, conv in ((dt.INT64, int), (dt.FLOAT64, float)):
-            try:
-                for v in nonnull:
-                    conv(v)
+        for t, conv, pat in ((dt.INT64, int, _INT_RE),
+                             (dt.FLOAT64, float, _FLOAT_RE)):
+            if all(pat.match(v) for v in nonnull):
                 return t, conv
-            except ValueError:
-                continue
         return dt.STRING, str
 
     fields, convs = [], {}
@@ -432,7 +434,162 @@ class TpuFileScanExec(LeafExec):
                     except queue.Empty:
                         break
 
+    # --- device page decode (parquet) -------------------------------------
+
+    def _use_device_decode(self, conf) -> bool:
+        return (self.fmt == "parquet"
+                and conf.get(PARQUET_DEVICE_DECODE)
+                and conf.get(PARQUET_READER_TYPE) != "COALESCING")
+
+    def _device_rg_tasks(self) -> List[Tuple[str, int]]:
+        """(path, row_group) work list honoring row-group pruning."""
+        tasks: List[Tuple[str, int]] = []
+        for split in self._splits():
+            md = pq.ParquetFile(split.path).metadata
+            groups = split.row_groups
+            if groups is None:
+                groups = list(range(md.num_row_groups))
+            if self._conjuncts:
+                name_to_idx = {md.schema.column(i).name: i
+                               for i in range(md.num_columns)}
+                groups = [g for g in groups
+                          if _rg_may_match(md, g, name_to_idx,
+                                           self._conjuncts)]
+            tasks.extend((split.path, g) for g in groups)
+        return tasks
+
+    def _thread_pf(self, path: str) -> "pq.ParquetFile":
+        """Per-(thread, path) ParquetFile: one footer parse per pool
+        thread instead of one per row group, without sharing a file
+        handle (pyarrow reads seek) across threads."""
+        tl = self.__dict__.setdefault("_pf_local", threading.local())
+        cache = getattr(tl, "cache", None)
+        if cache is None:
+            cache = tl.cache = {}
+        pf = cache.get(path)
+        if pf is None:
+            pf = cache[path] = pq.ParquetFile(path)
+        return pf
+
+    def _plan_row_group(self, path: str, g: int):
+        """Host side of the device-decode path for one row group: page
+        walk + codec decompress + run-header parse per eligible column
+        chunk; pyarrow decode for the rest. Runs on the reader pool."""
+        from .parquet_device import HostFallback, plan_chunk
+        pf = self._thread_pf(path)
+        md = pf.metadata
+        rg = md.row_group(g)
+        n_rows = rg.num_rows
+        name_to_ci = {md.schema.column(i).name: i
+                      for i in range(md.num_columns)}
+        part_fields = {f.name for f in self._part_schema.fields} \
+            if self._part_schema is not None else set()
+        plans: Dict[str, object] = {}
+        host_cols: List[str] = []
+        with open(path, "rb") as f:
+            for fld in self._schema.fields:
+                if fld.name in part_fields:
+                    continue
+                ci = name_to_ci.get(fld.name)
+                if ci is None:
+                    continue  # schema evolution: nulls at assembly
+                try:
+                    plans[fld.name] = plan_chunk(
+                        f, rg.column(ci), pf.schema.column(ci), fld.dtype,
+                        pf.schema_arrow.field(fld.name).type)
+                except HostFallback:
+                    host_cols.append(fld.name)
+        host_rb = None
+        if host_cols:
+            t = pf.read_row_group(g, columns=host_cols)
+            host_rb = t.combine_chunks().to_batches()[0] if t.num_rows \
+                else None
+        return n_rows, plans, host_rb, self._part_values.get(path)
+
+    def _assemble_device_batch(self, n_rows, plans, host_rb, part_vals):
+        """Consumer side: ONE fused decode dispatch for every planned
+        column + uploads for host-fallback/partition columns, then the
+        TpuBatch (all async — no host sync)."""
+        from .parquet_device import decode_row_group_device
+        from ..columnar.batch import bucket_rows
+        from ..columnar.arrow_bridge import arrow_column_to_device
+        from ..columnar.column import TpuColumnVector
+        cap = bucket_rows(max(n_rows, 1))
+        part_fields = {f.name for f in self._part_schema.fields} \
+            if self._part_schema is not None else set()
+        encoded = decoded = 0
+        typed = {}
+        for fld in self._schema.fields:
+            plan = plans.get(fld.name)
+            if plan is not None:
+                typed[fld.name] = (plan, fld.dtype)
+                encoded += plan.encoded_bytes
+                lane = plan.lane
+                decoded += n_rows * (1 if lane == bool else lane.itemsize)
+        dev_cols = decode_row_group_device(typed, cap) if typed else {}
+        cols = []
+        for fld in self._schema.fields:
+            if fld.name in dev_cols:
+                cols.append(dev_cols[fld.name])
+                continue
+            if fld.name in part_fields:
+                v = (part_vals or {}).get(fld.name)
+                arr = pa.array([v] * n_rows, type=dt.to_arrow(fld.dtype))
+                cols.append(arrow_column_to_device(arr, fld.dtype, cap))
+                continue
+            if host_rb is not None \
+                    and host_rb.schema.get_field_index(fld.name) >= 0:
+                arr = host_rb.column(
+                    host_rb.schema.get_field_index(fld.name))
+                if arr.type != dt.to_arrow(fld.dtype):
+                    arr = arr.cast(dt.to_arrow(fld.dtype))
+                cols.append(arrow_column_to_device(arr, fld.dtype, cap))
+                continue
+            cols.append(TpuColumnVector.nulls(fld.dtype, cap))
+        from ..columnar.batch import TpuBatch
+        return TpuBatch(cols, self._schema, n_rows), encoded, decoded
+
+    def _execute_device_decode(self, ctx: ExecCtx):
+        conf = ctx.conf
+        rows = ctx.metric(self, "numOutputRows")
+        scan_t = ctx.metric(self, "scanTime")
+        up_t = ctx.metric(self, "uploadTime")
+        enc_m = ctx.metric(self, "encodedBytes")
+        dec_m = ctx.metric(self, "decodedBytes")
+        tasks = self._device_rg_tasks()
+        if not tasks:
+            return
+        nthreads = max(1, conf.get(PARQUET_MULTITHREADED_THREADS))
+        depth = nthreads + max(0, conf.get(SCAN_PREFETCH_BATCHES))
+        with concurrent.futures.ThreadPoolExecutor(nthreads) as pool:
+            pending = []
+            it = iter(tasks)
+            def topup():
+                while len(pending) < depth:
+                    try:
+                        p, g = next(it)
+                    except StopIteration:
+                        return
+                    pending.append(pool.submit(self._plan_row_group, p, g))
+            topup()
+            while pending:
+                t0 = time.perf_counter()
+                n_rows, plans, host_rb, part_vals = pending.pop(0).result()
+                scan_t.value += time.perf_counter() - t0
+                topup()
+                t1 = time.perf_counter()
+                batch, encoded, decoded = self._assemble_device_batch(
+                    n_rows, plans, host_rb, part_vals)
+                up_t.value += time.perf_counter() - t1
+                enc_m.value += encoded
+                dec_m.value += decoded
+                rows.value += n_rows
+                yield batch
+
     def execute(self, ctx: ExecCtx):
+        if self._use_device_decode(ctx.conf):
+            yield from self._execute_device_decode(ctx)
+            return
         rows = ctx.metric(self, "numOutputRows")
         scan_t = ctx.metric(self, "scanTime")
         up_t = ctx.metric(self, "uploadTime")

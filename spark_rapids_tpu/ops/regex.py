@@ -24,11 +24,14 @@ masked transition product (MXU-shaped) plus accept tests. Unanchored
 search re-injects floating first-positions every step; `$`-anchored
 accepts fire only at each row's last byte.
 
-Byte semantics: matching is over UTF-8 BYTES. Patterns must be ASCII
-(enforced); `.` matches any byte except \\n, so on non-ASCII input a
-multi-byte character counts as several `.` positions — the documented
-device-dialect divergence (the reference's cudf regex has analogous
-incompat caveats).
+UTF-8 correctness (ADVICE r4 medium): patterns must be ASCII
+(enforced), but DATA may be any UTF-8. Atoms that can match non-ASCII
+characters — `.`, negated classes, negated escapes (\\D \\W \\S),
+`[\\s\\S]` — compile into multi-position sub-automata matching one
+WHOLE UTF-8 character (lead byte class + continuation chain for 2-, 3-
+and 4-byte sequences), so 'é' LIKE '_' is true on device exactly as in
+Spark. ASCII-only atoms stay single positions; the lockstep simulation
+is unchanged (its cost scales with total positions).
 """
 from __future__ import annotations
 
@@ -37,9 +40,10 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 __all__ = ["RegexUnsupported", "compile_pattern", "regex_match_device",
-           "like_to_regex"]
+           "regex_find_spans_device", "compile_replace_pattern",
+           "replace_program_supported", "like_to_regex"]
 
-_MAX_STATES = 48
+_MAX_STATES = 64
 
 
 class RegexUnsupported(Exception):
@@ -158,7 +162,7 @@ class RegexProgram:
 
     __slots__ = ("acc", "follow", "first_anchored", "first_floating",
                  "accept_any", "accept_end", "always_match",
-                 "empty_only_match", "n_states")
+                 "empty_only_match", "n_states", "min_len")
 
     def __init__(self):
         self.n_states = 0
@@ -170,6 +174,7 @@ class RegexProgram:
         self.accept_end = np.zeros(0, bool)
         self.always_match = False     # matches every (non-null) string
         self.empty_only_match = False  # ^$-style: matches len==0 rows
+        self.min_len = 1  # minimal match width in bytes (replace sizing)
 
 
 def _split_alternation(p: str) -> List[str]:
@@ -203,13 +208,76 @@ def _split_alternation(p: str) -> List[str]:
     return out
 
 
+class _Fragment:
+    """One atom's position-automaton fragment. ASCII-only atoms are a
+    single position; atoms that can match non-ASCII characters expand
+    into a UTF-8 character automaton (one position per byte of each
+    encoding length), so device matching is per CHARACTER, not per byte
+    (ADVICE r4 medium)."""
+
+    __slots__ = ("masks", "first", "last", "follow")
+
+    def __init__(self, masks, first, last, follow):
+        self.masks = masks      # List[np.ndarray(256, bool)] per position
+        self.first = first      # local position indices legal at start
+        self.last = last        # local position indices completing it
+        self.follow = follow    # local (i, j) internal byte transitions
+
+
+_CONT = np.zeros(256, bool)
+_CONT[0x80:0xC0] = True
+_LEAD2 = np.zeros(256, bool)
+_LEAD2[0xC2:0xE0] = True
+_LEAD3 = np.zeros(256, bool)
+_LEAD3[0xE0:0xF0] = True
+_LEAD4 = np.zeros(256, bool)
+_LEAD4[0xF0:0xF5] = True
+
+
+def _atom_fragment(m: np.ndarray) -> _Fragment:
+    """Class mask -> fragment. A mask with any char >= 0x80 means the
+    atom matches non-ASCII CHARACTERS (an ASCII pattern can only say
+    "all of them" — via `.`, negation, \\D \\W \\S or [\\s\\S]), so the
+    multi-byte branches join the automaton."""
+    if not m[128:].any():
+        return _Fragment([m], [0], [0], [])
+    ascii_m = m.copy()
+    ascii_m[128:] = False
+    masks, first, last, follow = [], [], [], []
+    if ascii_m.any():
+        masks.append(ascii_m)
+        first.append(0)
+        last.append(0)
+    b = len(masks)
+    masks += [_LEAD2, _CONT]                    # 2-byte sequence
+    first.append(b)
+    last.append(b + 1)
+    follow.append((b, b + 1))
+    b = len(masks)
+    masks += [_LEAD3, _CONT, _CONT]             # 3-byte sequence
+    first.append(b)
+    last.append(b + 2)
+    follow += [(b, b + 1), (b + 1, b + 2)]
+    b = len(masks)
+    masks += [_LEAD4, _CONT, _CONT, _CONT]      # 4-byte sequence
+    first.append(b)
+    last.append(b + 3)
+    follow += [(b, b + 1), (b + 1, b + 2), (b + 2, b + 3)]
+    return _Fragment(masks, first, last, follow)
+
+
 def compile_pattern(pattern: str) -> RegexProgram:
     """Compile, or raise RegexUnsupported."""
     if any(ord(c) > 127 for c in pattern):
         raise RegexUnsupported("non-ASCII pattern")
     prog = RegexProgram()
     branches = [_parse_branch(b) for b in _split_alternation(pattern)]
-    n = sum(len(atoms) for _, _, atoms in branches)
+    frag_branches = []
+    n = 0
+    for a_start, a_end, atoms in branches:
+        frags = [( _atom_fragment(m), q) for m, q in atoms]
+        n += sum(len(f.masks) for f, _ in frags)
+        frag_branches.append((a_start, a_end, frags))
     if n > _MAX_STATES:
         raise RegexUnsupported(f"{n} positions > {_MAX_STATES}")
     prog.n_states = n
@@ -221,9 +289,11 @@ def compile_pattern(pattern: str) -> RegexProgram:
     prog.accept_end = np.zeros(n, bool)
 
     base = 0
-    for a_start, a_end, atoms in branches:
-        k = len(atoms)
-        nullable = [q in "*?" for _, q in atoms]
+    branch_min = []
+    for a_start, a_end, frags in frag_branches:
+        branch_min.append(sum(1 for _, q in frags if q not in "*?"))
+        k = len(frags)
+        nullable = [q in "*?" for _, q in frags]
         if k == 0 or all(nullable):
             # empty-matchable branch: unanchored/half-anchored search
             # always finds the empty match; fully anchored matches only
@@ -232,26 +302,45 @@ def compile_pattern(pattern: str) -> RegexProgram:
                 prog.empty_only_match = True
             else:
                 prog.always_match = True
-        for i, (m, q) in enumerate(atoms):
-            s = base + i
-            prog.acc[:, s] = m
+        # global position index of each fragment's start
+        starts = []
+        b = base
+        for f, _ in frags:
+            starts.append(b)
+            b += len(f.masks)
+        for i, (f, q) in enumerate(frags):
+            s0 = starts[i]
+            for p, m in enumerate(f.masks):
+                prog.acc[:, s0 + p] = m
+            for (p, r) in f.follow:
+                prog.follow[s0 + p, s0 + r] = True
             # firsts: everything before i nullable
             if all(nullable[:i]):
-                (prog.first_anchored if a_start
-                 else prog.first_floating)[s] = True
+                tgt = prog.first_anchored if a_start \
+                    else prog.first_floating
+                for p in f.first:
+                    tgt[s0 + p] = True
             # lasts: everything after i nullable
             if all(nullable[i + 1:]):
-                (prog.accept_end if a_end else prog.accept_any)[s] = True
-            # follow: self-loop for * and +
+                tgt = prog.accept_end if a_end else prog.accept_any
+                for p in f.last:
+                    tgt[s0 + p] = True
+            # repetition: * and + loop last -> first
             if q in "*+":
-                prog.follow[s, s] = True
-            # follow: j > i with the gap nullable
+                for p in f.last:
+                    for r in f.first:
+                        prog.follow[s0 + p, s0 + r] = True
+            # cross-fragment follow: j > i with the gap nullable
             for j in range(i + 1, k):
                 if all(nullable[i + 1:j]):
-                    prog.follow[s, base + j] = True
+                    fj = frags[j][0]
+                    for p in f.last:
+                        for r in fj.first:
+                            prog.follow[s0 + p, starts[j] + r] = True
                 if not nullable[j]:
                     break
-        base += k
+        base = b
+    prog.min_len = max(1, min(branch_min) if branch_min else 1)
     return prog
 
 
@@ -334,3 +423,274 @@ def regex_match_device(col, prog: RegexProgram):
     _, _, matched = jax.lax.while_loop(
         cond, body, (jnp.int32(0), active0, matched0))
     return matched
+
+
+# --- match POSITIONS: spans for regexp_replace / regexp_extract ------------
+#
+# VERDICT r4 #7: the automaton above answers accept/reject; replace and
+# extract need WHERE. Two phases, both lockstep over all rows:
+#
+#   1. a BACKWARD boolean pass of the automaton against the follow
+#      relation transposed marks, per byte position i, whether some
+#      match STARTS at i (reachability of an accept reading s[i..]) —
+#      an (n, S) x (S, S) matmul per byte, the same MXU shape as the
+#      forward matcher;
+#   2. ONE forward walk advances every row's cursor a byte per step:
+#      scanning rows look for the next marked start (greedy leftmost),
+#      matching rows run the ANCHORED automaton recording the last
+#      accept (greedy longest); when a row's active set dies its span
+#      [start, last_accept) is committed and the cursor rewinds to the
+#      span end (non-overlapping, Java's continue-after-match).
+#
+# Leftmost-LONGEST equals Java's leftmost-greedy for the supported
+# dialect RESTRICTED to a single branch (alternation is leftmost-FIRST
+# in Java — 'a|ab' on "ab" picks 'a' — so multi-branch patterns fall
+# back to host). Patterns that can match empty also fall back (Java
+# emits empty matches at every position; the span machinery assumes
+# width >= 1).
+
+
+def compile_replace_pattern(pattern: str):
+    """(program, None) when find-spans semantics are exact for this
+    pattern, else (None, reason) — one compilation, reused by the
+    caller."""
+    try:
+        prog = compile_pattern(pattern)
+    except RegexUnsupported as e:
+        return None, str(e)
+    if len(_split_alternation(pattern)) > 1:
+        return None, ("alternation is leftmost-first in Java but "
+                      "leftmost-longest on device; runs on host")
+    if prog.always_match or prog.empty_only_match:
+        return None, "pattern can match the empty string; runs on host"
+    return prog, None
+
+
+def replace_program_supported(pattern: str) -> Optional[str]:
+    """None when find-spans semantics are exact for this pattern, else
+    the fallback reason."""
+    return compile_replace_pattern(pattern)[1]
+
+
+def regex_find_spans_device(col, prog: RegexProgram,
+                            first_only: bool = False):
+    """Per-row non-overlapping leftmost-longest match spans.
+
+    Returns (in_match, match_start, n_matches, first_s, first_e): flat
+    bool masks over the chars lane (byte is inside a span / starts a
+    span), the per-row span count, and each row's FIRST span as
+    row-relative [first_s, first_e) (-1/-1 when none — regexp_extract's
+    answer). With first_only, each row stops after its first span."""
+    import jax
+    import jax.numpy as jnp
+    offs = col.offsets
+    n = offs.shape[0] - 1
+    lens = (offs[1:] - offs[:-1]).astype(jnp.int32)
+    live_lens = jnp.where(col.validity, lens, 0)
+    ccap = max(col.chars.shape[0], 1)
+    chars = col.chars if col.chars.shape[0] else jnp.zeros((1,), jnp.uint8)
+    max_len = jnp.max(live_lens, initial=0)
+    S = prog.n_states
+
+    acc = jnp.asarray(prog.acc)                          # (256, S)
+    follow_t = jnp.asarray(prog.follow.T, jnp.float32)   # backward
+    follow = jnp.asarray(prog.follow, jnp.float32)
+    first = jnp.asarray(prog.first_anchored | prog.first_floating)
+    anchored_start = bool(prog.first_anchored.any()) \
+        and not prog.first_floating.any()
+    accept_any = jnp.asarray(prog.accept_any)
+    accept_end = jnp.asarray(prog.accept_end)
+
+    # ---- phase 1: backward start-reachability ---------------------------
+    # R[j] = states that, consuming s[j], can begin a suffix reaching an
+    # accept. A match starts at j iff first ∩ R[j] != 0.
+    def bcond(state):
+        j, _, _ = state
+        return j >= 0
+
+    # start marks live on the FLAT chars lane (starts_flat[offs[r]+j]):
+    # a (n, max_len) matrix would be dynamically shaped
+    starts_flat = jnp.zeros((ccap,), jnp.bool_)
+
+    def bbody_flat(state):
+        j, R_next, starts_flat = state
+        pos = jnp.clip(offs[:-1] + j, 0, ccap - 1)
+        c = chars[pos]
+        in_row = j < live_lens
+        at_last = j == live_lens - 1
+        acc_here = (accept_any[None, :]
+                    | (accept_end[None, :] & at_last[:, None]))
+        can_continue = (R_next.astype(jnp.float32) @ follow_t) > 0
+        R = acc[c] & in_row[:, None] & (acc_here | can_continue)
+        hit = jnp.any(R & first[None, :], axis=1) & in_row
+        if anchored_start:
+            hit = hit & (j == 0)
+        # inactive rows scatter to the drop sentinel, NOT a stale
+        # write-back of the old value: duplicate flat indices (empty
+        # rows share pos with their neighbor) are implementation-
+        # defined order on TPU and the stale False could win
+        starts_flat = starts_flat.at[
+            jnp.where(in_row & hit, pos, ccap)].set(True, mode="drop")
+        return j - 1, R, starts_flat
+
+    _, _, starts_flat = jax.lax.while_loop(
+        bcond, bbody_flat,
+        (max_len - 1, jnp.zeros((n, S), jnp.bool_), starts_flat))
+
+    # ---- phase 2: greedy forward span walk ------------------------------
+
+    def fcond(state):
+        j = state[0]
+        return jnp.any(j < live_lens)
+
+    def fbody(state):
+        (j, matching, mstart, last_end, active, in_match, match_start,
+         nmatches, done, first_s, first_e) = state
+        pos = jnp.clip(offs[:-1] + j, 0, ccap - 1)
+        c = chars[pos]
+        in_row = (j < live_lens) & ~done
+        start_here = starts_flat[pos] & in_row & ~matching
+        # begin a span: anchored automaton from this byte
+        active = jnp.where(start_here[:, None], first[None, :], active)
+        matching2 = matching | start_here
+        mstart = jnp.where(start_here, j, mstart)
+        last_end = jnp.where(start_here, -1, last_end)
+        # consume byte j for matching rows
+        fired = active & acc[c] & (matching2 & in_row)[:, None]
+        at_last = j == live_lens - 1
+        accepts = fired & (accept_any[None, :]
+                           | (accept_end[None, :] & at_last[:, None]))
+        acc_fired = jnp.any(accepts, axis=1)
+        last_end = jnp.where(matching2 & acc_fired, j + 1, last_end)
+        nxt = (fired.astype(jnp.float32) @ follow) > 0
+        alive = jnp.any(nxt, axis=1) & (j + 1 < live_lens)
+        # a span commits when the thread dies (or the row ends)
+        commit = matching2 & in_row & ~alive
+        have = commit & (last_end > mstart)
+        # mark the span's bytes [mstart, last_end) — bounded per-step
+        # work: one segment write via the cumulative trick below, done
+        # lazily by recording span edges in the masks
+        span_pos = jnp.clip(offs[:-1] + mstart, 0, ccap - 1)
+        match_start = match_start.at[
+            jnp.where(have, span_pos, ccap)].set(True, mode="drop")
+        end_pos = jnp.clip(offs[:-1] + last_end, 0, ccap - 1)
+        # record end edge into in_match as a +1/-1 prefix encoding:
+        # in_match here is an int8 DELTA lane, decoded after the loop
+        in_match = in_match.at[span_pos].add(
+            jnp.where(have, 1, 0).astype(jnp.int8))
+        in_match = in_match.at[end_pos].add(
+            jnp.where(have & (last_end < lens), -1, 0).astype(jnp.int8))
+        # row-end deltas for spans touching the last byte are implicit:
+        # the prefix decode is segmented per row, so no -1 is needed
+        # when end == len
+        is_first = have & (nmatches == 0)
+        first_s = jnp.where(is_first, mstart, first_s)
+        first_e = jnp.where(is_first, last_end, first_e)
+        nmatches = nmatches + have.astype(jnp.int32)
+        done = done | (first_only & have)
+        # advance: matching rows that committed rewind to the span end
+        # (or +1 past a failed start); everything else one byte forward
+        j_next = jnp.where(
+            in_row & commit, jnp.where(have, last_end, mstart + 1),
+            j + 1)
+        matching3 = matching2 & ~commit
+        return (j_next.astype(jnp.int32), matching3, mstart, last_end,
+                nxt, in_match, match_start, nmatches, done, first_s,
+                first_e)
+
+    j0 = jnp.zeros((n,), jnp.int32)
+    state = (j0, jnp.zeros((n,), jnp.bool_), jnp.zeros((n,), jnp.int32),
+             jnp.full((n,), -1, jnp.int32), jnp.zeros((n, S), jnp.bool_),
+             jnp.zeros((ccap,), jnp.int8), jnp.zeros((ccap,), jnp.bool_),
+             jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.bool_),
+             jnp.full((n,), -1, jnp.int32), jnp.full((n,), -1, jnp.int32))
+    state = jax.lax.while_loop(fcond, fbody, state)
+    delta, match_start, nmatches = state[5], state[6], state[7]
+    first_s, first_e = state[9], state[10]
+    # segmented prefix decode of the +1/-1 edges -> in-span mask
+    cum = jnp.cumsum(delta.astype(jnp.int32))
+    row_of = jnp.clip(jnp.searchsorted(offs, jnp.arange(ccap,
+                                                        dtype=jnp.int32),
+                                       side="right") - 1, 0, n - 1)
+    row_base = cum[jnp.clip(offs[:-1][row_of], 0, ccap - 1)] \
+        - delta.astype(jnp.int32)[jnp.clip(offs[:-1][row_of], 0,
+                                           ccap - 1)]
+    in_match = (cum - row_base) > 0
+    return in_match, match_start, nmatches, first_s, first_e
+
+
+def regex_replace_device(col, prog: RegexProgram, repl: bytes,
+                         char_cap: int):
+    """replaceAll: every non-overlapping leftmost-longest span replaced
+    by the literal `repl`. Returns a string TpuColumnVector with
+    char capacity `char_cap` (caller sizes via replace_char_cap)."""
+    import jax.numpy as jnp
+    from ..columnar.column import TpuColumnVector
+    in_match, mstart, _, _, _ = regex_find_spans_device(col, prog)
+    offs = col.offsets
+    n = offs.shape[0] - 1
+    ccap = max(col.chars.shape[0], 1)
+    chars = col.chars if col.chars.shape[0] else jnp.zeros((1,), jnp.uint8)
+    Lr = len(repl)
+    contrib = jnp.where(~in_match, 1,
+                        jnp.where(mstart, Lr, 0)).astype(jnp.int32)
+    # clamp contributions to live bytes
+    i = jnp.arange(ccap, dtype=jnp.int32)
+    row_of = jnp.clip(jnp.searchsorted(offs, i, side="right") - 1,
+                      0, n - 1)
+    in_any_row = (i >= offs[:-1][row_of]) & (i < offs[1:][row_of])
+    contrib = jnp.where(in_any_row, contrib, 0)
+    out_off = jnp.cumsum(contrib) - contrib  # exclusive
+    # per-row output offsets: exclusive cumsum at row starts + total
+    row_start_out = out_off[jnp.clip(offs[:-1], 0, ccap - 1)]
+    total = jnp.sum(contrib)
+    new_offsets = jnp.concatenate(
+        [row_start_out.astype(jnp.int32), total[None].astype(jnp.int32)])
+    out = jnp.zeros((char_cap,), jnp.uint8)
+    keep = ~in_match & in_any_row
+    dst = jnp.where(keep, out_off, char_cap)
+    out = out.at[dst].set(chars, mode="drop")
+    if Lr:
+        rep = jnp.asarray(np.frombuffer(repl, np.uint8))
+        start_dst = jnp.where(mstart & in_any_row, out_off, char_cap)
+        for k in range(Lr):
+            out = out.at[jnp.where(start_dst < char_cap, start_dst + k,
+                                   char_cap)].set(rep[k], mode="drop")
+    return TpuColumnVector(col.dtype, validity=col.validity,
+                           offsets=new_offsets, chars=out)
+
+
+def replace_char_cap(col, prog: RegexProgram, repl_len: int) -> int:
+    """Static output char bound for replace: unmatched bytes plus
+    repl_len per match, matches bounded by chars/min_len."""
+    from ..columnar.batch import bucket_bytes
+    ccap = max(int(col.chars.shape[0]), 1)
+    bound = ccap + (ccap // max(prog.min_len, 1)) * repl_len + 16
+    return bucket_bytes(bound)
+
+
+def regex_extract_device(col, prog: RegexProgram):
+    """regexp_extract group-0: each row's FIRST span as a string column
+    ('' when no match, null propagates)."""
+    import jax.numpy as jnp
+    from ..columnar.column import TpuColumnVector
+    _, _, _, first_s, first_e = regex_find_spans_device(col, prog,
+                                                        first_only=True)
+    offs = col.offsets
+    n = offs.shape[0] - 1
+    ccap = max(col.chars.shape[0], 1)
+    chars = col.chars if col.chars.shape[0] else jnp.zeros((1,), jnp.uint8)
+    have = first_e > first_s
+    out_len = jnp.where(have, first_e - first_s, 0).astype(jnp.int32)
+    new_offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32), jnp.cumsum(out_len)]).astype(jnp.int32)
+    char_cap = ccap  # extraction never grows
+    i = jnp.arange(char_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(new_offsets, i, side="right") - 1,
+                   0, n - 1)
+    src = offs[:-1][row] + first_s[row] + (i - new_offsets[:-1][row])
+    live = i < new_offsets[-1]
+    out = jnp.where(live, chars[jnp.clip(src, 0, ccap - 1)], 0)
+    return TpuColumnVector(col.dtype, validity=col.validity,
+                           offsets=new_offsets,
+                           chars=out.astype(jnp.uint8))

@@ -92,6 +92,12 @@ class NodeMeta:
                 er = e.tpu_supported()
                 if er:
                     self.will_not_work(f"expression {e!r}: {er}")
+                    continue
+                e_hook = getattr(e, "tpu_supported_conf", None)
+                if e_hook is not None:
+                    er = e_hook(conf)
+                    if er:
+                        self.will_not_work(f"expression {e!r}: {er}")
         self.on_device = not self.reasons
 
     # --- explain ---------------------------------------------------------
@@ -164,11 +170,28 @@ class PhysicalPlan:
             tracer = contextlib.nullcontext()
         with tracer:
             if self.root_on_device:
-                with ctx.mm.task_slot():  # GpuSemaphore admission control
-                    rbs = [device_to_arrow(b)
-                           for b in self.root.execute(ctx)]
+                try:
+                    with ctx.mm.task_slot():  # GpuSemaphore admission
+                        rbs = [device_to_arrow(b)
+                               for b in self.root.execute(ctx)]
+                except BaseException:
+                    ctx.discard_deferred()  # dead query's flags
+                    raise
+                finally:
+                    ctx.run_cleanups()
+                ctx.check_deferred()  # downloads were the sync point
             else:
-                rbs = list(self.root.execute_cpu(ctx))
+                # CPU-rooted plans can still contain device islands
+                # (under DeviceToHostExec): their cleanups and deferred
+                # device checks must run here too
+                try:
+                    rbs = list(self.root.execute_cpu(ctx))
+                except BaseException:
+                    ctx.discard_deferred()
+                    raise
+                finally:
+                    ctx.run_cleanups()
+                ctx.check_deferred()
         return pa.Table.from_batches(rbs, schema=schema)
 
     def metrics_report(self, ctx: Optional[ExecCtx] = None) -> str:
@@ -239,22 +262,50 @@ class TpuOverrides:
                 built = DeviceToHostExec(built)
             built = self._maybe_aqe(c, built)
             new_children.append(built)
-        return meta.node.with_new_children(new_children)
+        out = meta.node.with_new_children(new_children)
+        return self._maybe_aqe_join(meta, out)
 
     def _maybe_aqe(self, meta: NodeMeta, built: TpuExec) -> TpuExec:
         """With spark.sql.adaptive.enabled, wrap device-side shuffle
         exchanges in the adaptive reader (coalesce + skew split,
-        exec/aqe.py) — inserted like transitions, below the consumer."""
+        exec/aqe.py) — inserted like transitions, below the consumer.
+        An exchange instance seen for a second time (self-joins reuse
+        the same subtree object) is flagged `shared`: it materializes
+        once and every consumer reads the same stage (the
+        ReusedExchangeExec analog, SURVEY.md:161)."""
         from .config import ADAPTIVE_ENABLED
         from .exec.exchange import TpuShuffleExchangeExec
         if not self.conf.get(ADAPTIVE_ENABLED):
             return built
         if meta.on_device and isinstance(built, TpuShuffleExchangeExec):
+            # _seen_exchanges is reset per apply(): the exchanges are
+            # alive for the whole walk, so id() is unambiguous there —
+            # but across applies a freed id could recur (CPython reuses
+            # addresses) and falsely flag a single-consumer exchange
+            if id(built) in self._seen_exchanges:
+                built.shared = True
+            self._seen_exchanges.add(id(built))
             from .exec.aqe import TpuAQEShuffleReadExec
             return TpuAQEShuffleReadExec(built)
         return built
 
+    def _maybe_aqe_join(self, meta: NodeMeta, built: TpuExec) -> TpuExec:
+        """With AQE: wrap device-side shuffled hash joins over exchange
+        children in the runtime strategy switch (shuffled -> broadcast
+        demotion from sync-free stage size — exec/aqe.py,
+        SURVEY.md:161)."""
+        from .config import ADAPTIVE_ENABLED
+        from .exec.joins import TpuShuffledHashJoinExec
+        if not self.conf.get(ADAPTIVE_ENABLED) or not meta.on_device:
+            return built
+        if isinstance(built, TpuShuffledHashJoinExec):
+            from .exec.aqe import TpuAQEJoinExec, _unwrap_exchange
+            if _unwrap_exchange(built.right) is not None:
+                return TpuAQEJoinExec(built)
+        return built
+
     def apply(self, plan: TpuExec) -> PhysicalPlan:
+        self._seen_exchanges = set()
         meta = self._wrap(plan)
         self._tag(meta)
         root = self._convert(meta)

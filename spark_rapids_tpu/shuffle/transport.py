@@ -148,11 +148,31 @@ class LocalShuffleTransport(ShuffleTransport):
             self._shuffles.setdefault(shuffle_id, {})
             self._nparts[shuffle_id] = num_partitions
 
-    def partition_stats(self, shuffle_id: int):
+    def stage_bytes(self, shuffle_id: int) -> int:
+        """Total bytes materialized for this shuffle, from CAPACITY
+        metadata only — no device sync, and no SpillableBatch.get()
+        (which would re-upload spilled entries just to read a size):
+        the catalog records nbytes at registration."""
+        total = 0
+        for p, entries in self._shuffles.get(shuffle_id, {}).items():
+            for _, e in entries:
+                if p is None:
+                    total += e._sb.nbytes if e._sb is not None \
+                        else e._raw.device_size_bytes()
+                else:
+                    total += e.device_size_bytes()
+        return total
+
+    def partition_stats(self, shuffle_id: int, free_only: bool = False):
         """Approximate bytes per partition for AQE: per map entry, live
         row counts per partition (sorted pids + searchsorted — no
         scatter) scaled to the entry's byte size; ONE host readback per
-        shuffle, paid only when an AQE read asks (SURVEY.md:161)."""
+        shuffle, paid only when an AQE read asks (SURVEY.md:161). With
+        free_only (spark.rapids.sql.adaptive.freeStatsOnly), this
+        transport has no readback to fold the stats into, so it reports
+        None and the adaptive reader passes through."""
+        if free_only:
+            return None
         import jax
         import jax.numpy as jnp
         import numpy as np

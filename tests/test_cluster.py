@@ -1,0 +1,124 @@
+"""Multi-process execution tests (VERDICT r4 #2): real OS worker
+processes exchanging Arrow-IPC shuffle files through a filesystem
+rendezvous — rung 1 of the blueprint ladder (SURVEY.md:524-527, §3.4).
+The whole point is the process boundary: each worker has its own JAX
+runtime and nothing is shared but files."""
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from data_gen import IntegerGen, LongGen, StringGen, gen_table
+
+from spark_rapids_tpu import datatypes as dt
+from spark_rapids_tpu.cluster import TpuProcessCluster
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.exec.base import ExecCtx, HostBatchSourceExec
+from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+from spark_rapids_tpu.exec.joins import TpuShuffledHashJoinExec
+from spark_rapids_tpu.expr import (Alias, Multiply,
+                                   UnresolvedColumn as col)
+from spark_rapids_tpu.expr.aggregates import Count, Sum
+from spark_rapids_tpu.shuffle.partitioner import HashPartitioning
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with TpuProcessCluster(n_workers=2) as c:
+        yield c
+
+
+def _canon_rows(table: pa.Table, sort_by):
+    return sorted(map(tuple, pa.Table.from_arrays(
+        [table.column(i) for i in range(table.num_columns)],
+        names=table.column_names).to_pylist()), key=lambda r: tuple(
+            (v is None, v) for v in r))
+
+
+def _oracle(plan):
+    rbs = list(plan.execute_cpu(ExecCtx()))
+    from spark_rapids_tpu.columnar.arrow_bridge import arrow_schema
+    return pa.Table.from_batches(rbs, schema=arrow_schema(
+        plan.output_schema))
+
+
+def _rows(table):
+    return sorted(
+        map(tuple, table.to_pylist()),
+        key=lambda r: tuple((v is None, str(v)) for v in r.values())) \
+        if isinstance(table, dict) else sorted(
+            table.to_pylist(), key=lambda d: tuple(
+                (v is None, str(v)) for v in d.values()))
+
+
+def test_process_shuffle_groupby(cluster):
+    """shuffle -> final agg across two worker processes == CPU oracle."""
+    rbs = [gen_table([IntegerGen(min_val=0, max_val=20, null_frac=0.1),
+                      LongGen(nullable=False)], n, seed=s,
+                     names=["k", "v"])
+           for n, s in [(300, 1), (250, 2), (411, 3), (128, 4)]]
+    src = HostBatchSourceExec(rbs)
+    exch = TpuShuffleExchangeExec(HashPartitioning([col("k")], 4), src)
+    plan = TpuHashAggregateExec(
+        [col("k")], [Alias(Sum(col("v")), "s"),
+                     Alias(Count(col("v")), "c")], exch)
+    got = cluster.run_query(plan)
+    want = _oracle(plan)
+    assert _rows(got) == _rows(want)
+
+
+def test_process_shuffle_join_agg(cluster):
+    """The verdict's named bar: shuffle + join + agg dual-run across OS
+    processes."""
+    rng = np.random.default_rng(5)
+    n_f, n_d = 2000, 64
+    fact = pa.record_batch({
+        "fk": pa.array(rng.integers(0, n_d, n_f).astype(np.int32)),
+        "amt": pa.array(rng.integers(1, 100, n_f).astype(np.int64)),
+    })
+    dim = pa.record_batch({
+        "dk": pa.array(np.arange(n_d, dtype=np.int32)),
+        "grp": pa.array((np.arange(n_d) % 7).astype(np.int32)),
+    })
+    # two batches per side so both map stages have real splits
+    fact_src = HostBatchSourceExec([fact.slice(0, 1100),
+                                    fact.slice(1100)])
+    dim_src = HostBatchSourceExec([dim.slice(0, 40), dim.slice(40)])
+    nparts = 3
+    lex = TpuShuffleExchangeExec(HashPartitioning([col("fk")], nparts),
+                                 fact_src)
+    rex = TpuShuffleExchangeExec(HashPartitioning([col("dk")], nparts),
+                                 dim_src)
+    join = TpuShuffledHashJoinExec([col("fk")], [col("dk")], "inner",
+                                   lex, rex)
+    # the agg groups by a NON-join key, so distributed execution needs
+    # the re-partition exchange Spark would plan here; the cluster runs
+    # this as three stages (two leaf maps, a join map, a reduce)
+    gex = TpuShuffleExchangeExec(HashPartitioning([col("grp")], nparts),
+                                 join)
+    plan = TpuHashAggregateExec(
+        [col("grp")], [Alias(Sum(col("amt")), "total"),
+                       Alias(Count(col("amt")), "n")], gex)
+    got = cluster.run_query(plan)
+    want = _oracle(plan)
+    assert _rows(got) == _rows(want)
+
+
+def test_process_cluster_worker_error_surfaces(cluster):
+    """A failing task raises on the driver with the worker traceback."""
+    class Boom(HostBatchSourceExec):
+        def execute(self, ctx):
+            raise RuntimeError("boom-from-worker")
+    # Boom is a local class: pickling it fails at submit OR raises in
+    # the worker; either way the driver must not hang. Use a picklable
+    # failure instead: scan of a missing file.
+    from spark_rapids_tpu.io.scan import TpuFileScanExec
+    schema = dt.Schema([dt.StructField("x", dt.INT64, True)])
+    missing = TpuFileScanExec(["/nonexistent/x.parquet"], schema=schema)
+    exch = TpuShuffleExchangeExec(HashPartitioning([col("x")], 2),
+                                  missing)
+    plan = TpuHashAggregateExec([], [Alias(Count(col("x")), "c")], exch)
+    with pytest.raises(RuntimeError, match="worker task"):
+        cluster.run_query(plan)

@@ -105,6 +105,86 @@ def test_parquet_predicate_pushdown_prunes_and_stays_correct(tmp_path):
     assert sum(r.num_rows for r in rbs) <= 1024
 
 
+def test_parquet_device_decode_matrix(tmp_path):
+    """Device page decode (VERDICT r4 #1): PLAIN + dictionary/RLE
+    bit-packed chunks, nullable and required, across codecs, against
+    both the CPU oracle and the host-decode path; dictionary columns
+    must cross the link SMALLER than decoded."""
+    rng = np.random.default_rng(7)
+    n = 30_000
+    arrays = {
+        "dict_i32": pa.array(rng.integers(0, 9, n).astype(np.int32)),
+        "dict_f32": pa.array((rng.integers(0, 7, n) / 8)
+                             .astype(np.float32)),
+        "plain_f64": pa.array(rng.uniform(0, 1, n)),
+        "i64": pa.array(rng.integers(-(1 << 40), 1 << 40, n)),
+        "b": pa.array(rng.integers(0, 2, n).astype(bool)),
+        "date": pa.array(rng.integers(8000, 9000, n).astype(np.int32))
+        .cast(pa.date32()),
+        "rle_sorted": pa.array(np.sort(rng.integers(0, 4, n))
+                               .astype(np.int64)),
+        "null_i32": pa.array(rng.integers(0, 50, n).astype(np.int32),
+                             mask=rng.uniform(0, 1, n) < 0.25),
+        "all_null": pa.array([None] * n, type=pa.int64()),
+        "s": pa.array(["x" + str(i % 13) for i in range(n)]),  # host path
+    }
+    for codec in ("snappy", "zstd"):
+        p = os.path.join(str(tmp_path), f"m_{codec}.parquet")
+        pq.write_table(pa.table(arrays), p, row_group_size=8000,
+                       compression=codec,
+                       dictionary_pagesize_limit=32 << 10,
+                       data_page_size=8 << 10)
+        scan = TpuFileScanExec([p])
+        ctx = ExecCtx()
+        got_dev = pa.Table.from_batches(
+            [b for b in map(_to_arrow, scan.execute(ctx))])
+        want = pa.Table.from_batches(list(scan.execute_cpu(ExecCtx())))
+        assert _canon(got_dev) == _canon(want), codec
+        m = ctx.metrics[scan.node_label()]
+        assert m["encodedBytes"].value > 0
+        # dict/RLE savings on this data dominate the PLAIN columns
+        assert m["encodedBytes"].value < m["decodedBytes"].value, codec
+        # host-decode path (conf off) agrees
+        off = RapidsConf({
+            "spark.rapids.sql.format.parquet.deviceDecode.enabled":
+                "false"})
+        got_host = pa.Table.from_batches(
+            [b for b in map(_to_arrow,
+                            TpuFileScanExec([p]).execute(ExecCtx(off)))])
+        assert _canon(got_dev) == _canon(got_host), codec
+
+
+def _to_arrow(batch):
+    from spark_rapids_tpu.columnar.arrow_bridge import device_to_arrow
+    return device_to_arrow(batch)
+
+
+def test_parquet_device_decode_fallback_encodings(tmp_path):
+    """DELTA_BINARY_PACKED / byte-stream-split chunks are outside the
+    device envelope: per-chunk host fallback keeps results right."""
+    rng = np.random.default_rng(8)
+    n = 5000
+    tab = pa.table({
+        "delta": pa.array(rng.integers(0, 1 << 30, n).astype(np.int64)),
+        "bss": pa.array(rng.uniform(0, 1, n).astype(np.float32)),
+        "ok": pa.array(rng.integers(0, 5, n).astype(np.int32)),
+    })
+    p = os.path.join(str(tmp_path), "enc.parquet")
+    pq.write_table(tab, p, use_dictionary=False,
+                   column_encoding={"delta": "DELTA_BINARY_PACKED",
+                                    "bss": "BYTE_STREAM_SPLIT",
+                                    "ok": "PLAIN"})
+    assert_tpu_and_cpu_plan_equal(TpuFileScanExec([p]))
+
+
+def test_parquet_device_decode_v2_pages_fallback(tmp_path):
+    rb = gen_table([IntegerGen(), LongGen(), FloatGen(dt.FLOAT64)], n=800)
+    p = os.path.join(str(tmp_path), "v2.parquet")
+    pq.write_table(pa.Table.from_batches([rb]), p,
+                   data_page_version="2.0")
+    assert_tpu_and_cpu_plan_equal(TpuFileScanExec([p]))
+
+
 def test_csv_scan(tmp_path):
     rb = gen_table([IntegerGen(), FloatGen(dt.FLOAT64),
                     StringGen(ascii_only=True,
@@ -177,6 +257,27 @@ def test_partitioned_write(tmp_path):
     assert back.num_rows == rb.num_rows
     assert sorted(back.column("v").to_pylist(), key=lambda x: (x is None, x)) \
         == sorted(rb.column(1).to_pylist(), key=lambda x: (x is None, x))
+
+
+def test_hive_partition_inference_strict(tmp_path):
+    """Directory values like 'nan'/'inf'/'1_0' type as STRING, not
+    float64/int64 (Python float()/int() accept them; Spark does not —
+    ADVICE r4)."""
+    from spark_rapids_tpu.io.scan import _hive_partition_values
+    base = str(tmp_path)
+    paths = [f"{base}/k={v}/f.parquet" for v in ("nan", "inf", "1_0")]
+    typed, schema = _hive_partition_values(paths)
+    assert schema.fields[0].dtype == dt.STRING
+    assert typed[paths[0]]["k"] == "nan"
+    # plain ints still infer int64
+    paths = [f"{base}/k={v}/f.parquet" for v in ("1", "-2", "+3")]
+    typed, schema = _hive_partition_values(paths)
+    assert schema.fields[0].dtype == dt.INT64
+    assert typed[paths[1]]["k"] == -2
+    # decimals/exponents infer float64
+    paths = [f"{base}/k={v}/f.parquet" for v in ("1.5", "2e3", ".25")]
+    _, schema = _hive_partition_values(paths)
+    assert schema.fields[0].dtype == dt.FLOAT64
 
 
 def test_scan_q6_pipeline_through_planner(tmp_path):

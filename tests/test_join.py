@@ -455,3 +455,87 @@ def test_join_fast_path_inner_condition():
         [col("lk")], [col("rk")], "inner", plan.left, plan.right,
         condition=GreaterThan(col("lv"), col("rv")))
     assert_tpu_and_cpu_plan_equal(plan, label="fast-cond")
+
+
+# --- build_unique hint verification (VERDICT r4 weak #3 / ADVICE #4) -------
+
+def _dup_build_sources():
+    import numpy as np
+    left = HostBatchSourceExec([pa.record_batch({
+        "lk": pa.array(np.arange(50, dtype=np.int32)),
+        "lv": pa.array(np.arange(50, dtype=np.int64))})])
+    right = HostBatchSourceExec([pa.record_batch({
+        "rk": pa.array((np.arange(40, dtype=np.int32) % 20)),  # dups!
+        "rv": pa.array(np.arange(40, dtype=np.int64))})])
+    return left, right
+
+
+def test_unique_hint_false_caught_deferred():
+    """Zero-readback fast path (no strings): a FALSE hint is caught by
+    the device-side probe and raised at the first natural download."""
+    from spark_rapids_tpu.exec.base import collect_arrow
+    left, right = _dup_build_sources()
+    join = TpuShuffledHashJoinExec([col("lk")], [col("rk")], "inner",
+                                   left, right, build_unique_hint=True)
+    with pytest.raises(RuntimeError, match="build_unique hint violated"):
+        collect_arrow(join)
+
+
+def test_unique_hint_false_multikey_caught_deferred():
+    from spark_rapids_tpu.exec.base import collect_arrow
+    left, right = _dup_build_sources()
+    join = TpuShuffledHashJoinExec([col("lk"), col("lv")],
+                                   [col("rk"), col("rv")], "inner",
+                                   left, right, build_unique_hint=True)
+    # rv is unique so (rk, rv) is unique -> passes; force dups by
+    # joining on rk twice
+    join = TpuShuffledHashJoinExec([col("lk"), col("lk")],
+                                   [col("rk"), col("rk")], "inner",
+                                   left, right, build_unique_hint=True)
+    with pytest.raises(RuntimeError, match="build_unique hint violated"):
+        collect_arrow(join)
+
+
+def test_unique_hint_false_with_strings_reverts_staged():
+    """When the build analysis readback happens anyway (string payload),
+    a false hint is validated eagerly for free: warn + fall back to the
+    duplicate-correct staged path — results match the oracle."""
+    import numpy as np
+    left = HostBatchSourceExec([pa.record_batch({
+        "lk": pa.array(np.arange(30, dtype=np.int32)),
+        "lv": pa.array(np.arange(30, dtype=np.int64))})])
+    right = HostBatchSourceExec([pa.record_batch({
+        "rk": pa.array((np.arange(24, dtype=np.int32) % 12)),
+        "rs": pa.array([f"s{i}" for i in range(24)])})])
+    join = TpuShuffledHashJoinExec([col("lk")], [col("rk")], "inner",
+                                   left, right, build_unique_hint=True)
+    with pytest.warns(RuntimeWarning, match="build_unique hint is FALSE"):
+        assert_tpu_and_cpu_plan_equal(join)
+
+
+def test_unique_hint_true_passes_verification():
+    import numpy as np
+    from spark_rapids_tpu.exec.base import collect_arrow
+    left = HostBatchSourceExec([pa.record_batch({
+        "lk": pa.array(np.arange(50, dtype=np.int32) % 25),
+        "lv": pa.array(np.arange(50, dtype=np.int64))})])
+    right = HostBatchSourceExec([pa.record_batch({
+        "rk": pa.array(np.arange(20, dtype=np.int32)),
+        "rv": pa.array(np.arange(20, dtype=np.int64))})])
+    join = TpuShuffledHashJoinExec([col("lk")], [col("rk")], "inner",
+                                   left, right, build_unique_hint=True)
+    out = collect_arrow(join)  # deferred check passes
+    assert out.num_rows == 40
+
+
+def test_unique_hint_verify_off_is_unchecked():
+    """Conf off: the hint is trusted verbatim (the reference-style
+    trust-me escape hatch) — no raise, even though results drop dups."""
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.exec.base import ExecCtx, collect_arrow
+    left, right = _dup_build_sources()
+    join = TpuShuffledHashJoinExec([col("lk")], [col("rk")], "inner",
+                                   left, right, build_unique_hint=True)
+    conf = RapidsConf({"spark.rapids.sql.join.verifyUniqueHint": "false"})
+    out = collect_arrow(join, ExecCtx(conf))
+    assert out.num_rows == 20  # one match per stream row: dropped dups

@@ -111,6 +111,32 @@ def test_local_limit():
         assert_tpu_and_cpu_plan_equal(plan, label=f"limit {lim}")
 
 
+def test_local_limit_early_exit():
+    """LIMIT n over a long stream stops pulling the child after the
+    periodic counter sync confirms the limit is reached (ADVICE r4: the
+    sync-free path did O(input) work)."""
+    from spark_rapids_tpu.exec.base import ExecCtx
+
+    rbs = [gen_table([IntegerGen(nullable=False)], 100, seed=s)
+           for s in range(64)]
+    pulled = []
+
+    class CountingSource(HostBatchSourceExec):
+        def execute(self, ctx):
+            for i, b in enumerate(super().execute(ctx)):
+                pulled.append(i)
+                yield b
+
+    plan = TpuLocalLimitExec(50, CountingSource(rbs))
+    list(plan.execute(ExecCtx()))
+    # limit hit in batch 0; the every-8-batches sync must break the
+    # stream well before all 64 batches are decoded/uploaded
+    assert len(pulled) <= TpuLocalLimitExec._SYNC_EVERY
+    pulled.clear()
+    assert_tpu_and_cpu_plan_equal(
+        TpuLocalLimitExec(50, CountingSource(rbs)), label="early-exit")
+
+
 def test_topn():
     plan = TpuTopNExec(
         10, [SortOrder(col("c0"), ascending=False), SortOrder(col("c2"))],
