@@ -235,6 +235,106 @@ def setup_join_groupby(n_li=1 << 23, n_ord=1 << 17):
     return run, host_run, finish_check, n_li
 
 
+def bench_nds_from_files(tmp_dir, n_sales=1 << 20):
+    """NDS-shaped queries with the SCAN in the timed region
+    (VERDICT r4 weak #2: the cached geomean is compute-only): tables
+    written as snappy parquet once, then per query the engine pipeline
+    reads files -> device decode -> query, vs pandas read_parquet + the
+    oracle computation on the same files. Two queries bound first-run
+    compile time; both place every operator on device. Returns
+    (geomean, detail, verify_fn) — the caller runs verify AFTER every
+    timed phase (downloads flip tunneled dispatch to sync)."""
+    import math
+
+    import jax
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.exec.base import ExecCtx
+    from spark_rapids_tpu.planner import TpuOverrides
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.tools.nds import (build_query, gen_tables,
+                                            pandas_oracle)
+    order = ["q3", "q55"]
+    tables = gen_tables(n_sales=n_sales)
+    # cache keyed by the data shape: a gen_tables/n_sales change must
+    # invalidate old files or the bench silently times stale data
+    tmp_dir = f"{tmp_dir}_n{n_sales}"
+    paths = {}
+    os.makedirs(tmp_dir, exist_ok=True)
+    for name, cols in tables.items():
+        p = os.path.join(tmp_dir, f"{name}.parquet")
+        if not os.path.exists(p):
+            pq.write_table(pa.table(cols), p, row_group_size=1 << 19,
+                           compression="snappy")
+        paths[name] = p
+    s = TpuSession(conf={"spark.sql.shuffle.partitions": "1"})
+    frames = {name: s.read_parquet(p) for name, p in paths.items()}
+    s._nds_frames = (tables, frames)
+    results = {}
+    ratios = []
+    outs = {}
+    for name in order:
+        df = build_query(name, s, tables)
+        pp = TpuOverrides(s.conf).apply(df._node)
+        ctx = ExecCtx(s.conf)
+
+        def run_dev():
+            bs = list(pp.root.execute(ctx))
+            jax.block_until_ready(bs)
+            return bs
+        run_dev()  # warm-up/compile
+        times = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            outs[name] = run_dev()
+            times.append(time.perf_counter() - t0)
+        dev_t = min(times)
+
+        import pandas as pd
+
+        def host_run():
+            t0 = time.perf_counter()
+            pdt = {n2: pq.read_table(p).to_pandas()
+                   for n2, p in paths.items()}
+            pandas_oracle(name, tables, pdt=pdt)
+            return time.perf_counter() - t0
+        host_t = min(host_run() for _ in range(2))
+        results[name] = {"device_ms": round(dev_t * 1e3, 1),
+                         "host_ms": round(host_t * 1e3, 1),
+                         "vs_host": round(host_t / dev_t, 3)}
+        ratios.append(host_t / dev_t)
+    geomean = math.exp(sum(math.log(r) for r in ratios) / len(ratios))
+
+    def verify():
+        # deferred like bench_nds_subset's: a scan/decode bug must fail
+        # the bench, not publish a plausible geomean over wrong rows
+        import pandas as pd
+
+        from spark_rapids_tpu.columnar.arrow_bridge import (
+            arrow_schema, device_to_arrow)
+        pdt = {n2: pq.read_table(p).to_pandas()
+               for n2, p in paths.items()}
+        for name in order:
+            df = build_query(name, s, tables)
+            rbs = [device_to_arrow(b) for b in outs[name]]
+            got = pa.Table.from_batches(
+                rbs, schema=arrow_schema(df._node.output_schema)) \
+                .to_pandas()
+            want = pandas_oracle(name, tables, pdt=pdt) \
+                .reset_index(drop=True)
+            assert len(got) == len(want), (name, len(got), len(want))
+            for ci, c in enumerate(want.columns):
+                w = want[c].to_numpy()
+                g = got.iloc[:, ci].to_numpy()
+                if np.issubdtype(w.dtype, np.floating):
+                    assert np.allclose(g.astype(float), w, rtol=1e-5,
+                                       atol=1e-5), (name, c)
+                else:
+                    assert (g == w).all(), (name, c)
+    return round(geomean, 3), results, verify
+
+
 def bench_nds_subset(n_sales=1 << 21):
     """TPC-DS-shaped corpus (spark_rapids_tpu.tools.nds): per query,
     device wall time through the full session/planner path vs the
@@ -364,6 +464,16 @@ def main():
           + "; ".join(f"{k} {v['vs_host']}x" for k, v in
                       nds_detail.items()), file=sys.stderr)
 
+    # --- timed phase 0b: NDS from FILES (scan in the timed region) -------
+    nds_files_dir = os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), ".bench_cache", "nds_parquet")
+    nds_files_geo, nds_files_detail, nds_files_verify = \
+        bench_nds_from_files(nds_files_dir)
+    print(f"nds from-files: geomean {nds_files_geo}x host "
+          "(pandas read_parquet + compute); "
+          + "; ".join(f"{k} {v['vs_host']}x" for k, v in
+                      nds_files_detail.items()), file=sys.stderr)
+
     n = SF_ROWS
     cols = gen_lineitem(n)
     paths = ensure_parquet(cols, n)
@@ -428,6 +538,54 @@ def main():
         return sorted(ts)[3]
     t_xla = _t(xla_fn)
     t_pal = _t(lambda *a: masked_product_sum_pallas(*a, False))
+
+    # gather-bound A/B (VERDICT r4 weak #10: the hard candidate). The
+    # elementwise A/B above measures the kernel XLA was always going to
+    # win; gather shapes (join probe, _ragged_to_matrix) are where a
+    # hand kernel could pay. Mosaic on this environment may reject the
+    # kernel — recorded verbatim, keeping the question FALSIFIABLE
+    # rather than implying a measured no-win.
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.ops.pallas_kernels import (gather_pallas,
+                                                     gather_xla)
+    g_rng = np.random.default_rng(2)
+    g_table = jax.device_put(
+        g_rng.uniform(0, 1, 1 << 20).astype(np.float32))
+    g_idx = jax.device_put(
+        g_rng.integers(0, 1 << 20, 1 << 22).astype(np.int32))
+    g_xla = jax.jit(gather_xla)
+    g_xla(g_table, g_idx).block_until_ready()
+
+    def _tg(fn):
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            fn(g_table, g_idx).block_until_ready()
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[2]
+    tg_xla = _tg(g_xla)
+    try:
+        r_gp = gather_pallas(g_table, g_idx, False)
+        r_gp.block_until_ready()
+        compiled = True
+    except Exception as e:
+        # ONLY compile/lowering failures may claim "rejected"; anything
+        # after a successful compile (wrong values, OOM) must surface
+        # as its own status or the A/B stops being falsifiable
+        compiled = False
+        gather_ab = {"xla_ms": round(tg_xla * 1e3, 3),
+                     "status": "mosaic-rejected",
+                     "error": f"{type(e).__name__}: {str(e)[:120]}"}
+    if compiled:
+        if not bool(jnp.array_equal(g_xla(g_table, g_idx), r_gp)):
+            gather_ab = {"xla_ms": round(tg_xla * 1e3, 3),
+                         "status": "WRONG-RESULT"}
+        else:
+            tg_pal = _tg(lambda t_, i_: gather_pallas(t_, i_, False))
+            gather_ab = {"xla_ms": round(tg_xla * 1e3, 3),
+                         "pallas_ms": round(tg_pal * 1e3, 3),
+                         "pallas_over_xla": round(tg_xla / tg_pal, 3)}
 
     # --- timed phase 2: FROM FILES (scan -> filter -> proj -> agg) -------
     # one scan exec per timed run would re-plan splits; splits are cheap
@@ -528,6 +686,7 @@ def main():
     # --- correctness (post-timing: the downloads happen HERE) -----------
     join_check(join_outs, host_join_out)
     nds_verify()
+    nds_files_verify()
     assert abs(float(r_xla) - float(r_pal)) <= \
         1e-3 * max(1.0, abs(float(r_xla))), (float(r_xla), float(r_pal))
     join_mrows = round(join_rows / join_dev_t / 1e6, 2)
@@ -576,13 +735,22 @@ def main():
             round(join_rows / join_sync_t / 1e6, 2),
         "nds_subset_geomean_vs_host": nds_geomean,
         "nds_subset_detail": nds_detail,
-        # Pallas vs XLA on the q6 inner loop (rows/ms; >1 means the
-        # hand kernel wins). The measured answer to SURVEY.md §7.1.3.
+        # scans in the timed region (VERDICT r4 weak #2): engine
+        # files->device-decode->query vs pandas read_parquet + compute
+        "nds_subset_from_files_vs_host": nds_files_geo,
+        "nds_from_files_detail": nds_files_detail,
+        # Pallas vs XLA (SURVEY.md §7.1.3). pallas_ab is the q6 inner
+        # loop — the fused elementwise+reduce shape XLA wins at the
+        # roofline. pallas_gather_ab is the HARD candidate (join-probe/
+        # ragged gather shapes); when Mosaic rejects the kernel the
+        # entry says so: on this environment the general question stays
+        # OPEN for gather shapes, not answered.
         "pallas_ab": {
             "xla_ms": round(t_xla * 1e3, 3),
             "pallas_ms": round(t_pal * 1e3, 3),
             "pallas_over_xla": round(t_xla / t_pal, 3),
         },
+        "pallas_gather_ab": gather_ab,
         "device_kind": kind,
     }))
 

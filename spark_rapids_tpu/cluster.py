@@ -199,13 +199,19 @@ class TpuProcessCluster:
         wenv["RAPIDS_TPU_WORKER_PLATFORM"] = platform
         if env:
             wenv.update(env)
-        self._procs = [
-            subprocess.Popen(
+        # stderr goes to a file per worker, NOT a pipe: an undrained
+        # pipe blocks the worker once it fills (~64 KiB of library
+        # warnings is enough) — a silent cluster hang
+        self._errlogs = []
+        self._procs = []
+        for w in range(n_workers):
+            errpath = os.path.join(self.root, f"worker-{w}.err")
+            errf = open(errpath, "wb")
+            self._errlogs.append((errpath, errf))
+            self._procs.append(subprocess.Popen(
                 [sys.executable, "-m", "spark_rapids_tpu.cluster",
                  "--root", self.root, "--worker", str(w)],
-                env=wenv, stdout=subprocess.DEVNULL,
-                stderr=subprocess.PIPE)
-            for w in range(n_workers)]
+                env=wenv, stdout=subprocess.DEVNULL, stderr=errf))
         self._task_seq = 0
         self._sid_seq = 0
 
@@ -232,10 +238,14 @@ class TpuProcessCluster:
                         raise RuntimeError(
                             f"worker task {os.path.basename(p)} failed:\n"
                             + f.read())
-            for proc in self._procs:
+            for w, proc in enumerate(self._procs):
                 if proc.poll() is not None:
-                    err = proc.stderr.read().decode(errors="replace") \
-                        if proc.stderr else ""
+                    errpath = self._errlogs[w][0]
+                    try:
+                        with open(errpath, "rb") as f:
+                            err = f.read().decode(errors="replace")
+                    except OSError:
+                        err = ""
                     raise RuntimeError(
                         f"worker died rc={proc.returncode}: {err[-2000:]}")
             if time.time() > deadline:
@@ -251,6 +261,11 @@ class TpuProcessCluster:
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+        for _, errf in self._errlogs:
+            try:
+                errf.close()
+            except OSError:
+                pass
         if self._own_root:
             import shutil
             shutil.rmtree(self.root, ignore_errors=True)
@@ -429,9 +444,15 @@ def _slice_partitions(plan: TpuExec, w: int, n: int):
     """Restrict every ProcessShuffleReadExec to worker w's share of its
     partitions; None when w gets no partitions anywhere."""
     reads: List[ProcessShuffleReadExec] = []
+    seen = set()
 
     def walk(node):
-        if isinstance(node, ProcessShuffleReadExec):
+        if isinstance(node, ProcessShuffleReadExec) \
+                and id(node) not in seen:
+            # dedupe: an aliased subtree (self-join) holds the SAME
+            # read node under both parents — slicing it twice would
+            # leave partitions no worker reads
+            seen.add(id(node))
             reads.append(node)
         for c in getattr(node, "children", ()):
             walk(c)

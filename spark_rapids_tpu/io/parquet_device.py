@@ -236,14 +236,19 @@ _MAX_DICT_WIDTH = 24  # funnel-shift window bound: shift(<=31) + width <= 55
 
 class ChunkPlan:
     """Host-side product of planning one column chunk for device decode:
-    numpy arrays ready for upload + the static facts the kernel needs."""
+    numpy arrays ready for upload + the static facts the kernel needs.
+    For STRING chunks (dictionary-encoded BYTE_ARRAY), `lane` is int32
+    (the index stream), `dictionary` is None and `str_dict` holds the
+    host-decoded (offsets int32[nd+1], chars uint8[...]) dictionary —
+    the device expands indices then gathers the strings in HBM."""
 
     __slots__ = ("n_rows", "lane", "dictionary", "packed", "runs",
                  "def_packed", "def_runs", "n_valid", "has_nulls",
-                 "encoded_bytes")
+                 "encoded_bytes", "str_dict", "str_char_cap")
 
     def __init__(self, n_rows, lane, dictionary, packed, runs, def_packed,
-                 def_runs, n_valid, encoded_bytes):
+                 def_runs, n_valid, encoded_bytes, str_dict=None,
+                 str_char_cap=0):
         self.n_rows = n_rows
         self.lane = lane
         self.dictionary = dictionary
@@ -254,6 +259,8 @@ class ChunkPlan:
         self.n_valid = n_valid
         self.has_nulls = n_valid < n_rows
         self.encoded_bytes = encoded_bytes
+        self.str_dict = str_dict      # (offsets, chars) or None
+        self.str_char_cap = str_char_cap
 
 
 def _decompress(codec: str, payload: bytes, uncompressed: int) -> bytes:
@@ -280,7 +287,9 @@ def plan_chunk(f, col_md, descriptor, engine_dtype: dt.DataType,
     decode. `f` is an open seekable file object; raises HostFallback
     anywhere outside the envelope."""
     phys = col_md.physical_type
-    lane = _PHYS_LANE.get(phys)
+    is_string = phys == "BYTE_ARRAY" \
+        and isinstance(engine_dtype, (dt.StringType, dt.BinaryType))
+    lane = np.dtype(np.int32) if is_string else _PHYS_LANE.get(phys)
     if lane is None:
         raise HostFallback(f"physical type {phys}")
     if descriptor.max_repetition_level != 0:
@@ -307,7 +316,7 @@ def plan_chunk(f, col_md, descriptor, engine_dtype: dt.DataType,
             return "i64"
         return str(t)
     eng_arrow = dt.to_arrow(engine_dtype)
-    if arrow_field_type != eng_arrow \
+    if not is_string and arrow_field_type != eng_arrow \
             and _bits_class(arrow_field_type) != _bits_class(eng_arrow):
         both_int = pa.types.is_integer(arrow_field_type) \
             and pa.types.is_integer(eng_arrow)
@@ -323,6 +332,7 @@ def plan_chunk(f, col_md, descriptor, engine_dtype: dt.DataType,
     buf = f.read(col_md.total_compressed_size)
 
     dictionary: Optional[np.ndarray] = None
+    str_dict = None                 # (offsets, chars) for BYTE_ARRAY
     packed_parts: List[bytes] = []
     runs: List[tuple] = []          # (value_row, is_rle, value, bit, is_dict, width)
     def_packed_parts: List[bytes] = []
@@ -344,7 +354,10 @@ def plan_chunk(f, col_md, descriptor, engine_dtype: dt.DataType,
             data = _decompress(codec, payload, hdr["uncompressed"])
             if phys == "BOOLEAN":
                 raise HostFallback("boolean dictionary")
-            dictionary = np.frombuffer(data, lane, count=dh.get(1, 0))
+            if is_string:
+                str_dict = _parse_byte_array_dict(data, dh.get(1, 0))
+            else:
+                dictionary = np.frombuffer(data, lane, count=dh.get(1, 0))
             continue
         if hdr["type"] == _PAGE_INDEX:
             continue
@@ -371,21 +384,28 @@ def plan_chunk(f, col_md, descriptor, engine_dtype: dt.DataType,
                 data[4:4 + dl], 0, num_values)
             def_runs.extend(page_def)
             off = 4 + dl
-        if enc in (_ENC_RLE_DICT, _ENC_PLAIN_DICT) and dictionary is not None:
+        if enc in (_ENC_RLE_DICT, _ENC_PLAIN_DICT) \
+                and (dictionary is not None or str_dict is not None):
             width = data[off]
             if width > _MAX_DICT_WIDTH:
                 raise HostFallback(f"dict index width {width}")
+            # string chunks: the INDEX stream is the decoded value
+            # (is_dict False -> the kernel returns raw indices; the
+            # device gathers strings from the uploaded dictionary)
+            as_dict = not is_string
             base_bits = _align8(packed_parts) * 8
             if width == 0:
                 # every value is dictionary[0]
-                runs.append((values_seen, True, 0, 0, True, 1))
+                runs.append((values_seen, True, 0, 0, as_dict, 1))
             else:
                 pruns, stream_end = _parse_runs(data, off + 1, len(data),
                                                 width, page_valid,
                                                 base_bits)
                 packed_parts.append(data[off + 1: stream_end])
-                runs.extend((r + values_seen, k, v, b, True, width)
+                runs.extend((r + values_seen, k, v, b, as_dict, width)
                             for r, k, v, b in pruns)
+        elif enc == _ENC_PLAIN and is_string:
+            raise HostFallback("PLAIN string pages (host decode)")
         elif enc == _ENC_PLAIN:
             base = _align8(packed_parts)
             if phys == "BOOLEAN":
@@ -418,18 +438,50 @@ def plan_chunk(f, col_md, descriptor, engine_dtype: dt.DataType,
     encoded = (len(packed) + len(def_packed) + run_tab.nbytes
                + def_tab.nbytes
                + (dictionary.nbytes if dictionary is not None else 0))
-    # no-win guard: the host-decode path uploads bucket_rows(n)×lane
-    # data + a bool validity lane; if the encoded form (incl. tables)
-    # is not smaller, host decode is the better trade
-    host_upload = bucket_rows(n_rows) * (lane.itemsize + 1)
-    if encoded > host_upload:
-        raise HostFallback(
-            f"encoded {encoded}B >= host upload {host_upload}B")
+    str_char_cap = 0
+    if is_string:
+        if str_dict is None:
+            raise HostFallback("string chunk without dictionary")
+        d_offs, d_chars = str_dict
+        encoded += d_offs.nbytes + d_chars.nbytes
+        d_lens = d_offs[1:] - d_offs[:-1]
+        max_len = int(d_lens.max()) if d_lens.size else 0
+        bound = n_rows * max(max_len, 1)
+        if bound > (1 << 26):
+            raise HostFallback(
+                f"string expansion bound {bound}B over the device cap")
+        str_char_cap = bucket_bytes(max(bound, 16))
+    else:
+        # no-win guard: the host-decode path uploads bucket_rows(n)×lane
+        # data + a bool validity lane; if the encoded form (incl.
+        # tables) is not smaller, host decode is the better trade
+        host_upload = bucket_rows(n_rows) * (lane.itemsize + 1)
+        if encoded > host_upload:
+            raise HostFallback(
+                f"encoded {encoded}B >= host upload {host_upload}B")
     return ChunkPlan(n_rows, lane,
                      dictionary if dictionary is not None
                      else np.zeros(1, lane),
                      _as_words(packed), run_tab,
-                     _as_words(def_packed), def_tab, values_seen, encoded)
+                     _as_words(def_packed), def_tab, values_seen, encoded,
+                     str_dict=str_dict, str_char_cap=str_char_cap)
+
+
+def _parse_byte_array_dict(data: bytes, count: int):
+    """PLAIN BYTE_ARRAY dictionary page -> (offsets int32[count+1],
+    chars uint8[...]). Dictionaries are small (that is why the column
+    dict-encoded), so the host loop is fine."""
+    offs = np.zeros(count + 1, np.int32)
+    parts = []
+    pos = 0
+    for i in range(count):
+        ln = int.from_bytes(data[pos:pos + 4], "little")
+        pos += 4
+        parts.append(data[pos:pos + ln])
+        pos += ln
+        offs[i + 1] = offs[i] + ln
+    chars = np.frombuffer(b"".join(parts) + b"\x00" * 8, np.uint8)
+    return offs, chars
 
 
 def _as_words(b: bytes) -> np.ndarray:
@@ -565,11 +617,21 @@ def decode_row_group_device(plans: Dict[str, Tuple[ChunkPlan, dt.DataType]],
         d_u32 = np.ascontiguousarray(d).view(np.uint32).reshape(-1) \
             if d.dtype != np.bool_ else np.zeros(2, np.uint32)
         dict_off, _ = add(d_u32)
+        if plan.str_dict is not None:
+            s_offs, s_chars = plan.str_dict
+            so_off, _ = add(np.ascontiguousarray(_pad_pow2(s_offs))
+                            .view(np.uint32))
+            sc_off, sc_len = add(_as_words(s_chars.tobytes()))
+            str_info = (so_off, s_offs.shape[0] - 1, sc_off,
+                        plan.str_char_cap)
+        else:
+            str_info = None
         names.append(name)
-        spec.append((str(lane), str(np.dtype(eng_dtype.np_dtype)),
+        spec.append((str(lane), str(np.dtype(eng_dtype.np_dtype))
+                     if eng_dtype.np_dtype is not None else "str",
                      w_off, max(w_len, 4), t_off, t.shape[0],
                      dw_off, max(dw_len, 4), dt_off, dtab.shape[0],
-                     dict_off, d.shape[0], plan.n_rows))
+                     dict_off, d.shape[0], plan.n_rows, str_info))
     parts.append(np.zeros(4, np.uint32))  # slice-overrun guard words
     blob = np.concatenate(parts)
     blob = _pad_pow2(blob)
@@ -580,7 +642,8 @@ def decode_row_group_device(plans: Dict[str, Tuple[ChunkPlan, dt.DataType]],
         def build(b):
             outs = []
             for (lane_s, eng_s, w_off, w_len, t_off, t_n, dw_off,
-                 dw_len, dt_off, dt_n, d_off, d_n, n_rows) in spec:
+                 dw_len, dt_off, dt_n, d_off, d_n, n_rows,
+                 str_info) in spec:
                 lane = np.dtype(lane_s)
                 words = b[w_off: w_off + w_len + 2]
                 tab = lax.bitcast_convert_type(
@@ -602,6 +665,30 @@ def decode_row_group_device(plans: Dict[str, Tuple[ChunkPlan, dt.DataType]],
                 vals, valid = _decode_device(
                     words, tab, dict_arr, def_words, def_tab,
                     jnp.int64(n_rows), cap)
+                if str_info is not None:
+                    so_off, nd, sc_off, char_cap = str_info
+                    d_offs = lax.bitcast_convert_type(
+                        b[so_off: so_off + nd + 1], jnp.int32)
+                    idx = jnp.clip(vals.astype(jnp.int32), 0,
+                                   max(nd - 1, 0))
+                    lens = d_offs[idx + 1] - d_offs[idx]
+                    ll = jnp.where(valid, lens, 0)
+                    offsets = jnp.concatenate(
+                        [jnp.zeros((1,), jnp.int32),
+                         jnp.cumsum(ll).astype(jnp.int32)])
+                    k = jnp.arange(char_cap, dtype=jnp.int32)
+                    row = jnp.clip(
+                        jnp.searchsorted(offsets, k, side="right") - 1,
+                        0, cap - 1)
+                    src = d_offs[idx[row]] + (k - offsets[:-1][row])
+                    word = b[jnp.clip(sc_off + (src >> 2), 0,
+                                      b.shape[0] - 1)]
+                    byte = ((word >> ((src & 3) * 8))
+                            & jnp.uint32(0xFF)).astype(jnp.uint8)
+                    chars = jnp.where(k < offsets[-1], byte,
+                                      jnp.uint8(0))
+                    outs.append((offsets, chars, valid))
+                    continue
                 if vals.dtype != np.dtype(eng_s):
                     vals = vals.astype(np.dtype(eng_s))
                 outs.append((vals, valid))
@@ -610,10 +697,16 @@ def decode_row_group_device(plans: Dict[str, Tuple[ChunkPlan, dt.DataType]],
         _JIT_CACHE[key] = fn
     outs = fn(jnp.asarray(blob))
     result = {}
-    for name, (plan, eng_dtype), (vals, valid) in zip(
+    for name, (plan, eng_dtype), out in zip(
             names, [plans[n] for n in names], outs):
-        result[name] = TpuColumnVector(eng_dtype, data=vals,
-                                       validity=valid)
+        if plan.str_dict is not None:
+            offsets, chars, valid = out
+            result[name] = TpuColumnVector(eng_dtype, validity=valid,
+                                           offsets=offsets, chars=chars)
+        else:
+            vals, valid = out
+            result[name] = TpuColumnVector(eng_dtype, data=vals,
+                                           validity=valid)
     return result
 
 

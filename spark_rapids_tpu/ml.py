@@ -1,0 +1,97 @@
+"""ML bridge: hand device feature columns to a trainer.
+
+TPU analog of the reference's `ColumnarRdd` / `InternalColumnarRddConverter`
+(SURVEY.md §2.2-B "RDD/Dataset bridge", §3.5, BASELINE config 4;
+reference mount empty): the reference exposes GPU column handles to
+XGBoost4J-Spark so DMatrix construction skips row conversion. Here:
+
+- `columnar_rdd(df)` yields the executed plan's DEVICE batches as
+  {name: jax.Array} column dicts — no row conversion, no Arrow
+  round-trip; a JAX trainer consumes HBM-resident features directly
+  (the zero-copy path the reference gets via DMatrix-from-GPU-handles).
+- `to_feature_matrix(df, feature_cols, label_col)` stacks numeric
+  columns into ONE device (n, f) float32 matrix + label vector with a
+  live-row mask — the DMatrix-shaped handoff.
+- `to_torch(df, ...)` materializes the matrix for host trainers
+  (torch CPU wheels here; on co-located deployments this is the
+  device->host hop XGBoost's CPU predictor pays too).
+
+The Mortgage-ETL-shaped pipeline feeding this lives in
+`tools/mortgage.py` (BASELINE config 4's ETL half).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+__all__ = ["columnar_rdd", "to_feature_matrix", "to_torch"]
+
+
+def columnar_rdd(df) -> Iterator[Dict[str, object]]:
+    """Execute the DataFrame's plan on device and yield per-batch
+    column dicts of jax.Arrays (data lane + validity), padded to the
+    batch capacity with `row_count` marking live rows."""
+    from .exec.base import ExecCtx
+    from .ops.gather import ensure_compacted
+    pp = df._plan()
+    ctx = ExecCtx(df._session.conf)
+    for batch in pp.root.execute(ctx):
+        batch = ensure_compacted(batch)
+        out: Dict[str, object] = {"row_count": batch.row_count}
+        for f, c in zip(batch.schema.fields, batch.columns):
+            out[f.name] = c.data if c.data is not None else c
+            out[f.name + "__valid"] = c.validity
+        yield out
+
+
+def to_feature_matrix(df, feature_cols: List[str],
+                      label_col: Optional[str] = None):
+    """(features (n, f) float32 jax.Array, labels (n,) float32 | None,
+    live (n,) bool) — one device-resident design matrix from the
+    executed plan; nulls become 0.0 with the row kept (the reference's
+    DMatrix treats missing via a sentinel; mask columns are available
+    through columnar_rdd for trainers that model missingness)."""
+    import jax.numpy as jnp
+
+    from .ops.concat import concat_batches
+    from .exec.base import ExecCtx
+    from .ops.gather import ensure_compacted
+    pp = df._plan()
+    ctx = ExecCtx(df._session.conf)
+    batches = [ensure_compacted(b) for b in pp.root.execute(ctx)]
+    if not batches:
+        raise ValueError("empty input")
+    big = batches[0] if len(batches) == 1 else concat_batches(batches)
+    big = ensure_compacted(big)
+    name_to_col = {f.name: c for f, c in zip(big.schema.fields,
+                                             big.columns)}
+    feats = []
+    for name in feature_cols:
+        c = name_to_col[name]
+        if c.data is None:
+            raise TypeError(f"feature column {name} is not numeric")
+        feats.append(jnp.where(c.validity, c.data, 0)
+                     .astype(jnp.float32))
+    X = jnp.stack(feats, axis=1)
+    y = None
+    if label_col is not None:
+        lc = name_to_col[label_col]
+        y = jnp.where(lc.validity, lc.data, 0).astype(jnp.float32)
+    from .columnar.batch import row_mask
+    live = row_mask(big.capacity, big.row_count)
+    return X, y, live
+
+
+def to_torch(df, feature_cols: List[str],
+             label_col: Optional[str] = None):
+    """Host handoff for torch-family trainers: (X (n, f) float32
+    tensor, y | None) with padding rows dropped."""
+    import jax
+    import numpy as np
+    import torch
+    X, y, live = to_feature_matrix(df, feature_cols, label_col)
+    Xh, yh, lh = jax.device_get((X, y, live))
+    lh = np.asarray(lh)
+    Xt = torch.from_numpy(np.ascontiguousarray(np.asarray(Xh)[lh]))
+    yt = None if yh is None else torch.from_numpy(
+        np.ascontiguousarray(np.asarray(yh)[lh]))
+    return Xt, yt

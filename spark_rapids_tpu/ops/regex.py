@@ -641,9 +641,15 @@ def regex_replace_device(col, prog: RegexProgram, repl: bytes,
     in_any_row = (i >= offs[:-1][row_of]) & (i < offs[1:][row_of])
     contrib = jnp.where(in_any_row, contrib, 0)
     out_off = jnp.cumsum(contrib) - contrib  # exclusive
-    # per-row output offsets: exclusive cumsum at row starts + total
-    row_start_out = out_off[jnp.clip(offs[:-1], 0, ccap - 1)]
     total = jnp.sum(contrib)
+    # per-row output offsets: exclusive cumsum at row starts + total.
+    # A row whose start offset EQUALS the chars capacity (total chars
+    # landed exactly on the bucket boundary) must map to `total`, not
+    # to the clipped last slot (which would steal the preceding row's
+    # final output byte — code-review r5)
+    row_start_out = jnp.where(
+        offs[:-1] >= ccap, total,
+        out_off[jnp.clip(offs[:-1], 0, ccap - 1)])
     new_offsets = jnp.concatenate(
         [row_start_out.astype(jnp.int32), total[None].astype(jnp.int32)])
     out = jnp.zeros((char_cap,), jnp.uint8)

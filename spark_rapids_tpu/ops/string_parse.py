@@ -178,12 +178,15 @@ def parse_float_tpu(col, target: dt.DataType):
     ok = ok & ((int_len + frac_len) > 0)  # at least one mantissa digit
     ok = ok & _all_in(is_digit, rid, i, ds, dot, n)
     ok = ok & _all_in(is_digit, rid, i, frac_lo, epos, n)
-    # mantissa digits as one run: value = int_part*10^frac_len + frac
+    # mantissa: integer and fraction digit runs scaled SEPARATELY in
+    # float64 — combining them in uint64 (int*10^frac_len + frac)
+    # overflows past 19 total digits and silently produced garbage
+    # (code-review r5). Each run is individually gated to <= 19
+    # significant digits by _digits_value; the separate scaling costs
+    # at most one extra rounding (the documented ulp caveat).
     iv, _, ok_i = _digits_value(c, rid, i, ds, dot, n)
     fv, _, ok_f = _digits_value(c, rid, i, frac_lo, epos, n)
     ok = ok & ok_i & ok_f
-    pow_f = jnp.asarray(_POW10_U64)[jnp.clip(frac_len, 0, 19)]
-    m = iv * pow_f + fv
     # exponent
     e_ds = epos + 1
     at_e = c[jnp.clip(e_ds, 0, cap)]
@@ -196,10 +199,14 @@ def parse_float_tpu(col, target: dt.DataType):
     ev, _, _ = _digits_value(c, rid, i, e_lo, te, n)
     ev = jnp.clip(ev, jnp.uint64(0), jnp.uint64(400)).astype(jnp.int32)
     exp = jnp.where(has_exp, jnp.where(e_neg, -ev, ev), 0)
-    scale = jnp.clip(exp - frac_len, -350, 350)
-    mag = m.astype(jnp.float64) * jnp.asarray(_F_POW10)[scale + 350]
-    mag = jnp.where(m == 0, 0.0, mag)  # 0e999 is 0.0, not 0*inf
-    val = jnp.where(neg, -mag, mag)
+    POW = jnp.asarray(_F_POW10)
+    int_scale = jnp.clip(exp, -350, 350)
+    frac_scale = jnp.clip(exp - frac_len, -350, 350)
+    int_mag = jnp.where(iv == 0, 0.0,
+                        iv.astype(jnp.float64) * POW[int_scale + 350])
+    frac_mag = jnp.where(fv == 0, 0.0,
+                         fv.astype(jnp.float64) * POW[frac_scale + 350])
+    val = jnp.where(neg, -(int_mag + frac_mag), int_mag + frac_mag)
     # specials (trimmed, case-insensitive)
     nan_m = _match_literal(c, rid, i, ts, te, n, b"nan")
     sgn = has_sign.astype(jnp.int32)

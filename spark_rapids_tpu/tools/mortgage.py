@@ -1,0 +1,144 @@
+"""Mortgage ETL -> training features (BASELINE config 4's ETL half).
+
+TPU analog of the reference's Mortgage pipeline (SURVEY.md §3.5,
+§2.2-F "XGBoost integration"; mount empty): raw acquisition +
+performance tables -> joins/aggregations/casts/categorical features ->
+a feature DataFrame handed to a trainer through the ml.py bridge
+(`ColumnarRdd` analog) WITHOUT row conversion. The reference trains
+XGBoost4J-Spark from GPU column handles; here `train_logreg_jax`
+consumes the device feature matrix directly in HBM (zero host
+round-trip), and `ml.to_torch` serves host-side trainer libraries.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+__all__ = ["gen_mortgage", "mortgage_features", "train_logreg_jax"]
+
+
+def gen_mortgage(n_loans: int = 2000, perf_per_loan: int = 6,
+                 seed: int = 0) -> Dict[str, dict]:
+    """Mortgage-shaped raw tables: `acquisition` (loan origination
+    facts) and `performance` (monthly servicing rows incl. delinquency
+    status) — the two inputs of the reference's ETL."""
+    rng = np.random.default_rng(seed)
+    states = np.array(["CA", "TX", "NY", "FL", "WA", "IL", "OH", "GA"])
+    purposes = np.array(["P", "C", "R", "U"])
+    acquisition = {
+        "loan_id": np.arange(n_loans, dtype=np.int64),
+        "orig_interest_rate": rng.uniform(2.5, 7.5, n_loans)
+        .astype(np.float32),
+        "orig_upb": rng.integers(50_000, 800_000, n_loans)
+        .astype(np.int64),
+        "orig_loan_term": rng.choice([180, 240, 360], n_loans)
+        .astype(np.int32),
+        "oltv": rng.uniform(40, 97, n_loans).astype(np.float32),
+        "dti": rng.uniform(10, 50, n_loans).astype(np.float32),
+        "borrower_credit_score": rng.integers(580, 840, n_loans)
+        .astype(np.int32),
+        "property_state": states[rng.integers(0, len(states), n_loans)]
+        .tolist(),
+        "loan_purpose": purposes[rng.integers(0, len(purposes),
+                                              n_loans)].tolist(),
+    }
+    n_perf = n_loans * perf_per_loan
+    loan = np.repeat(np.arange(n_loans, dtype=np.int64), perf_per_loan)
+    # delinquency risk increases with dti and decreases with score
+    risk = (acquisition["dti"][loan] / 50.0
+            + (760 - acquisition["borrower_credit_score"][loan]) / 400.0)
+    delinq = (rng.uniform(0, 1, n_perf) < np.clip(risk * 0.18, 0, 0.9)) \
+        .astype(np.int32) * rng.integers(1, 4, n_perf).astype(np.int32)
+    performance = {
+        "loan_id": loan,
+        "period": (18_000 + np.tile(np.arange(perf_per_loan) * 30,
+                                    n_loans)).astype(np.int32),
+        "current_upb": (acquisition["orig_upb"][loan]
+                        * rng.uniform(0.5, 1.0, n_perf)).astype(
+                            np.float32),
+        "delinquency_status": delinq,
+    }
+    return {"acquisition": acquisition, "performance": performance}
+
+
+def mortgage_features(session, tables=None, n_loans: int = 2000):
+    """The ETL: per-loan performance aggregation -> join with
+    acquisition -> categorical hash features + casts. Returns the
+    feature DataFrame (one row per loan) and the feature column list —
+    the reference pipeline's shape (§3.5) through this engine's planner
+    (joins, group-by, casts, hash all on device)."""
+    import pyarrow as pa
+
+    from .. import datatypes as dt
+    from ..expr import (Alias, Cast, GreaterThanOrEqual, Literal,
+                        UnresolvedColumn as col)
+    from ..expr.aggregates import Average, Count, Max, Min, Sum
+    from ..expr.hashes import Murmur3Hash
+    if tables is None:
+        tables = gen_mortgage(n_loans)
+    acq = session.create_dataframe(pa.table(tables["acquisition"]))
+    perf = session.create_dataframe(pa.table(tables["performance"]))
+
+    perf_agg = perf.group_by("loan_id").agg(
+        Alias(Max(col("delinquency_status")), "max_delinq"),
+        Alias(Average(col("current_upb")), "avg_upb"),
+        Alias(Min(col("current_upb")), "min_upb"),
+        Alias(Count(col("period")), "n_periods"))
+
+    joined = acq.join(perf_agg, on="loan_id", how="inner")
+    feats = (
+        joined
+        .with_column("state_bucket",
+                     Cast(Murmur3Hash(col("property_state")),
+                          dt.FLOAT32))
+        .with_column("purpose_bucket",
+                     Cast(Murmur3Hash(col("loan_purpose")),
+                          dt.FLOAT32))
+        .with_column("score_f",
+                     Cast(col("borrower_credit_score"), dt.FLOAT32))
+        .with_column("term_f", Cast(col("orig_loan_term"), dt.FLOAT32))
+        .with_column("upb_f", Cast(col("orig_upb"), dt.FLOAT32))
+        .with_column("label",
+                     Cast(GreaterThanOrEqual(col("max_delinq"),
+                                             Literal(1, dt.INT32)),
+                          dt.FLOAT32)))
+    feature_cols = ["orig_interest_rate", "oltv", "dti", "score_f",
+                    "term_f", "upb_f", "avg_upb", "min_upb",
+                    "state_bucket", "purpose_bucket"]
+    return feats, feature_cols
+
+
+def train_logreg_jax(X, y, live, steps: int = 60, lr: float = 0.3):
+    """Logistic regression trained entirely ON DEVICE from the bridge's
+    feature matrix (the XGBoost-from-GPU-handles analog: features never
+    leave HBM). Returns (weights, bias, loss_history)."""
+    import jax
+    import jax.numpy as jnp
+
+    n_live = jnp.maximum(jnp.sum(live.astype(jnp.float32)), 1.0)
+    # standardize over LIVE rows only: a full-capacity mean would be
+    # biased toward 0 by the padding rows (code-review r5)
+    mu = jnp.sum(jnp.where(live[:, None], X, 0), axis=0) / n_live
+    sd = jnp.sqrt(jnp.sum(jnp.where(live[:, None], (X - mu) ** 2, 0),
+                          axis=0) / n_live) + 1e-6
+    Xn = (X - mu) / sd
+    w = jnp.zeros((X.shape[1],), jnp.float32)
+    b = jnp.float32(0.0)
+
+    def loss_fn(params):
+        w_, b_ = params
+        z = Xn @ w_ + b_
+        p = jax.nn.sigmoid(z)
+        eps = 1e-6
+        ll = y * jnp.log(p + eps) + (1 - y) * jnp.log(1 - p + eps)
+        return -jnp.sum(jnp.where(live, ll, 0)) / n_live
+
+    grad = jax.jit(jax.value_and_grad(loss_fn))
+    losses = []
+    params = (w, b)
+    for _ in range(steps):
+        val, g = grad(params)
+        params = (params[0] - lr * g[0], params[1] - lr * g[1])
+        losses.append(float(val))
+    return params[0], params[1], losses

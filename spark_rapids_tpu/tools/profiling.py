@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-__all__ = ["profile_report"]
+__all__ = ["profile_report", "profile_event_logs"]
 
 
 def profile_report(pp, ctx=None) -> str:
@@ -60,3 +60,99 @@ def profile_report(pp, ctx=None) -> str:
         lines.append("recommendations:")
         lines.extend(f"  - {r}" for r in recs)
     return "\n".join(lines)
+
+
+# --- event-log profiling (the reference tool's actual mode) ----------------
+# The reference's ProfileMain mines event logs of ACCELERATED runs:
+# op coverage, metric rollups, cross-run comparison, config
+# recommendations (SURVEY.md:212). Same here over the engine's JSONL
+# query events.
+
+def profile_event_logs(path: str) -> str:
+    import collections
+
+    from .event_log import read_event_logs
+    events = list(read_event_logs(path))
+    lines = ["=== TPU profile (event logs) ===",
+             f"events: {len(events)}"]
+    if not events:
+        return "\n".join(lines + ["(no events under the given path)"])
+
+    # op coverage across every logged plan
+    op_total = collections.Counter()
+    op_dev = collections.Counter()
+    reason_count = collections.Counter()
+    for ev in events:
+        for n in ev.get("nodes", []):
+            op_total[n["op"]] += 1
+            if n["on_device"]:
+                op_dev[n["op"]] += 1
+            for r in n.get("reasons", []):
+                reason_count[r] += 1
+    lines.append("operator coverage:")
+    for op, tot in op_total.most_common():
+        lines.append(f"  {op:<28} {op_dev[op]}/{tot} on device")
+
+    # metric rollups (opTime / spillTime / upload) by operator class
+    roll = collections.defaultdict(float)
+    for ev in events:
+        for label, ms in ev.get("metrics", {}).items():
+            op = label.split("#")[0]
+            for mname in ("opTime", "spillTime", "uploadTime",
+                          "scanTime"):
+                v = ms.get(mname)
+                if isinstance(v, (int, float)):
+                    roll[(op, mname)] += float(v)
+    hot = sorted(((v, k) for k, v in roll.items() if v > 0),
+                 reverse=True)
+    if hot:
+        lines.append("metric rollups (summed across runs):")
+        for v, (op, mname) in hot[:10]:
+            lines.append(f"  {op:<28} {mname:<12} {v * 1e3:9.1f}ms")
+
+    # cross-run regression: same plan fingerprint, wall-time spread
+    by_fp = collections.defaultdict(list)
+    for ev in events:
+        by_fp[ev.get("fingerprint", "?")].append(ev.get("wall_s", 0.0))
+    regressions = []
+    for fp, walls in by_fp.items():
+        if len(walls) >= 2 and min(walls) > 0 \
+                and max(walls) / min(walls) > 1.5:
+            regressions.append((max(walls) / min(walls), fp, walls))
+    if regressions:
+        regressions.sort(reverse=True)
+        lines.append("wall-time spread across runs of the same query "
+                     "(>1.5x):")
+        for ratio, fp, walls in regressions[:5]:
+            lines.append(
+                f"  {fp}  {min(walls) * 1e3:.1f}ms .. "
+                f"{max(walls) * 1e3:.1f}ms  ({ratio:.1f}x)")
+
+    recs = []
+    spill_total = sum(v for (op, m), v in roll.items()
+                      if m == "spillTime")
+    if spill_total > 0.1:
+        recs.append(f"{spill_total * 1e3:.0f}ms total spill — raise "
+                    "the device memory budget or lower concurrency")
+    if reason_count:
+        top = reason_count.most_common(1)[0]
+        recs.append(f"most common fallback ({top[1]}x): {top[0]}")
+    if recs:
+        lines.append("recommendations:")
+        lines.extend(f"  - {r}" for r in recs)
+    return "\n".join(lines)
+
+
+def _main(argv):
+    import sys
+    if not argv:
+        print("usage: python -m spark_rapids_tpu.tools.profiling "
+              "<event-log dir>", file=sys.stderr)
+        return 2
+    print(profile_event_logs(argv[0]))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(_main(sys.argv[1:]))

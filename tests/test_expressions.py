@@ -252,7 +252,12 @@ def test_cast_string_to_float_device():
     vals = ["1", "2.5", "-0.125", ".5", "5.", "1e3", "1.5E-3", "-2e+2",
             "NaN", "nan", "Infinity", "-Infinity", "+inf", "-inf",
             "abc", "", None, "1e", "e5", "0e999", "1e999", "-1e999",
-            " 3.25 ", "1_0", "12345678901234", "+.75"]
+            " 3.25 ", "1_0", "12345678901234", "+.75",
+            # >19 combined mantissa digits must not overflow the device
+            # accumulator (code-review r5: int and fraction runs now
+            # scale separately in float64)
+            "1234567890123456789.123", "1.0000000000000000000005",
+            "0.00000000000000000000075"]
     rb = pa.record_batch({"a": pa.array(vals, pa.string())})
     for t in (dt.FLOAT32, dt.FLOAT64):
         from spark_rapids_tpu.expr.base import bind_expr

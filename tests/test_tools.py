@@ -138,3 +138,73 @@ def test_supported_ops_doc_in_sync():
         committed = f.read().rstrip("\n")
     assert committed == generate_supported_ops().rstrip("\n"), \
         "SUPPORTED_OPS.md is stale; regenerate it"
+
+
+# --- event logs + offline tools (VERDICT r4 missing #8) --------------------
+
+def _run_logged_queries(tmp_path, sql_enabled=True):
+    import pyarrow as pa
+
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.exec.base import HostBatchSourceExec
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.exec.basic import TpuFilterExec
+    from spark_rapids_tpu.expr import (Alias, GreaterThan, Literal,
+                                       UnresolvedColumn as col)
+    from spark_rapids_tpu.expr.aggregates import Count, Sum
+    from spark_rapids_tpu import datatypes as dt
+    from spark_rapids_tpu.planner import TpuOverrides
+    import numpy as np
+    log_dir = str(tmp_path / "events")
+    conf = RapidsConf({
+        "spark.rapids.eventLog.dir": log_dir,
+        "spark.rapids.sql.enabled": str(sql_enabled).lower()})
+    rng = np.random.default_rng(1)
+    rb = pa.record_batch({
+        "k": pa.array(rng.integers(0, 9, 500).astype(np.int32)),
+        "v": pa.array(rng.integers(0, 100, 500).astype(np.int64))})
+    for _ in range(2):  # two runs of the same fingerprint
+        src = HostBatchSourceExec([rb])
+        filt = TpuFilterExec(GreaterThan(col("v"), Literal(10, dt.INT64)),
+                             src)
+        agg = TpuHashAggregateExec(
+            [col("k")], [Alias(Sum(col("v")), "s"),
+                         Alias(Count(), "n")], filt)
+        TpuOverrides(conf).apply(agg).collect()
+    return log_dir
+
+
+def test_event_log_written_and_profiled(tmp_path):
+    from spark_rapids_tpu.tools.event_log import read_event_logs
+    from spark_rapids_tpu.tools.profiling import profile_event_logs
+    log_dir = _run_logged_queries(tmp_path)
+    events = list(read_event_logs(log_dir))
+    assert len(events) == 2
+    assert events[0]["fingerprint"] == events[1]["fingerprint"]
+    assert events[0]["nodes"] and events[0]["wall_s"] > 0
+    report = profile_event_logs(log_dir)
+    assert "operator coverage" in report
+    assert "HashAggregateExec" in report
+
+
+def test_event_log_qualification_cpu_run(tmp_path):
+    """The reference tool's mode: logs from a CPU run (sql disabled)
+    still carry would-be placement; qualification models the speedup."""
+    from spark_rapids_tpu.tools.qualification import qualify_event_logs
+    log_dir = _run_logged_queries(tmp_path, sql_enabled=False)
+    rep = qualify_event_logs(log_dir)
+    assert rep.queries == 2
+    # sql.enabled=false tags every node ineligible -> est ~1x, and the
+    # kill switch is the blocker
+    assert rep.est_speedup <= 1.05
+    assert any("spark.rapids.sql.enabled" in r for r in rep.top_blockers)
+    out = rep.render()
+    assert "estimated speedup" in out
+
+
+def test_event_log_qualification_eligible_run(tmp_path):
+    from spark_rapids_tpu.tools.qualification import qualify_event_logs
+    log_dir = _run_logged_queries(tmp_path, sql_enabled=True)
+    rep = qualify_event_logs(log_dir)
+    assert rep.est_speedup > 3  # fully eligible plan models well
+    assert rep.top_blockers == []
