@@ -41,8 +41,16 @@ from ..exec.base import ExecCtx, LeafExec
 
 __all__ = ["FileSplit", "TpuFileScanExec", "plan_splits"]
 
+from ..config import register as _register
+
+HIVE_TEXT_ENABLED = _register(
+    "spark.rapids.sql.format.hiveText.enabled", True,
+    "Enable accelerated Hive text-serde reads/writes (LazySimpleSerDe "
+    "defaults: \\x01 delimiter, \\N nulls).")
+
 _FORMAT_CONF = {"parquet": PARQUET_ENABLED, "orc": ORC_ENABLED,
-                "csv": CSV_ENABLED, "json": JSON_ENABLED}
+                "csv": CSV_ENABLED, "json": JSON_ENABLED,
+                "hivetext": HIVE_TEXT_ENABLED}
 
 
 class FileSplit:
@@ -235,8 +243,10 @@ def _attach_partition_columns(rbs: List[pa.RecordBatch], part_vals,
 
 
 def _decode_split(split: FileSplit, fmt: str, columns, batch_rows: int,
-                  conjuncts) -> List[pa.RecordBatch]:
-    """Host-side decode of one split into bounded RecordBatches."""
+                  conjuncts, schema=None) -> List[pa.RecordBatch]:
+    """Host-side decode of one split into bounded RecordBatches.
+    `schema` (engine Schema) is required for header-less formats
+    (hivetext)."""
     if fmt == "parquet":
         f = pq.ParquetFile(split.path)
         md = f.metadata
@@ -256,6 +266,9 @@ def _decode_split(split: FileSplit, fmt: str, columns, batch_rows: int,
             if rb.num_rows:
                 out.append(rb)
         return out
+    if fmt == "hivetext":
+        return _decode_hive_text(split.path, columns, batch_rows,
+                                 schema)
     if fmt == "orc":
         from pyarrow import orc
         table = orc.ORCFile(split.path).read(columns=columns)
@@ -273,6 +286,101 @@ def _decode_split(split: FileSplit, fmt: str, columns, batch_rows: int,
         raise ValueError(f"unknown scan format {fmt!r}")
     return [rb for rb in table.combine_chunks().to_batches(
         max_chunksize=batch_rows) if rb.num_rows]
+
+
+def _decode_hive_text(path: str, columns, batch_rows: int,
+                      schema) -> List[pa.RecordBatch]:
+    """Hive LazySimpleSerDe text read (GpuHiveTextFileFormat analog):
+    \\x01 delimiter, \\N nulls, serde escapes (\\\\, \\<delim>, \\n),
+    no header — the schema names/types the fields. Host decode; the
+    standard upload path carries the columns to the device."""
+    if schema is None:
+        raise ValueError("hivetext scans need an explicit schema= "
+                         "(the format has no header)")
+    names = [f.name for f in schema.fields
+             if columns is None or f.name in columns]
+    fields = {f.name: f for f in schema.fields}
+
+    def unescape(tok: str):
+        if tok == "\\N":
+            return None
+        out = []
+        i = 0
+        while i < len(tok):
+            ch = tok[i]
+            if ch == "\\" and i + 1 < len(tok):
+                nxt = tok[i + 1]
+                out.append("\n" if nxt == "n" else nxt)
+                i += 2
+            else:
+                out.append(ch)
+                i += 1
+        return "".join(out)
+
+    def split_row(line: str) -> List[str]:
+        toks, cur, i = [], [], 0
+        while i < len(line):
+            ch = line[i]
+            if ch == "\\" and i + 1 < len(line):
+                cur.append(ch)
+                cur.append(line[i + 1])
+                i += 2
+                continue
+            if ch == "\x01":
+                toks.append("".join(cur))
+                cur = []
+            else:
+                cur.append(ch)
+            i += 1
+        toks.append("".join(cur))
+        return toks
+
+    def conv(tok, f):
+        v = unescape(tok)
+        if v is None:
+            return None
+        try:
+            if dt.is_integral(f.dtype):
+                return int(v)
+            if dt.is_floating(f.dtype):
+                return float(v)
+            if isinstance(f.dtype, dt.BooleanType):
+                return v.lower() == "true"
+            if isinstance(f.dtype, dt.DateType):
+                import datetime as _dtm
+                y, m, d = v.split("-")
+                return _dtm.date(int(y), int(m), int(d))
+            if isinstance(f.dtype, dt.BinaryType):
+                import base64
+                return base64.b64decode(v)  # Hive Base64 binary
+        except (ValueError, TypeError):
+            return None
+        return v  # strings
+
+    all_fields = [f.name for f in schema.fields]
+    out: List[pa.RecordBatch] = []
+    rows: List[List[str]] = []
+
+    def flush():
+        if not rows:
+            return
+        arrays = []
+        for name in names:
+            fi = all_fields.index(name)
+            f = fields[name]
+            vals = [conv(r[fi], f) if fi < len(r) else None
+                    for r in rows]
+            arrays.append(pa.array(vals, type=dt.to_arrow(f.dtype)))
+        out.append(pa.RecordBatch.from_arrays(arrays, names=names))
+        rows.clear()
+
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            rows.append(split_row(line.rstrip("\n")))
+            if len(rows) >= batch_rows:
+                flush()
+    flush()
+    return out
 
 
 class TpuFileScanExec(LeafExec):
@@ -369,7 +477,7 @@ class TpuFileScanExec(LeafExec):
     def _decode_with_parts(self, split: FileSplit,
                            batch_rows: int) -> List[pa.RecordBatch]:
         rbs = _decode_split(split, self.fmt, self.columns, batch_rows,
-                            self._conjuncts)
+                            self._conjuncts, schema=self._schema)
         if self._part_schema is None:
             return rbs
         return _attach_partition_columns(

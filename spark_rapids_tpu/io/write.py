@@ -28,7 +28,8 @@ PARQUET_COMPRESSION = register(
     "spark.sql.parquet.compression.codec", "snappy",
     "Compression codec for Parquet writes: none, snappy, zstd, lz4, gzip.")
 
-_FMT_EXT = {"parquet": "parquet", "orc": "orc", "csv": "csv"}
+_FMT_EXT = {"parquet": "parquet", "orc": "orc", "csv": "csv",
+            "hivetext": "txt"}
 
 
 def _write_one(table: pa.Table, path: str, fmt: str, compression: str):
@@ -43,8 +44,39 @@ def _write_one(table: pa.Table, path: str, fmt: str, compression: str):
     elif fmt == "csv":
         from pyarrow import csv
         csv.write_csv(table, path)
+    elif fmt == "hivetext":
+        _write_hive_text(table, path)
     else:
         raise ValueError(f"unknown write format {fmt!r}")
+
+
+def _write_hive_text(table: pa.Table, path: str):
+    """Hive LazySimpleSerDe text defaults (GpuHiveTextFileFormat analog
+    — SURVEY.md §2.2-B 'Hive text / misc formats'): \\x01 field
+    delimiter, \\N for NULL, \\n row terminator, no header. Strings'
+    delimiter/newline/backslash bytes are escaped like the serde does."""
+    import base64
+    cols = [table.column(i).to_pylist() for i in range(table.num_columns)]
+    types = [f.type for f in table.schema]
+    with open(path, "w", encoding="utf-8") as f:
+        for r in range(table.num_rows):
+            fields = []
+            for ci, vals in enumerate(cols):
+                v = vals[r]
+                if v is None:
+                    fields.append("\\N")
+                elif pa.types.is_boolean(types[ci]):
+                    fields.append("true" if v else "false")
+                elif isinstance(v, bytes):
+                    # Hive text serde encodes BINARY as Base64
+                    fields.append(base64.b64encode(v).decode("ascii"))
+                elif isinstance(v, str):
+                    fields.append(v.replace("\\", "\\\\")
+                                  .replace("\x01", "\\\x01")
+                                  .replace("\n", "\\n"))
+                else:
+                    fields.append(str(v))
+            f.write("\x01".join(fields) + "\n")
 
 
 def write_files(batches: Iterator[pa.RecordBatch], schema: pa.Schema,

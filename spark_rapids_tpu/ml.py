@@ -28,8 +28,11 @@ __all__ = ["columnar_rdd", "to_feature_matrix", "to_torch"]
 
 def columnar_rdd(df) -> Iterator[Dict[str, object]]:
     """Execute the DataFrame's plan on device and yield per-batch
-    column dicts of jax.Arrays (data lane + validity), padded to the
-    batch capacity with `row_count` marking live rows."""
+    column dicts of jax.Arrays, padded to the batch capacity with
+    `row_count` marking live rows: fixed-width columns contribute a
+    data lane + `<name>__valid`; string/binary columns contribute
+    `<name>__offsets` + `<name>__chars` + `<name>__valid` (the ragged
+    Arrow layout — still jax.Arrays, never wrapper objects)."""
     from .exec.base import ExecCtx
     from .ops.gather import ensure_compacted
     pp = df._plan()
@@ -38,7 +41,15 @@ def columnar_rdd(df) -> Iterator[Dict[str, object]]:
         batch = ensure_compacted(batch)
         out: Dict[str, object] = {"row_count": batch.row_count}
         for f, c in zip(batch.schema.fields, batch.columns):
-            out[f.name] = c.data if c.data is not None else c
+            if c.data is not None:
+                out[f.name] = c.data
+            elif c.offsets is not None and c.chars is not None:
+                out[f.name + "__offsets"] = c.offsets
+                out[f.name + "__chars"] = c.chars
+            else:
+                raise TypeError(
+                    f"column {f.name} ({f.dtype.simple_string()}) has "
+                    "no flat device representation for columnar_rdd")
             out[f.name + "__valid"] = c.validity
         yield out
 

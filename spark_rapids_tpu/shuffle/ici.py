@@ -42,6 +42,21 @@ __all__ = ["make_ici_all_to_all", "make_ici_broadcast",
            "IciShuffleTransport", "ici_broadcast_batches"]
 
 
+
+def _axis_size(mesh: Mesh, axis) -> int:
+    """Device-group size for a single axis name or a TUPLE of axis
+    names (hierarchical meshes: e.g. ("dcn", "ici") = slices x chips —
+    the collective then spans slices over DCN exactly as it spans chips
+    over ICI, SURVEY.md §5.8/:201; XLA routes each hop over the
+    matching interconnect)."""
+    if isinstance(axis, (tuple, list)):
+        n = 1
+        for a in axis:
+            n *= mesh.shape[a]
+        return n
+    return mesh.shape[axis]
+
+
 def _local_exchange(ndev: int, axis: str, char_caps: Tuple[int, ...],
                     datas, valids, pids, live, char_offs, char_bytes):
     """Per-device body (runs under shard_map). datas: tuple of (cap,) or
@@ -128,7 +143,7 @@ def make_ici_all_to_all(mesh: Mesh, axis: str = "x"):
     char_offs[k] is (D, cap+1) offsets, char_bytes[k] (D, char_cap)
     bytes, char_caps[k] the static per-pair byte bucket; out_chars[k]
     is (D, D*CB) received payload chunks."""
-    ndev = mesh.shape[axis]
+    ndev = _axis_size(mesh, axis)
     cache: Dict[tuple, object] = {}
 
     def build(ndims: Tuple[int, ...], n_char: int,
@@ -182,7 +197,7 @@ def make_ici_broadcast(mesh: Mesh, axis: str = "x"):
     fn(datas, valids, live) with shapes (D, cap[, B]) returns
     (out_datas, out_valids, out_live) of shape (D, D*cap[, B]) where
     every device's shard holds the FULL gathered table."""
-    ndev = mesh.shape[axis]
+    ndev = _axis_size(mesh, axis)
     cache: Dict[Tuple[int, ...], object] = {}
 
     def build(ndims: Tuple[int, ...]):
@@ -263,6 +278,19 @@ def _lane_spec(schema):
     return lanes
 
 
+def _blocks_max_len(blocks, ci, path):
+    """Max live element/byte count of one var-width node across blocks
+    — the ONE sizing invariant both the broadcast matrix widths and the
+    all-to-all epoch caps derive from."""
+    w = jnp.int32(0)
+    for b in blocks:
+        c = _node_at(b.column(ci), path)
+        lens = c.offsets[1:] - c.offsets[:-1]
+        lens = jnp.where(b.live_mask(), lens, 0)
+        w = jnp.maximum(w, jnp.max(lens, initial=0))
+    return w
+
+
 def _discover_widths(blocks: List[TpuBatch], spec,
                      jit_cache: Dict[tuple, object]) -> Dict[tuple, int]:
     """Static matrix width per var-width node ((ci, path) keyed: max
@@ -278,16 +306,9 @@ def _discover_widths(blocks: List[TpuBatch], spec,
     fn = jit_cache.get(caps_key)
     if fn is None:
         def widths_fn(bs):
-            outs = []
-            for ci, path, _ in var_nodes:
-                w = jnp.int32(0)
-                for b in bs:
-                    c = _node_at(b.column(ci), path)
-                    lens = c.offsets[1:] - c.offsets[:-1]
-                    lens = jnp.where(b.live_mask(), lens, 0)
-                    w = jnp.maximum(w, jnp.max(lens, initial=0))
-                outs.append(w)
-            return jnp.stack(outs)
+            return jnp.stack([
+                _blocks_max_len(bs, ci, path)
+                for ci, path, _ in var_nodes])
         fn = jax.jit(widths_fn)
         jit_cache[caps_key] = fn
     vals = np.asarray(jax.device_get(fn(blocks)))
@@ -315,15 +336,8 @@ def _discover_epoch_caps(blocks, spec, ndev: int, fold: bool,
     fn = jit_cache.get(key)
     if fn is None:
         def caps_fn(bs):
-            outs = []
-            for ci, path in arr_nodes:
-                w = jnp.int32(0)
-                for b, _ in bs:
-                    c = _node_at(b.column(ci), path)
-                    lens = c.offsets[1:] - c.offsets[:-1]
-                    lens = jnp.where(b.live_mask(), lens, 0)
-                    w = jnp.maximum(w, jnp.max(lens, initial=0))
-                outs.append(w)
+            outs = [_blocks_max_len([b for b, _ in bs], ci, path)
+                    for ci, path in arr_nodes]
             for ci, path in str_nodes:
                 m = jnp.int32(0)
                 for b, pids in bs:
@@ -509,7 +523,7 @@ def ici_broadcast_batches(mesh: Mesh, batches: List[TpuBatch],
     lanes like the shuffle; one small per-epoch readback sizes the
     reassembled char buffers (the broadcast is a materialization point
     anyway)."""
-    ndev = mesh.shape[axis]
+    ndev = _axis_size(mesh, axis)
     bcast = make_ici_broadcast(mesh, axis)
     schema = batches[0].schema
     out: List[TpuBatch] = []
@@ -676,7 +690,7 @@ class IciShuffleTransport(ShuffleTransport):
         self.mesh = mesh
         self.axis = axis
         self.max_payload = (conf or RapidsConf()).get(ICI_MAX_PAYLOAD)
-        self.ndev = mesh.shape[axis]
+        self.ndev = _axis_size(mesh, axis)
         self._exchange = make_ici_all_to_all(mesh, axis)
         self._pending: Dict[int, List[Tuple[int, TpuBatch, object]]] = {}
         self._results: Dict[int, List[List[TpuBatch]]] = {}

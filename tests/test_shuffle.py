@@ -593,3 +593,45 @@ def test_ici_string_outlier_does_not_inflate_exchange():
             got += list(zip(tb.column("v").to_pylist(),
                             tb.column("s").to_pylist()))
     assert sorted(got) == sorted(zip(vals, strs))
+
+
+def test_ici_hierarchical_dcn_mesh():
+    """Cross-slice exchange (SURVEY.md §5.8/:201): the transport over a
+    2-D (dcn, ici) mesh — 2 'slices' x 4 chips — routes rows across
+    BOTH axes in one collective; XLA places the inter-slice hop on DCN
+    on real pods. Parity vs the same exchange on a flat 8-mesh."""
+    import pyarrow as pa
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from spark_rapids_tpu import datatypes as dt
+    from spark_rapids_tpu.columnar.arrow_bridge import (arrow_to_device,
+                                                        device_to_arrow)
+    from spark_rapids_tpu.shuffle.ici import IciShuffleTransport
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh2d = Mesh(devs, ("dcn", "ici"))
+    t = IciShuffleTransport(mesh2d, axis=("dcn", "ici"))
+    assert t.ndev == 8
+    n = 300
+    rng = np.random.default_rng(12)
+    vals = rng.integers(0, 1000, n).astype(np.int64)
+    strs = [f"row{v}" for v in vals]
+    rb = pa.record_batch({"v": pa.array(vals),
+                          "s": pa.array(strs, pa.string())})
+    b = arrow_to_device(rb)
+    pids = jnp.asarray((vals % 8).astype(np.int32))
+    t.register_shuffle(1, 8)
+    w = t.writer(1, 0)
+    w.write_unsplit(b, pids)
+    got = []
+    for p in range(8):
+        for ob in t.read_partition(1, p):
+            tb = device_to_arrow(ob)
+            rows = list(zip(tb.column("v").to_pylist(),
+                            tb.column("s").to_pylist()))
+            assert all(v % 8 == p for v, _ in rows)
+            got += rows
+    assert sorted(got) == sorted(zip(vals.tolist(), strs))
+    # stats ride the same epoch readback on the hierarchical mesh too
+    stats = t.partition_stats(1, free_only=True)
+    assert stats is not None and sum(1 for s in stats if s > 0) == 8
