@@ -80,15 +80,20 @@ def _lex_select(keys, a, b):
     return jnp.where(lt, b, a)
 
 
-def _sparse_argmin_query(keys, lo, hi, nonempty, cap: int):
+def _sparse_argmin_query(keys, lo, hi, nonempty, cap: int,
+                         max_len: Optional[int] = None):
     """Range lex-argmin over arbitrary per-row [lo, hi] spans: doubling
     tables T[k][i] = position of the lex-min in [i, i+2^k), answered by
     combining the two power-of-two covers [lo, lo+2^k) and
     [hi-2^k+1, hi] with k = floor(log2(len)). Empty frames yield the
-    sentinel in every lane (matching the windowed-gather path)."""
+    sentinel in every lane (matching the windowed-gather path).
+    `max_len` (rows frames: the static frame width) caps the table
+    depth — levels beyond floor(log2(max span)) are never queried."""
     pos0 = jnp.arange(cap, dtype=jnp.int32)
     levels = [pos0]
     K = max(1, math.ceil(math.log2(max(cap, 2))))
+    if max_len is not None:
+        K = min(K, max(1, math.ceil(math.log2(max(max_len, 2)))))
     for k in range(1, K + 1):
         half = 1 << (k - 1)
         prev = levels[-1]
@@ -246,22 +251,68 @@ class TpuWindowExec(UnaryExec):
             col = expr.eval_tpu(batch, ectx)
             return gather_column(col, perm, sorted_live)
 
+        def _range_literal_bound(delta, side):
+            """Frame bound for RANGE <delta> PRECEDING/FOLLOWING: a
+            compound (segment, null-region, orderable-value)
+            searchsorted — the order lane is ascending within each
+            segment by construction, so [v+lower, v+upper] maps to an
+            index span. NULL order values are their own peer group
+            (Spark: a null row's frame is exactly the null rows): they
+            occupy a separate compound band matching their sort
+            placement, and null rows take their PEER bounds (device
+            support gated by tpu_supported to one ascending <=32-bit
+            order key)."""
+            from ..ops.sort_keys import orderable_int
+            ok_col = okeys[0]
+            t = ok_col.dtype
+            sval = ok_col.data[perm]
+            snull = ~ok_col.validity[perm]
+            nulls_first = self.orders[0].nulls_first
+            ones = jnp.ones((cap,), jnp.bool_)
+            BIAS = jnp.int64(1) << 31
+
+            def enc32(vals):
+                col = TpuColumnVector(t, data=vals, validity=ones)
+                return orderable_int(col).astype(jnp.int64) + BIAS
+            # region bit: matches where the sort placed the nulls
+            val_region = jnp.int64(1 if nulls_first else 0)
+            region = jnp.where(snull, jnp.int64(1) - val_region,
+                               val_region)
+            base = seg_start.astype(jnp.int64) << jnp.int64(33)
+            comp = jnp.where(
+                sorted_live,
+                base + (region << jnp.int64(32)) + enc32(sval),
+                jnp.int64(0x7FFFFFFFFFFFFFFF))
+            if dt.is_floating(t):
+                tv = (sval + jnp.asarray(delta, t.np_dtype))
+            else:
+                info = jnp.iinfo(t.np_dtype)
+                tv = jnp.clip(sval.astype(jnp.int64) + int(delta),
+                              info.min, info.max).astype(t.np_dtype)
+            q = base + (val_region << jnp.int64(32)) + enc32(tv)
+            if side == "lo":
+                b = jnp.searchsorted(comp, q, side="left") \
+                    .astype(jnp.int32)
+                return jnp.where(snull, peer_start, b)
+            b = (jnp.searchsorted(comp, q, side="right") - 1) \
+                .astype(jnp.int32)
+            return jnp.where(snull, peer_end, b)
+
         def frame_bounds(fr):
             if fr.frame_type == "rows":
                 lo = seg_start if fr.lower is None \
                     else jnp.maximum(seg_start, pos + fr.lower)
                 hi = seg_end if fr.upper is None \
                     else jnp.minimum(seg_end, pos + fr.upper)
-            else:  # range: peers at CURRENT ROW bounds (offsets -> CPU)
-                if fr.lower not in (None, 0) or fr.upper not in (None, 0):
-                    # defend in depth: the planner gates this via
-                    # tpu_supported; a direct execute must fail loudly,
-                    # not silently return peer-group results
-                    raise NotImplementedError(
-                        "RANGE frame with literal offsets has no device "
-                        "path (CPU oracle only)")
-                lo = seg_start if fr.lower is None else peer_start
-                hi = seg_end if fr.upper is None else peer_end
+            else:  # range: value-offset bounds (0 = the peer group)
+                lo = (seg_start if fr.lower is None else
+                      peer_start if fr.lower == 0 else
+                      jnp.maximum(seg_start,
+                                  _range_literal_bound(fr.lower, "lo")))
+                hi = (seg_end if fr.upper is None else
+                      peer_end if fr.upper == 0 else
+                      jnp.minimum(seg_end,
+                                  _range_literal_bound(fr.upper, "hi")))
             return lo, hi
 
         def prefix_frame(contrib, lo, hi, empty):
@@ -287,15 +338,19 @@ class TpuWindowExec(UnaryExec):
             loc = jnp.clip(lo, 0, cap - 1)
             hic = jnp.clip(hi, 0, cap - 1)
             if fr.frame_type == "range":
-                if fr.lower is None:  # [seg_start, hi]
+                if fr.lower is None:  # [seg_start, hi] — any hi
                     res = _argmin_scan(keys, part_flag)
                     return tuple(r[hic] for r in res)
-                if fr.upper is None:  # [peer_start, seg_end]
+                if fr.upper is None:  # [lo, seg_end] — any lo
                     res = _argmin_scan(keys, end_flag, reverse=True)
                     return tuple(r[loc] for r in res)
-                # (0, 0): the peer group
-                res = _argmin_scan(keys, peer_flag)
-                return tuple(r[hic] for r in res)
+                if fr.lower == 0 and fr.upper == 0:  # the peer group
+                    res = _argmin_scan(keys, peer_flag)
+                    return tuple(r[hic] for r in res)
+                # literal value offsets: arbitrary per-row spans — the
+                # sparse-table range-argmin serves them directly
+                return _sparse_argmin_query(keys, loc, hic, hi >= lo,
+                                            cap)
             if fr.lower is None:
                 res = _argmin_scan(keys, part_flag)
                 return tuple(r[hic] for r in res)
@@ -323,7 +378,8 @@ class TpuWindowExec(UnaryExec):
             # tables of lex-argmin POSITIONS, then every row's frame is
             # the combine of two overlapping power-of-two covers. O(n
             # log w) build, O(n) query, no (n, w) materialization.
-            return _sparse_argmin_query(keys, loc, hic, hi >= lo, cap)
+            return _sparse_argmin_query(keys, loc, hic, hi >= lo, cap,
+                                        max_len=w)
 
         win_cols: List[TpuColumnVector] = []
         for we in self.win_exprs:

@@ -265,8 +265,34 @@ class WindowExpression(Expression):
             bounded = [v for v in (fr.lower, fr.upper)
                        if v is not None and v != 0]
             if bounded:
-                return ("RANGE frame with literal offsets not on device "
-                        "(CPU oracle only)")
+                # literal value offsets map to index spans via a
+                # compound (segment << 32 | orderable) searchsorted
+                # (exec/window.py _range_literal_bound) — which needs
+                # ONE ascending non-null order key whose orderable lane
+                # fits 32 bits
+                if self._n_order != 1:
+                    return ("RANGE literal offsets need exactly one "
+                            "order key on device")
+                if not self.order_specs[0][0]:
+                    return ("RANGE literal offsets over a descending "
+                            "order key not on device")
+                okey = self.children[1 + self._n_part]
+                ot = okey.dtype
+                np_d = ot.np_dtype
+                import numpy as _np
+                # floats excluded: the device would add the offset in
+                # the key dtype while the oracle/Spark compute in
+                # float64, so boundary rows one ulp from the edge could
+                # disagree (code-review r5)
+                ok32 = np_d is not None and not dt.is_nested(ot) \
+                    and _np.dtype(np_d).itemsize <= 4 \
+                    and not isinstance(ot, dt.BooleanType) \
+                    and not dt.is_floating(ot)
+                if not ok32:
+                    return (f"RANGE literal offsets over "
+                            f"{ot.simple_string()} not on device "
+                            "(needs a <= 32-bit integer/date order "
+                            "lane)")
         # bounded rows frames of ANY width run on device since round 5:
         # narrow frames use the (n, width) windowed gather, wider ones
         # the log-depth sparse-table range-argmin (exec/window.py

@@ -310,7 +310,7 @@ def _decode_hive_text(path: str, columns, batch_rows: int,
             ch = tok[i]
             if ch == "\\" and i + 1 < len(tok):
                 nxt = tok[i + 1]
-                out.append("\n" if nxt == "n" else nxt)
+                out.append({"n": "\n", "r": "\r"}.get(nxt, nxt))
                 i += 2
             else:
                 out.append(ch)
@@ -350,10 +350,19 @@ def _decode_hive_text(path: str, columns, batch_rows: int,
                 import datetime as _dtm
                 y, m, d = v.split("-")
                 return _dtm.date(int(y), int(m), int(d))
+            if isinstance(f.dtype, dt.TimestampType):
+                import datetime as _dtm
+                ts = _dtm.datetime.fromisoformat(v)
+                if ts.tzinfo is None:
+                    ts = ts.replace(tzinfo=_dtm.timezone.utc)
+                return ts
+            if isinstance(f.dtype, dt.DecimalType):
+                import decimal as _dec
+                return _dec.Decimal(v)
             if isinstance(f.dtype, dt.BinaryType):
                 import base64
                 return base64.b64decode(v)  # Hive Base64 binary
-        except (ValueError, TypeError):
+        except (ValueError, TypeError, ArithmeticError):
             return None
         return v  # strings
 
@@ -374,7 +383,9 @@ def _decode_hive_text(path: str, columns, batch_rows: int,
         out.append(pa.RecordBatch.from_arrays(arrays, names=names))
         rows.clear()
 
-    with open(path, encoding="utf-8") as fh:
+    # newline="\n": universal-newline mode would split rows at bare \r
+    # inside escaped string fields
+    with open(path, encoding="utf-8", newline="\n") as fh:
         for line in fh:
             rows.append(split_row(line.rstrip("\n")))
             if len(rows) >= batch_rows:

@@ -73,7 +73,8 @@ def _write_hive_text(table: pa.Table, path: str):
                 elif isinstance(v, str):
                     fields.append(v.replace("\\", "\\\\")
                                   .replace("\x01", "\\\x01")
-                                  .replace("\n", "\\n"))
+                                  .replace("\n", "\\n")
+                                  .replace("\r", "\\r"))
                 else:
                     fields.append(str(v))
             f.write("\x01".join(fields) + "\n")

@@ -405,3 +405,34 @@ def test_hive_text_binary_base64(tmp_path):
     back = assert_tpu_and_cpu_plan_equal(scan)
     assert back.column("bin").to_pylist() == [b"ab\x01c", None,
                                               b"\\x\nraw"]
+
+
+def test_hive_text_cr_decimal_timestamp(tmp_path):
+    """\\r in strings must not split rows, and decimal/timestamp
+    columns round-trip via their text forms (code-review r5)."""
+    import datetime as dtm
+    import decimal
+    from spark_rapids_tpu.io.write import TpuFileWriteExec
+    from spark_rapids_tpu.exec.base import HostBatchSourceExec
+    from spark_rapids_tpu.columnar.arrow_bridge import engine_schema
+    utc = dtm.timezone.utc
+    rb = pa.record_batch({
+        "i": pa.array([1, 2], pa.int64()),
+        "s": pa.array(["a\rb", "win\r\nline"]),
+        "dec": pa.array([decimal.Decimal("1.50"), None],
+                        pa.decimal128(10, 2)),
+        "ts": pa.array([dtm.datetime(2021, 3, 5, 12, 0, 1, 250000,
+                                     tzinfo=utc), None],
+                       pa.timestamp("us", tz="UTC")),
+    })
+    out_dir = os.path.join(str(tmp_path), "htc")
+    w = TpuFileWriteExec(HostBatchSourceExec([rb]), out_dir,
+                         fmt="hivetext")
+    list(w.execute(ExecCtx()))
+    scan = TpuFileScanExec(w.written_files, fmt="hivetext",
+                           schema=engine_schema(rb.schema))
+    back = assert_tpu_and_cpu_plan_equal(scan)
+    assert back.num_rows == 2
+    assert back.column("s").to_pylist() == ["a\rb", "win\r\nline"]
+    assert back.column("dec").to_pylist() == [decimal.Decimal("1.50"),
+                                              None]

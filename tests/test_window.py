@@ -212,10 +212,20 @@ def test_planner_window_on_device():
     _planner_dual_run(plan, expect_fallback=False)
 
 
-def test_planner_range_offset_falls_back():
-    # RANGE with literal offsets: CPU oracle only
+def test_planner_range_offset_on_device_now():
+    # RANGE with literal offsets runs on device since round 5 (the
+    # compound-searchsorted bounds); the old CPU-only gate is gone
     plan = TpuWindowExec(
         [win(Sum(col("c2")), WindowFrame("range", -5, 5))],
+        part_order_source(n=80))
+    _planner_dual_run(plan, expect_fallback=False)
+
+
+def test_planner_range_offset_64bit_key_falls_back():
+    # ...but a 64-bit order key exceeds the 32-bit compound lane
+    plan = TpuWindowExec(
+        [win(Sum(col("c1")), WindowFrame("range", -5, 5),
+             order=("c2",))],
         part_order_source(n=80))
     _planner_dual_run(plan, expect_fallback=True)
 
@@ -277,3 +287,62 @@ def test_wide_bounded_minmax_frame_on_device():
     pp = TpuOverrides().apply(plan)
     assert not pp.fallback_nodes(), pp.explain("NOT_ON_GPU")
     assert_tpu_and_cpu_plan_equal(plan, ignore_order=True)
+
+
+def test_range_frame_literal_offsets_on_device():
+    """RANGE BETWEEN x PRECEDING AND y FOLLOWING over a numeric order
+    key runs on device now (compound searchsorted bounds + sparse-table
+    argmin): sum/count/min/max dual-run vs the oracle."""
+    from spark_rapids_tpu.expr.window import (WindowExpression,
+                                              WindowFrame)
+    from spark_rapids_tpu.expr.aggregates import Count, Max, Min, Sum
+    rbs = [gen_table([IntegerGen(min_val=0, max_val=3, nullable=False),
+                      IntegerGen(min_val=0, max_val=500,
+                                 null_frac=0.08),  # null order keys:
+                      # a null row's frame is its null peers (Spark)
+                      LongGen(null_frac=0.1)],
+                     1200, seed=31, names=["p", "o", "v"])]
+    for lo, hi in [(-25, 25), (-100, 0), (0, 40), (-7, -2), (3, 9),
+                   (None, 30), (-30, None)]:
+        frame = WindowFrame("range", lo, hi)
+        exprs = [
+            Alias(WindowExpression(Sum(col("v")), [col("p")],
+                                   [SortOrder(col("o"))], frame), "s"),
+            Alias(WindowExpression(Count(col("v")), [col("p")],
+                                   [SortOrder(col("o"))], frame), "c"),
+            Alias(WindowExpression(Min(col("v")), [col("p")],
+                                   [SortOrder(col("o"))], frame), "mn"),
+            Alias(WindowExpression(Max(col("v")), [col("p")],
+                                   [SortOrder(col("o"))], frame), "mx"),
+        ]
+        plan = TpuWindowExec(exprs, HostBatchSourceExec(rbs))
+        from spark_rapids_tpu.planner import TpuOverrides
+        pp = TpuOverrides().apply(plan)
+        assert not pp.fallback_nodes(), (lo, hi,
+                                         pp.explain("NOT_ON_GPU"))
+        assert_tpu_and_cpu_plan_equal(plan, ignore_order=True,
+                                      label=f"range[{lo},{hi}]")
+
+
+def test_range_frame_literal_offsets_gates():
+    """Unsupported shapes (descending/nullable/64-bit keys) fall back
+    with reasons and stay correct via the oracle."""
+    from spark_rapids_tpu.expr.window import (WindowExpression,
+                                              WindowFrame)
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.planner import TpuOverrides
+    frame = WindowFrame("range", -5, 5)
+    rbs = [gen_table([IntegerGen(min_val=0, max_val=2, null_frac=0),
+                      LongGen(null_frac=0), LongGen(null_frac=0.1)],
+                     300, seed=5, names=["p", "o64", "v"])]
+    plan = TpuWindowExec(
+        [Alias(WindowExpression(Sum(col("v")), [col("p")],
+                                [SortOrder(col("o64"))], frame), "s")],
+        HostBatchSourceExec(rbs))
+    pp = TpuOverrides().apply(plan)
+    assert pp.fallback_nodes()
+    # the planner-placed (CPU) execution still answers like the oracle
+    from spark_rapids_tpu.exec.base import collect_arrow_cpu
+    got = pp.collect().to_pydict()
+    want = collect_arrow_cpu(plan).to_pydict()
+    assert got == want
