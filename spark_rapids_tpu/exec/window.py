@@ -41,7 +41,8 @@ from .. import datatypes as dt
 from ..columnar.arrow_bridge import arrow_schema
 from ..columnar.batch import TpuBatch
 from ..columnar.column import TpuColumnVector
-from ..expr.aggregates import (AggregateFunction, Average, Count, Max, Min,
+from ..expr.aggregates import (AggregateFunction, Average, Count,
+                               _CentralMoment, Max, Min,
                                Sum, _FirstLast)
 from ..expr.base import Alias, Expression, bind_expr
 from ..expr.window import (MAX_GATHER_FRAME, DenseRank, Lag, Lead, NTile,
@@ -263,8 +264,20 @@ class TpuWindowExec(UnaryExec):
             support gated by tpu_supported to one ascending <=32-bit
             order key)."""
             from ..ops.sort_keys import orderable_int
+            import numpy as _np
+            # defend in depth: the planner gates these shapes via
+            # tpu_supported, but a DIRECT execute must fail loudly —
+            # a descending or 64-bit/float order lane would corrupt
+            # the compound key and silently return wrong frames
             ok_col = okeys[0]
             t = ok_col.dtype
+            if len(okeys) != 1 or not self.orders[0].ascending \
+                    or t.np_dtype is None \
+                    or _np.dtype(t.np_dtype).itemsize > 4 \
+                    or dt.is_floating(t):
+                raise NotImplementedError(
+                    "RANGE literal offsets need one ascending <=32-bit "
+                    "integer/date order key on device")
             sval = ok_col.data[perm]
             snull = ~ok_col.validity[perm]
             nulls_first = self.orders[0].nulls_first
@@ -283,12 +296,11 @@ class TpuWindowExec(UnaryExec):
                 sorted_live,
                 base + (region << jnp.int64(32)) + enc32(sval),
                 jnp.int64(0x7FFFFFFFFFFFFFFF))
-            if dt.is_floating(t):
-                tv = (sval + jnp.asarray(delta, t.np_dtype))
-            else:
-                info = jnp.iinfo(t.np_dtype)
-                tv = jnp.clip(sval.astype(jnp.int64) + int(delta),
-                              info.min, info.max).astype(t.np_dtype)
+            # only integer/date lanes reach here (the guard above
+            # rejects floats): saturating integer offset arithmetic
+            info = jnp.iinfo(t.np_dtype)
+            tv = jnp.clip(sval.astype(jnp.int64) + int(delta),
+                          info.min, info.max).astype(t.np_dtype)
             q = base + (val_region << jnp.int64(32)) + enc32(tv)
             if side == "lo":
                 b = jnp.searchsorted(comp, q, side="left") \
@@ -498,6 +510,66 @@ class TpuWindowExec(UnaryExec):
                     den = jnp.where(cnt > 0, cnt, 1).astype(jnp.float64)
                     win_cols.append(TpuColumnVector(
                         dt.FLOAT64, data=s / den, validity=ok))
+                continue
+            if isinstance(f, _CentralMoment):
+                # stddev/variance over any frame: Σx, Σx² and count via
+                # the same prefix machinery (round 5: the gate is
+                # gone). Any non-finite value poisons its frames to NaN
+                # — matching the exact-oracle outcome ((inf-inf)² =
+                # NaN inside the two-pass). Sum-of-squares carries mild
+                # cancellation vs the oracle's two-pass; dual-runs
+                # compare approximately like all float aggregates.
+                vcol = sgather(f.children[0])
+                valid = vcol.validity & sorted_live
+                d = vcol.data.astype(jnp.float64)
+                finite = jnp.isfinite(d)
+                fin = jnp.where(valid & finite, d, 0.0)
+                # center by the per-SEGMENT mean before squaring (the
+                # same trick the group-by _CentralMoment uses): frame
+                # variance is shift-invariant, and centered values keep
+                # the sum-of-squares from catastrophic cancellation at
+                # large means (and from overflowing for |x| ~ 1e154)
+                # NOTE: the segment totals must NOT be masked by the
+                # per-row FRAME emptiness — a row with an empty frame
+                # still contributes to other rows' frames, and a mixed
+                # per-row shift would break the shift invariance
+                never = jnp.zeros_like(empty)
+                seg_cnt = prefix_frame(valid.astype(_I64), seg_start,
+                                       seg_end, never) \
+                    .astype(jnp.float64)
+                seg_sum = prefix_frame(fin, seg_start, seg_end, never)
+                mu_seg = seg_sum / jnp.where(seg_cnt > 0, seg_cnt, 1.0)
+                dev = jnp.where(valid & finite, d - mu_seg, 0.0)
+                s = prefix_frame(dev, lo, hi, empty)
+                s2 = prefix_frame(dev * dev, lo, hi, empty)
+                cnt = prefix_frame(valid.astype(_I64), lo, hi, empty) \
+                    .astype(jnp.float64)
+                bad = prefix_frame((valid & ~finite).astype(_I64), lo,
+                                   hi, empty)
+                mean = s / jnp.where(cnt > 0, cnt, 1.0)
+                m2 = jnp.maximum(s2 - s * mean, 0.0)
+                # prefix-difference extraction carries ~eps x (segment
+                # cumulative energy) of noise; an m2 below that floor
+                # is indistinguishable from 0 — snap it so equal-value
+                # frames report variance 0.0 exactly like the oracle
+                # threshold ~= a couple dozen ulps of the segment
+                # energy — the actual prefix-difference noise floor; a
+                # looser bound would zero GENUINE small variances in
+                # high-energy segments (one huge outlier plus a
+                # flat frame elsewhere)
+                seg_s2 = prefix_frame(dev * dev, seg_start, seg_end,
+                                      never)
+                m2 = jnp.where(m2 <= 4e-15 * seg_s2, 0.0, m2)
+                if f.sample:
+                    var = m2 / jnp.where(cnt > 1, cnt - 1.0, 1.0)
+                    ok = (cnt > 1) & ~empty & sorted_live
+                else:
+                    var = m2 / jnp.where(cnt > 0, cnt, 1.0)
+                    ok = (cnt > 0) & ~empty & sorted_live
+                outv = jnp.sqrt(var) if f.take_sqrt else var
+                outv = jnp.where(bad > 0, jnp.nan, outv)
+                win_cols.append(TpuColumnVector(
+                    dt.FLOAT64, data=outv, validity=ok))
                 continue
             if isinstance(f, (Min, Max)):
                 vcol = sgather(f.children[0])

@@ -18,7 +18,7 @@ from typing import List, Optional, Sequence, Tuple
 
 from .. import datatypes as dt
 from .aggregates import (AggregateFunction, Average, Count, First, Last,
-                         Max, Min, Sum)
+                         Max, Min, Sum, _CentralMoment)
 from .base import Expression, Literal
 
 __all__ = ["WindowFrame", "WindowExpression", "WindowFunction",
@@ -171,8 +171,9 @@ class Lead(_OffsetFunction):
 
 
 # aggregates with a device window path (exec/window.py kernels); others
-# (stddev/variance/collect_*) run through the CPU oracle via fallback
-_DEVICE_WINDOW_AGGS = (Sum, Count, Min, Max, Average, First, Last)
+# (collect_*) run through the CPU oracle via fallback
+_DEVICE_WINDOW_AGGS = (Sum, Count, Min, Max, Average, First, Last,
+                       _CentralMoment)
 
 
 class WindowExpression(Expression):
@@ -255,9 +256,10 @@ class WindowExpression(Expression):
                 and not isinstance(f, _DEVICE_WINDOW_AGGS):
             return (f"window aggregate {f.pretty_name()} not on device "
                     f"(CPU oracle only)")
-        if isinstance(f, Average) \
+        if isinstance(f, (Average, _CentralMoment)) \
                 and isinstance(f.children[0].dtype, dt.DecimalType):
-            return "decimal average over window not on device"
+            return (f"decimal {f.pretty_name().lower()} over window "
+                    "not on device")
         if isinstance(f, _OffsetFunction) and f.default is not None \
                 and f.dtype.is_variable_width:
             return "lag/lead default over strings not on device"

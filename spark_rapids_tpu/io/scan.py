@@ -384,10 +384,16 @@ def _decode_hive_text(path: str, columns, batch_rows: int,
         rows.clear()
 
     # newline="\n": universal-newline mode would split rows at bare \r
-    # inside escaped string fields
+    # inside escaped string fields. CRLF-terminated files (externally
+    # produced) still parse: one trailing \r is part of the terminator,
+    # never field data (the writer escapes in-field \r)
     with open(path, encoding="utf-8", newline="\n") as fh:
         for line in fh:
-            rows.append(split_row(line.rstrip("\n")))
+            if line.endswith("\r\n"):
+                line = line[:-2]
+            elif line.endswith("\n") or line.endswith("\r"):
+                line = line[:-1]
+            rows.append(split_row(line))
             if len(rows) >= batch_rows:
                 flush()
     flush()

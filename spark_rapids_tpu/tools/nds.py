@@ -462,6 +462,102 @@ def q_price_band_pd(pd, t):
     return g.sort_values("band")
 
 
+def q_rank_in_category(session, t):
+    """Windowed-rank shape (q67-like): top-3 brands per category by
+    revenue — group-by -> RANK() OVER (PARTITION BY category ORDER BY
+    revenue DESC) -> filter rank <= 3 (exercises the device window
+    machine inside a corpus query)."""
+    from ..exec.sort import SortOrder
+    from ..exec.window import TpuWindowExec
+    from ..expr import Rank, WindowExpression
+    from ..expr.aggregates import Sum
+    from ..expr.predicates import LessThanOrEqual
+    from ..expr.base import Literal
+    from ..session import DataFrame
+    from .. import datatypes as dt
+    f = _frames(session, t)
+    base = (f["store_sales"]
+            .join(f["item"], on=[("ss_item_sk", "i_item_sk")],
+                  build_unique=True)
+            .group_by("i_category", "i_brand_id")
+            .agg(_alias(Sum(_col("ss_ext_sales_price")), "rev")))
+    win = TpuWindowExec(
+        [_alias(WindowExpression(
+            Rank(), [_col("i_category")],
+            [SortOrder(_col("rev"), ascending=False),
+             SortOrder(_col("i_brand_id"))]), "rk")],
+        base._node)
+    return (DataFrame(win, session)
+            .filter(LessThanOrEqual(_col("rk"), Literal(3, dt.INT32)))
+            .order_by("i_category", "rk", "i_brand_id"))
+
+
+def q_rank_in_category_pd(pd, t):
+    ss, it = t["store_sales"], t["item"]
+    j = ss.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    g = j.groupby(["i_category", "i_brand_id"], as_index=False) \
+        .agg(rev=("ss_ext_sales_price", "sum"))
+    g = g.sort_values(["i_category", "rev", "i_brand_id"],
+                      ascending=[True, False, True])
+    # the engine ranks over the compound (rev DESC, brand ASC) key,
+    # and (category, brand) is the group key, so ranks are distinct:
+    # cumcount matches exactly even under revenue ties
+    g["rk"] = (g.groupby("i_category").cumcount() + 1).astype("int32")
+    g = g[g["rk"] <= 3]
+    return g.sort_values(["i_category", "rk", "i_brand_id"]).reset_index(
+        drop=True)
+
+
+def q_rolling_revenue(session, t):
+    """Rolling-window shape: per-store daily revenue with a trailing
+    7-day RANGE average (exercises the round-5 literal-offset range
+    frames inside a corpus query)."""
+    from ..exec.sort import SortOrder
+    from ..exec.window import TpuWindowExec
+    from ..expr import WindowExpression, WindowFrame
+    from ..expr.aggregates import Average, Sum
+    from ..session import DataFrame
+    from ..expr import Cast
+    from .. import datatypes as dt
+    f = _frames(session, t)
+    daily = (f["store_sales"]
+             .group_by("ss_store_sk", "ss_sold_date_sk")
+             .agg(_alias(Sum(_col("ss_ext_sales_price")), "rev"))
+             # the device range-frame path wants a <= 32-bit order
+             # lane; date surrogate keys fit int32
+             .with_column("d32", Cast(_col("ss_sold_date_sk"),
+                                      dt.INT32)))
+    win = TpuWindowExec(
+        [_alias(WindowExpression(
+            Average(_col("rev")), [_col("ss_store_sk")],
+            [SortOrder(_col("d32"))],
+            WindowFrame("range", -6, 0)), "avg7")],
+        daily._node)
+    return (DataFrame(win, session)
+            .select(_col("ss_store_sk"), _col("ss_sold_date_sk"),
+                    _col("rev"), _col("avg7"))
+            .order_by("ss_store_sk", "ss_sold_date_sk"))
+
+
+def q_rolling_revenue_pd(pd, t):
+    ss = t["store_sales"]
+    g = ss.groupby(["ss_store_sk", "ss_sold_date_sk"],
+                   as_index=False).agg(rev=("ss_ext_sales_price", "sum"))
+
+    def roll(sub):
+        sub = sub.sort_values("ss_sold_date_sk").reset_index(drop=True)
+        d = sub["ss_sold_date_sk"].to_numpy()
+        r = sub["rev"].to_numpy()
+        out = [r[(d >= d[i] - 6) & (d <= d[i])].mean()
+               for i in range(len(sub))]
+        sub["avg7"] = out
+        return sub
+    g = g.groupby("ss_store_sk", group_keys=False)[
+        ["ss_store_sk", "ss_sold_date_sk", "rev"]].apply(roll)
+    return g.sort_values(["ss_store_sk", "ss_sold_date_sk"]) \
+        .reset_index(drop=True)
+
+
 QUERIES = {
     "q3": (q3, q3_pd), "q42": (q42, q42_pd), "q55": (q55, q55_pd),
     "q7": (q7, q7_pd), "q96": (q96, q96_pd), "q97": (q97, q97_pd),
@@ -471,6 +567,8 @@ QUERIES = {
     "q_customer_age": (q_customer_age, q_customer_age_pd),
     "q_topn": (q_topn_profit, q_topn_profit_pd),
     "q_price_band": (q_price_band, q_price_band_pd),
+    "q_rank": (q_rank_in_category, q_rank_in_category_pd),
+    "q_rolling": (q_rolling_revenue, q_rolling_revenue_pd),
 }
 
 

@@ -436,3 +436,18 @@ def test_hive_text_cr_decimal_timestamp(tmp_path):
     assert back.column("s").to_pylist() == ["a\rb", "win\r\nline"]
     assert back.column("dec").to_pylist() == [decimal.Decimal("1.50"),
                                               None]
+
+
+def test_hive_text_crlf_external_file(tmp_path):
+    """CRLF-terminated files (external writers) parse without trailing
+    \\r leaking into the last field (code-review r5)."""
+    from spark_rapids_tpu.columnar.arrow_bridge import engine_schema
+    p = os.path.join(str(tmp_path), "crlf.txt")
+    with open(p, "wb") as f:
+        f.write(b"1\x01alpha\r\n2\x01beta\r\n\\N\x01\\N\r\n")
+    schema = engine_schema(pa.schema([("i", pa.int64()),
+                                      ("s", pa.string())]))
+    scan = TpuFileScanExec([p], fmt="hivetext", schema=schema)
+    back = assert_tpu_and_cpu_plan_equal(scan)
+    assert back.column("i").to_pylist() == [1, 2, None]
+    assert back.column("s").to_pylist() == ["alpha", "beta", None]

@@ -230,10 +230,43 @@ def test_planner_range_offset_64bit_key_falls_back():
     _planner_dual_run(plan, expect_fallback=True)
 
 
-def test_planner_stddev_window_falls_back():
+def test_planner_stddev_window_on_device_now():
+    # stddev/variance over windows run on device since round 5; the
+    # sum-of-squares path differs from the two-pass oracle by ulps,
+    # so compare approximately (like all float window aggregates)
+    import numpy as np
+    from spark_rapids_tpu.planner import TpuOverrides
+    from spark_rapids_tpu.exec.base import ExecCtx, collect_arrow_cpu
     plan = TpuWindowExec([win(StddevSamp(col("c2")))],
                          part_order_source(n=80))
-    _planner_dual_run(plan, expect_fallback=True)
+    pp = TpuOverrides().apply(plan)
+    assert not pp.fallback_nodes(), pp.explain("NOT_ON_GPU")
+    got = pp.collect().to_pandas()
+    want = collect_arrow_cpu(plan, ExecCtx()).to_pandas()
+    for c in got.columns:
+        g, w = got[c].to_numpy(), want[c].to_numpy()
+        if np.issubdtype(np.asarray(w).dtype, np.floating):
+            assert np.allclose(g.astype(float), w.astype(float),
+                               rtol=1e-9, equal_nan=True), c
+        else:
+            gn, wn = got[c].isna().to_numpy(), want[c].isna().to_numpy()
+            assert (gn == wn).all(), c
+            assert (g[~gn] == w[~wn]).all(), c
+
+
+def test_stddev_variance_window_frames():
+    from spark_rapids_tpu.expr.aggregates import (StddevPop, StddevSamp,
+                                                  VariancePop,
+                                                  VarianceSamp)
+    for frame in (None, WindowFrame("rows", -3, 3),
+                  WindowFrame("rows", None, 0),
+                  WindowFrame("rows", 2, 5),   # empty near segment end
+                  WindowFrame("range", 0, 0)):
+        for cls in (StddevSamp, StddevPop, VarianceSamp, VariancePop):
+            plan = TpuWindowExec([win(cls(col("c2")), frame)],
+                                 part_order_source(n=160))
+            assert_tpu_and_cpu_plan_equal(plan, approx_float=True,
+                                          label=f"{cls.__name__}")
 
 
 def test_window_out_of_core_bucketed():
