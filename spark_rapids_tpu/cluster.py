@@ -14,14 +14,19 @@ Execution model (Spark's, §2.6 data parallelism):
   - a map stage ships each worker a pickled plan slice (a partition of
     the stage's leaf input) + the exchange's Partitioning; workers
     execute on their own device runtime and write per-(map, partition)
-    Arrow IPC files via `HostShuffleTransport`;
+    Arrow IPC files via `HostShuffleTransport`, staged per attempt and
+    atomically committed (first commit wins — see shuffle/host.py);
   - the next stage's plan reads those files through
     `ProcessShuffleReadExec` (each worker owns a partition range);
   - the final stage's per-partition results concatenate on the driver.
 
-Scheduling/rendezvous is filesystem-based (task pickles + done/err
-markers) — no sockets to configure, matching how Spark's shuffle files
-need only shared storage. Task pickles carry only plan structure (plans
+Scheduling/rendezvous is filesystem-based (task pickles + claim/done/err
+markers + heartbeat files) — no sockets to configure, matching how
+Spark's shuffle files need only shared storage. Fault tolerance lives in
+`scheduler/task_scheduler.py` (the TaskSetManager analog): failed tasks
+retry on other workers, dead/wedged workers are detected via process
+polls + heartbeat staleness and respawned, stragglers optionally get
+speculative duplicates. Task pickles carry only plan structure (plans
 are pickled BEFORE any execution, so jit caches are empty).
 """
 from __future__ import annotations
@@ -32,6 +37,7 @@ import pickle
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 import traceback
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -39,8 +45,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import pyarrow as pa
 
 from . import datatypes as dt
-from .config import RapidsConf
+from .config import HEARTBEAT_INTERVAL, INJECT_FAULTS, RapidsConf
 from .exec.base import ExecCtx, LeafExec, TpuExec
+from .scheduler import TaskScheduler, TaskSpec
 
 __all__ = ["TpuProcessCluster", "ProcessShuffleReadExec",
            "run_process_query"]
@@ -49,7 +56,10 @@ __all__ = ["TpuProcessCluster", "ProcessShuffleReadExec",
 class ProcessShuffleReadExec(LeafExec):
     """Reduce-side leaf: streams the Arrow-IPC partition files a map
     stage wrote (the RapidsCachingReader / shuffle-fetch analog for the
-    file transport — SURVEY.md §2.2-D)."""
+    file transport — SURVEY.md §2.2-D). Only COMMITTED attempt output is
+    visible: map tasks write into per-attempt staging dirs and publish
+    with one atomic rename, so a zombie attempt racing its retry can
+    never interleave files here."""
 
     def __init__(self, shuffle_root: str, shuffle_id: int,
                  partitions: Sequence[int], schema: dt.Schema):
@@ -71,12 +81,9 @@ class ProcessShuffleReadExec(LeafExec):
         return None
 
     def _files(self, pid: int) -> List[str]:
+        from .shuffle.host import HostShuffleTransport
         d = os.path.join(self.shuffle_root, f"s{self.shuffle_id}")
-        if not os.path.isdir(d):
-            return []
-        suffix = f"_p{pid}.arrow"
-        return [os.path.join(d, n) for n in sorted(os.listdir(d))
-                if n.endswith(suffix)]
+        return HostShuffleTransport.committed_partition_files(d, pid)
 
     def _host_batches(self):
         for pid in self.partitions:
@@ -100,8 +107,10 @@ class ProcessShuffleReadExec(LeafExec):
 
 def _run_map_task(payload: Dict) -> None:
     """Execute a map plan slice and write its partitions as Arrow IPC
-    files (HostShuffleTransport is the writer; batch i of this slice is
-    map id base+i so multi-batch slices never collide)."""
+    files into an attempt-private staging dir, then commit atomically
+    (HostShuffleTransport is the writer; batch i of this slice is map id
+    base+i so multi-batch slices never collide). Losing the commit race
+    to a sibling attempt is SUCCESS: the winner's output is complete."""
     from .shuffle.host import HostShuffleTransport
     conf = RapidsConf(payload["conf"])
     plan: TpuExec = payload["plan"]
@@ -109,19 +118,29 @@ def _run_map_task(payload: Dict) -> None:
     transport = HostShuffleTransport(conf, threads=0,
                                      root=payload["shuffle_root"])
     sid = payload["shuffle_id"]
+    task_key = payload.get("task_id", f"m{payload['map_id_base']}")
+    attempt = payload.get("attempt", 0)
     transport.register_shuffle(sid, partitioning.num_partitions)
+    staging = transport.begin_task_attempt(sid, task_key, attempt)
     ctx = ExecCtx(conf)
     base = payload["map_id_base"]
-    for i, batch in enumerate(plan.execute(ctx)):
-        pids = partitioning.partition_ids_device(batch, ctx.eval_ctx)
-        writer = transport.writer(sid, base + i)
-        writer.write_unsplit(batch, pids)
-        writer.close()
+    try:
+        for i, batch in enumerate(plan.execute(ctx)):
+            pids = partitioning.partition_ids_device(batch, ctx.eval_ctx)
+            writer = transport.writer(sid, base + i, subdir=staging)
+            writer.write_unsplit(batch, pids)
+            writer.close()
+    except BaseException:
+        transport.abort_task_attempt(sid, task_key, attempt)
+        raise
+    transport.commit_task_attempt(sid, task_key, attempt)
 
 
 def _run_collect_task(payload: Dict) -> None:
     """Execute a (reduce/final) plan slice on this worker's device and
-    write the result as one Arrow IPC file."""
+    publish the result as one Arrow IPC file; the final hard link is the
+    commit — first attempt to link wins, a later (speculative/zombie)
+    attempt discards its own file."""
     from .columnar.arrow_bridge import arrow_schema, device_to_arrow
     conf = RapidsConf(payload["conf"])
     plan: TpuExec = payload["plan"]
@@ -129,21 +148,70 @@ def _run_collect_task(payload: Dict) -> None:
     rbs = [device_to_arrow(b) for b in plan.execute(ctx)]
     target = arrow_schema(plan.output_schema)
     out = payload["out"]
-    with pa.OSFile(out + ".tmp", "wb") as f, \
+    tmp = f"{out}.a{payload.get('attempt', 0)}.tmp"
+    with pa.OSFile(tmp, "wb") as f, \
             pa.ipc.new_file(f, target) as w:
         for rb in rbs:
             if rb.num_rows:
                 w.write_batch(rb)
-    os.replace(out + ".tmp", out)
+    try:
+        os.link(tmp, out)  # atomic first-commit-wins (EEXIST = lost)
+    except FileExistsError:
+        pass
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
 
 
 _TASK_KINDS = {"map": _run_map_task, "collect": _run_collect_task}
 
 
-def worker_main(root: str, worker_id: int, poll_s: float = 0.02) -> None:
+class _Heartbeat:
+    """Worker-side liveness beacon: a daemon thread rewriting
+    ``heartbeats/w<K>.hb`` every ``interval`` seconds. The driver treats
+    a stale file as a wedged worker. A native call hung while holding
+    the GIL (a stuck Pallas compile) starves this thread too, so real
+    wedges are caught, not just cooperative ones; chaos `hang` simulates
+    that via suspend()."""
+
+    def __init__(self, root: str, worker_id: int, interval: float):
+        self.path = os.path.join(root, "heartbeats", f"w{worker_id}.hb")
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._beat()
+        self._thread.start()
+
+    def _beat(self):
+        try:
+            with open(self.path + ".tmp", "w") as f:
+                f.write(str(time.time()))
+            os.replace(self.path + ".tmp", self.path)
+        except OSError:
+            pass
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            self._beat()
+
+    def suspend(self):
+        self._stop.set()
+
+
+def worker_main(root: str, worker_id: int, poll_s: float = 0.02,
+                heartbeat_interval: float = 0.5) -> None:
     """Worker process loop: claim task files addressed to this worker,
-    run them, write .ok/.err markers. Exits on root/shutdown."""
+    run them (after the chaos hook), write .ok/.err markers. Exits on
+    root/shutdown."""
+    from .scheduler import chaos
     tasks_dir = os.path.join(root, "tasks")
+    hb = _Heartbeat(root, worker_id, heartbeat_interval)
+    hb.start()
     while True:
         if os.path.exists(os.path.join(root, "shutdown")):
             return
@@ -163,6 +231,27 @@ def worker_main(root: str, worker_id: int, poll_s: float = 0.02) -> None:
             try:
                 with open(path, "rb") as f:
                     kind, payload = pickle.load(f)
+            except (OSError, EOFError):
+                continue  # unlinked under us (worker was declared lost)
+            except BaseException:
+                # deserialization failure (version skew, missing class)
+                # is a TASK failure the driver must see as a traceback —
+                # escaping here would look like a worker death and burn
+                # the respawn budget re-crashing on every retry
+                with open(err + ".tmp", "w") as f:
+                    f.write(traceback.format_exc())
+                os.replace(err + ".tmp", err)
+                ran = True
+                continue
+            try:
+                with open(path + ".claim.tmp", "w") as f:
+                    f.write(f"{worker_id} {time.time()}")
+                os.replace(path + ".claim.tmp", path + ".claim")
+                settings = payload.get("conf", {}) or {}
+                chaos.maybe_inject(
+                    settings.get(INJECT_FAULTS.key, ""), worker_id,
+                    payload.get("task_id", ""),
+                    payload.get("attempt", 0), hb)
                 _TASK_KINDS[kind](payload)
                 with open(done + ".tmp", "w") as f:
                     f.write("ok")
@@ -176,22 +265,146 @@ def worker_main(root: str, worker_id: int, poll_s: float = 0.02) -> None:
             time.sleep(poll_s)
 
 
+class _WorkerPool:
+    """Owns the N worker OS processes: spawn, poll, kill, respawn, and
+    heartbeat-file staleness — the seam `scheduler.TaskScheduler` drives
+    liveness through."""
+
+    def __init__(self, root: str, n: int, env: Dict[str, str],
+                 heartbeat_interval: float):
+        self.root = root
+        self.n = n
+        self._env = env
+        self._hb_interval = heartbeat_interval
+        self._procs: List[Optional[subprocess.Popen]] = [None] * n
+        self._errlogs: List[Optional[Tuple[str, object]]] = [None] * n
+        self._spawn_ts = [0.0] * n
+        for w in range(n):
+            self.spawn(w)
+
+    def spawn(self, w: int) -> None:
+        errpath = os.path.join(self.root, f"worker-{w}.err")
+        errf = open(errpath, "ab")  # append: respawns keep history
+        self._errlogs[w] = (errpath, errf)
+        # stderr goes to a file per worker, NOT a pipe: an undrained
+        # pipe blocks the worker once it fills (~64 KiB of library
+        # warnings is enough) — a silent cluster hang
+        self._procs[w] = subprocess.Popen(
+            [sys.executable, "-m", "spark_rapids_tpu.cluster",
+             "--root", self.root, "--worker", str(w),
+             "--heartbeat", str(self._hb_interval)],
+            env=self._env, stdout=subprocess.DEVNULL, stderr=errf)
+        self._spawn_ts[w] = time.time()
+        # a fresh incarnation must not look wedged through its
+        # predecessor's last (stale) beat
+        try:
+            os.unlink(self._hb_path(w))
+        except OSError:
+            pass
+
+    def alive(self, w: int) -> bool:
+        p = self._procs[w]
+        return p is not None and p.poll() is None
+
+    def exit_info(self, w: int) -> Tuple[Optional[int], str]:
+        p = self._procs[w]
+        rc = p.returncode if p is not None else None
+        err = ""
+        if self._errlogs[w] is not None:
+            try:
+                with open(self._errlogs[w][0], "rb") as f:
+                    err = f.read().decode(errors="replace")
+            except OSError:
+                pass
+        return rc, err
+
+    def kill(self, w: int) -> None:
+        p = self._procs[w]
+        if p is not None and p.poll() is None:
+            p.kill()
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+
+    def respawn(self, w: int) -> None:
+        self.kill(w)
+        if self._errlogs[w] is not None:
+            try:
+                self._errlogs[w][1].close()
+            except OSError:
+                pass
+        self.spawn(w)
+
+    def _hb_path(self, w: int) -> str:
+        return os.path.join(self.root, "heartbeats", f"w{w}.hb")
+
+    def heartbeat_age(self, w: int) -> Optional[float]:
+        try:
+            return time.time() - os.stat(self._hb_path(w)).st_mtime
+        except OSError:
+            return None  # no beat yet this incarnation
+
+    def spawn_ts(self, w: int) -> float:
+        return self._spawn_ts[w]
+
+    def shutdown(self) -> None:
+        with open(os.path.join(self.root, "shutdown"), "w") as f:
+            f.write("1")
+        for w in range(self.n):
+            p = self._procs[w]
+            if p is None:
+                continue
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in self._errlogs:
+            if log is not None:
+                try:
+                    log[1].close()
+                except OSError:
+                    pass
+
+
 class TpuProcessCluster:
     """Spawn N worker processes against a filesystem rendezvous root.
     Workers run `python -m spark_rapids_tpu.cluster --root R --worker K`
     with an isolated (CPU by default) JAX runtime each — genuinely
-    separate OS processes with nothing shared but the filesystem."""
+    separate OS processes with nothing shared but the filesystem.
+    Queries run under `scheduler.TaskScheduler`: bounded task retry,
+    worker blacklisting, heartbeat liveness + respawn, and optional
+    speculative execution (`spark.rapids.tpu.speculation`)."""
 
     def __init__(self, n_workers: int = 2, root: Optional[str] = None,
                  env: Optional[Dict[str, str]] = None,
-                 platform: str = "cpu"):
+                 platform: str = "cpu",
+                 conf: Optional[RapidsConf] = None):
         self.n_workers = n_workers
         self.root = root or tempfile.mkdtemp(prefix="rapids_tpu_cluster_")
         self._own_root = root is None
-        os.makedirs(os.path.join(self.root, "tasks"), exist_ok=True)
-        os.makedirs(os.path.join(self.root, "shuffle"), exist_ok=True)
-        os.makedirs(os.path.join(self.root, "results"), exist_ok=True)
+        self.conf = conf or RapidsConf()
+        # A reused root (driver crashed and rerun with the same path)
+        # holds a previous run's task/result/shuffle artifacts; query
+        # and shuffle seqs restart at 1, so the first-commit-wins
+        # protocol would mistake stale files for winning siblings and
+        # silently serve the old run's data. Start from a clean slate.
+        import shutil as _shutil
+        for sub in ("tasks", "shuffle", "results", "heartbeats"):
+            d = os.path.join(self.root, sub)
+            if not self._own_root and os.path.isdir(d):
+                _shutil.rmtree(d, ignore_errors=True)
+            os.makedirs(d, exist_ok=True)
         wenv = dict(os.environ)
+        # workers import the package by module name: make sure the dir
+        # the DRIVER imported it from is importable even when the driver
+        # added it via sys.path (not installed / not cwd)
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        pyp = wenv.get("PYTHONPATH", "")
+        if pkg_parent not in pyp.split(os.pathsep):
+            wenv["PYTHONPATH"] = (pkg_parent + os.pathsep + pyp
+                                  if pyp else pkg_parent)
         wenv["JAX_PLATFORMS"] = platform
         # environments whose sitecustomize re-pins JAX_PLATFORMS at
         # interpreter start (the axon tunnel does) need the worker to
@@ -199,73 +412,14 @@ class TpuProcessCluster:
         wenv["RAPIDS_TPU_WORKER_PLATFORM"] = platform
         if env:
             wenv.update(env)
-        # stderr goes to a file per worker, NOT a pipe: an undrained
-        # pipe blocks the worker once it fills (~64 KiB of library
-        # warnings is enough) — a silent cluster hang
-        self._errlogs = []
-        self._procs = []
-        for w in range(n_workers):
-            errpath = os.path.join(self.root, f"worker-{w}.err")
-            errf = open(errpath, "wb")
-            self._errlogs.append((errpath, errf))
-            self._procs.append(subprocess.Popen(
-                [sys.executable, "-m", "spark_rapids_tpu.cluster",
-                 "--root", self.root, "--worker", str(w)],
-                env=wenv, stdout=subprocess.DEVNULL, stderr=errf))
-        self._task_seq = 0
+        self.pool = _WorkerPool(self.root, n_workers, wenv,
+                                self.conf.get(HEARTBEAT_INTERVAL))
+        self._query_seq = 0
         self._sid_seq = 0
-
-    # --- task plumbing ----------------------------------------------------
-
-    def _submit(self, worker: int, kind: str, payload: Dict) -> str:
-        self._task_seq += 1
-        name = f"t{self._task_seq:05d}.w{worker}.task"
-        path = os.path.join(self.root, "tasks", name)
-        with open(path + ".tmp", "wb") as f:
-            pickle.dump((kind, payload), f, protocol=4)
-        os.replace(path + ".tmp", path)
-        return path
-
-    def _wait(self, paths: Sequence[str], timeout: float = 300.0) -> None:
-        deadline = time.time() + timeout
-        pending = set(paths)
-        while pending:
-            for p in list(pending):
-                if os.path.exists(p + ".ok"):
-                    pending.discard(p)
-                elif os.path.exists(p + ".err"):
-                    with open(p + ".err") as f:
-                        raise RuntimeError(
-                            f"worker task {os.path.basename(p)} failed:\n"
-                            + f.read())
-            for w, proc in enumerate(self._procs):
-                if proc.poll() is not None:
-                    errpath = self._errlogs[w][0]
-                    try:
-                        with open(errpath, "rb") as f:
-                            err = f.read().decode(errors="replace")
-                    except OSError:
-                        err = ""
-                    raise RuntimeError(
-                        f"worker died rc={proc.returncode}: {err[-2000:]}")
-            if time.time() > deadline:
-                raise TimeoutError(f"tasks {pending} timed out")
-            if pending:
-                time.sleep(0.02)
+        self.last_scheduler: Optional[TaskScheduler] = None
 
     def shutdown(self) -> None:
-        with open(os.path.join(self.root, "shutdown"), "w") as f:
-            f.write("1")
-        for p in self._procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
-        for _, errf in self._errlogs:
-            try:
-                errf.close()
-            except OSError:
-                pass
+        self.pool.shutdown()
         if self._own_root:
             import shutil
             shutil.rmtree(self.root, ignore_errors=True)
@@ -282,10 +436,38 @@ class TpuProcessCluster:
                   conf: Optional[RapidsConf] = None) -> pa.Table:
         """Execute a physical plan across the worker processes: stages
         split at shuffle exchanges, map outputs exchanged as Arrow IPC
-        files, final per-partition results concatenated here."""
-        conf = conf or RapidsConf()
+        files, final per-partition results concatenated here. Task
+        failures, worker deaths/hangs, and stragglers are handled by the
+        TaskScheduler; every attempt is recorded and forwarded to the
+        event log when `spark.rapids.eventLog.dir` is set."""
+        conf = conf or self.conf
         settings = conf.items()
         plan = copy.deepcopy(plan)
+        # planner-built plans (AQE on by default) wrap exchanges in
+        # TpuAQEShuffleReadExec; the adaptive reader is an in-process
+        # construct (it materializes the exchange through a transport
+        # handle), so strip it here — the process cluster IS the
+        # exchange (ADVICE round 5)
+        plan = _strip_aqe_reads(plan)
+        self._query_seq += 1
+        qid = self._query_seq
+        sched = TaskScheduler(self.pool, os.path.join(self.root, "tasks"),
+                              conf, query_id=f"q{qid}")
+        self.last_scheduler = sched
+        t0 = time.time()
+        try:
+            return self._run_query_stages(plan, conf, settings, qid,
+                                          sched)
+        finally:
+            # failed queries are exactly the ones whose attempt
+            # timeline the profiler needs — log unconditionally
+            from .tools.event_log import log_scheduler_events
+            log_scheduler_events(conf, f"q{qid}", sched,
+                                 time.time() - t0)
+
+    def _run_query_stages(self, plan: TpuExec, conf: RapidsConf,
+                          settings: Dict, qid: int,
+                          sched: TaskScheduler) -> pa.Table:
         shuffle_root = os.path.join(self.root, "shuffle")
         # run map stages deepest-first until no exchange remains
         while True:
@@ -295,24 +477,24 @@ class TpuProcessCluster:
             self._sid_seq += 1
             sid = self._sid_seq
             slices = _split_leaf_input(exch.child, self.n_workers)
-            paths = []
-            for w, child_slice in enumerate(slices):
-                paths.append(self._submit(w % self.n_workers, "map", {
+            specs = []
+            for i, child_slice in enumerate(slices):
+                specs.append(TaskSpec(f"q{qid}s{sid}m{i}", "map", {
                     "plan": child_slice,
                     "partitioning": exch.partitioning,
                     "shuffle_root": shuffle_root,
                     "shuffle_id": sid,
-                    "map_id_base": w * 100_000,
+                    "map_id_base": i * 100_000,
                     "conf": settings,
                 }))
-            self._wait(paths)
+            sched.run_stage(specs, stage_label=f"map s{sid}")
             n = exch.partitioning.num_partitions
             read = ProcessShuffleReadExec(shuffle_root, sid, list(range(n)),
                                           exch.child.output_schema)
             plan = _replace_node(plan, exch, read)
         # final stage: split the partition ranges of every shuffle read
         outs = []
-        paths = []
+        specs = []
         for w in range(self.n_workers):
             final = _slice_partitions(copy.deepcopy(plan), w,
                                       self.n_workers)
@@ -322,12 +504,12 @@ class TpuProcessCluster:
                 else:
                     continue
             out = os.path.join(self.root, "results",
-                               f"q{self._task_seq}_w{w}.arrow")
+                               f"q{qid}_r{w}.arrow")
             outs.append(out)
-            paths.append(self._submit(w, "collect",
-                                      {"plan": final, "out": out,
-                                       "conf": settings}))
-        self._wait(paths)
+            specs.append(TaskSpec(f"q{qid}r{w}", "collect",
+                                  {"plan": final, "out": out,
+                                   "conf": settings}))
+        sched.run_stage(specs, stage_label="final")
         tables = []
         for out in outs:
             with pa.OSFile(out, "rb") as f:
@@ -343,11 +525,30 @@ class TpuProcessCluster:
 def run_process_query(plan: TpuExec, n_workers: int = 2,
                       conf: Optional[RapidsConf] = None) -> pa.Table:
     """One-shot convenience: spin a cluster up, run, tear down."""
-    with TpuProcessCluster(n_workers) as cluster:
+    with TpuProcessCluster(n_workers, conf=conf) as cluster:
         return cluster.run_query(plan, conf)
 
 
 # --- plan surgery ----------------------------------------------------------
+
+def _strip_aqe_reads(plan: TpuExec) -> TpuExec:
+    """Replace every TpuAQEShuffleReadExec with its child exchange: the
+    cluster splits stages AT exchanges, and a leftover adaptive reader
+    above a ProcessShuffleReadExec would call .materialize on a node
+    that has none."""
+    from .exec.aqe import TpuAQEShuffleReadExec
+    if isinstance(plan, TpuAQEShuffleReadExec):
+        return _strip_aqe_reads(plan.child)
+    kids = getattr(plan, "children", ())
+    if kids:
+        new = tuple(_strip_aqe_reads(c) for c in kids)
+        if any(n is not o for n, o in zip(new, kids)):
+            # with_new_children, not a children= mutation: nodes with
+            # internal wiring (TopN's fused pipeline) rebuild over the
+            # new child instead of silently executing the old one
+            plan = plan.with_new_children(new)
+    return plan
+
 
 def _deepest_exchange(plan: TpuExec):
     """A shuffle exchange with no exchange below it (next runnable map
@@ -380,7 +581,9 @@ def _replace_node(plan: TpuExec, old: TpuExec, new: TpuExec) -> TpuExec:
         return new
     kids = getattr(plan, "children", ())
     if kids:
-        plan.children = tuple(_replace_node(c, old, new) for c in kids)
+        nkids = tuple(_replace_node(c, old, new) for c in kids)
+        if any(n is not o for n, o in zip(nkids, kids)):
+            plan = plan.with_new_children(nkids)
     return plan
 
 
@@ -476,13 +679,15 @@ def _main(argv: Sequence[str]) -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--root", required=True)
     ap.add_argument("--worker", type=int, required=True)
+    ap.add_argument("--heartbeat", type=float, default=0.5)
     args = ap.parse_args(argv)
     plat = os.environ.get("RAPIDS_TPU_WORKER_PLATFORM")
     if plat:
         os.environ["JAX_PLATFORMS"] = plat
         import jax
         jax.config.update("jax_platforms", plat)
-    worker_main(args.root, args.worker)
+    worker_main(args.root, args.worker,
+                heartbeat_interval=args.heartbeat)
 
 
 if __name__ == "__main__":

@@ -265,6 +265,60 @@ JOIN_VERIFY_UNIQUE_HINT = register(
     "fast path a device-side duplicate probe is recorded and raised at "
     "the query's first natural download — no extra host sync.")
 
+# --- Process-cluster scheduler --------------------------------------------
+TASK_MAX_ATTEMPTS = register(
+    "spark.rapids.tpu.task.maxAttempts", 4,
+    "Max attempts per cluster task (1 = no retry). A task that fails on "
+    "one worker is retried on another, like Spark's spark.task.maxFailures.")
+TASK_TIMEOUT = register(
+    "spark.rapids.tpu.task.timeout", 300.0,
+    "Seconds a claimed task attempt may run before the driver declares "
+    "the worker hung, kills it, and retries the task elsewhere.")
+STAGE_TIMEOUT = register(
+    "spark.rapids.tpu.scheduler.stageTimeout", 600.0,
+    "Wall-clock ceiling for one stage of a process-cluster query, "
+    "including every retry and respawn.")
+MAX_TASK_FAILURES_PER_WORKER = register(
+    "spark.rapids.tpu.scheduler.maxTaskFailuresPerWorker", 2,
+    "Blacklist a worker after this many task failures (errors, deaths, "
+    "or hangs) — no new attempts are scheduled on it.")
+MAX_WORKER_RESPAWNS = register(
+    "spark.rapids.tpu.scheduler.maxWorkerRespawns", 4,
+    "Total worker process respawns a query may spend recovering from "
+    "dead or wedged workers before the failure is fatal.")
+HEARTBEAT_INTERVAL = register(
+    "spark.rapids.tpu.heartbeat.interval", 0.5,
+    "Seconds between worker heartbeat-file writes (startup-time knob: "
+    "workers read it when the cluster spawns them).", startup_only=True)
+HEARTBEAT_TIMEOUT = register(
+    "spark.rapids.tpu.heartbeat.timeout", 10.0,
+    "Driver-side staleness bound: a worker whose heartbeat file is "
+    "older than this is considered wedged and is killed + respawned. "
+    "A hung native call (e.g. a stuck Pallas compile) holds the GIL and "
+    "starves the heartbeat thread, so wedged-in-native workers trip "
+    "this too.")
+SPECULATION = register(
+    "spark.rapids.tpu.speculation", False,
+    "Speculative execution: launch a duplicate attempt of a task "
+    "running longer than speculation.multiplier x the stage's median "
+    "completed-task time; whichever attempt commits first wins "
+    "(map output commits are atomic, so the loser's files never mix in).")
+SPECULATION_MULTIPLIER = register(
+    "spark.rapids.tpu.speculation.multiplier", 4.0,
+    "A running task is a straggler when its runtime exceeds this many "
+    "times the median completed-task runtime of its stage.")
+SPECULATION_MIN_RUNTIME = register(
+    "spark.rapids.tpu.speculation.minRuntime", 1.0,
+    "Never speculate a task that has been running for less than this "
+    "many seconds (guards against duplicating short tasks).")
+INJECT_FAULTS = register(
+    "spark.rapids.tpu.test.injectFaults", "",
+    "Testing: deterministic fault injection in cluster workers. "
+    "Semicolon-separated rules 'mode:task_glob:attempt[:seconds]' with "
+    "mode crash | hang | delay, task_glob an fnmatch pattern over task "
+    "ids (e.g. 'q1s1m0'), attempt an int or '*'. See scheduler/chaos.py.",
+    internal=True)
+
 # --- UDF ------------------------------------------------------------------
 UDF_COMPILER_ENABLED = register(
     "spark.rapids.sql.udfCompiler.enabled", True,

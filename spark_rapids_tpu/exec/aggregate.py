@@ -434,7 +434,13 @@ class TpuHashAggregateExec(UnaryExec):
             if not getattr(a, "single_pass", False):
                 continue
             if isinstance(a, ApproxPercentile) and not exact:
-                continue
+                # the sketch merge builds (segment, mass) compound int64
+                # keys with a 2^42 stride; capacities past the stride's
+                # headroom would overflow, so oversized plans fall back
+                # to the exact single-pass path instead
+                if ctx.conf.batch_size_rows * int(a._MASS_SCALE) \
+                        <= (1 << 63) - 1:
+                    continue
             return True
         return False
 

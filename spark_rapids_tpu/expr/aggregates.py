@@ -802,7 +802,18 @@ class ApproxPercentile(AggregateFunction):
             if seg is not None else _lane0(jnp.sum(sw), _I64)
         starts_mass = exclusive_cumsum(totals)
         cum_within = jnp.cumsum(sw) - starts_mass[sseg_c]
-        SCALE = self._MASS_SCALE
+        SCALE = int(self._MASS_SCALE)
+        imax = (1 << 63) - 1
+        if out_cap * SCALE > imax:
+            # (out_cap-1) * SCALE + SCALE-1 would wrap int64 negative
+            # and scramble the compound-key sort; shrink the mass stride
+            # to the largest power of two that fits. Masses clip at
+            # SCALE-1, so rank resolution inside monster segments
+            # degrades gracefully instead of corrupting every segment.
+            # (Plans sized like this normally never get here: the exec
+            # falls back to the exact single-pass path first.)
+            SCALE = 1 << max(1, (imax // out_cap).bit_length() - 1)
+        SCALE = jnp.int64(SCALE)
         compound = jnp.where(
             kept,
             sseg_c.astype(jnp.int64) * SCALE
@@ -814,8 +825,11 @@ class ApproxPercentile(AggregateFunction):
         out = []
         total_c = jnp.maximum(totals, 1)
         for k in range(K):
-            # mass rank of fraction k/(K-1), 1-based, endpoints exact
-            tgt = 1 + ((total_c - 1) * k) // (K - 1)
+            # mass rank of fraction k/(K-1), 1-based, endpoints exact;
+            # clipped to the stride so a clamped-SCALE segment's probe
+            # cannot bleed into the next segment's key range
+            tgt = jnp.clip(1 + ((total_c - 1) * k) // (K - 1),
+                           1, SCALE - 1)
             pos = jnp.searchsorted(compound, g * SCALE + tgt,
                                    side="left").astype(jnp.int32)
             pos = jnp.clip(pos, 0, rows * K - 1)

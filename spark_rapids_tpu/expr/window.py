@@ -228,9 +228,10 @@ class WindowExpression(Expression):
     def spec_signature(self) -> str:
         """Partition/order/frame identity — one TpuWindowExec handles one
         spec (Spark plans one WindowExec per distinct spec)."""
+        order = ", ".join(f"{o.child!r} {o.ascending} {o.nulls_first}"
+                          for o in self.order_by)
         return (f"partition=[{', '.join(map(repr, self.partition_by))}] "
-                f"order=[{', '.join(f'{o.child!r} {o.ascending} '
-                                    f'{o.nulls_first}' for o in self.order_by)}]")
+                f"order=[{order}]")
 
     def validate(self):
         f = self.func

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import queue
+import re
 import threading
 import time
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
@@ -51,6 +52,13 @@ HIVE_TEXT_ENABLED = _register(
 _FORMAT_CONF = {"parquet": PARQUET_ENABLED, "orc": ORC_ENABLED,
                 "csv": CSV_ENABLED, "json": JSON_ENABLED,
                 "hivetext": HIVE_TEXT_ENABLED}
+
+# strict numeric forms only: Python's float()/int() accept 'nan',
+# 'inf', 'Infinity' and '1_0', which Spark/LazySimpleSerDe type as
+# string or NULL (ADVICE r4/r5). Shared by partition-value inference
+# and Hive text field conversion.
+_INT_RE = re.compile(r"[+-]?\d+\Z")
+_FLOAT_RE = re.compile(r"[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?\Z")
 
 
 class FileSplit:
@@ -195,11 +203,6 @@ def _hive_partition_values(paths: Sequence[str]):
     if not keys:
         return {}, None
     NULLV = "__HIVE_DEFAULT_PARTITION__"
-    # strict numeric forms only: Python's float()/int() accept 'nan',
-    # 'inf' and '1_0', which Spark would type as string (ADVICE r4)
-    import re
-    _INT_RE = re.compile(r"[+-]?\d+\Z")
-    _FLOAT_RE = re.compile(r"[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?\Z")
 
     def infer(vals):
         nonnull = [v for v in vals if v is not None and v != NULLV]
@@ -341,9 +344,11 @@ def _decode_hive_text(path: str, columns, batch_rows: int,
             return None
         try:
             if dt.is_integral(f.dtype):
-                return int(v)
+                # LazySimpleSerDe: '1_0', 'nan', '0x10' etc. are NULL,
+                # not Python-int-parseable variants
+                return int(v) if _INT_RE.match(v) else None
             if dt.is_floating(f.dtype):
-                return float(v)
+                return float(v) if _FLOAT_RE.match(v) else None
             if isinstance(f.dtype, dt.BooleanType):
                 return v.lower() == "true"
             if isinstance(f.dtype, dt.DateType):

@@ -37,21 +37,33 @@ def columnar_rdd(df) -> Iterator[Dict[str, object]]:
     from .ops.gather import ensure_compacted
     pp = df._plan()
     ctx = ExecCtx(df._session.conf)
-    for batch in pp.root.execute(ctx):
-        batch = ensure_compacted(batch)
-        out: Dict[str, object] = {"row_count": batch.row_count}
-        for f, c in zip(batch.schema.fields, batch.columns):
-            if c.data is not None:
-                out[f.name] = c.data
-            elif c.offsets is not None and c.chars is not None:
-                out[f.name + "__offsets"] = c.offsets
-                out[f.name + "__chars"] = c.chars
-            else:
-                raise TypeError(
-                    f"column {f.name} ({f.dtype.simple_string()}) has "
-                    "no flat device representation for columnar_rdd")
-            out[f.name + "__valid"] = c.validity
-        yield out
+    # same lifecycle as collect_arrow: device admission for the whole
+    # iteration, cleanups (shared-exchange handles) even on abandonment,
+    # deferred device checks raised at the natural end-of-stream sync
+    try:
+        with ctx.mm.task_slot():  # admission control (GpuSemaphore analog)
+            for batch in pp.root.execute(ctx):
+                batch = ensure_compacted(batch)
+                out: Dict[str, object] = {"row_count": batch.row_count}
+                for f, c in zip(batch.schema.fields, batch.columns):
+                    if c.data is not None:
+                        out[f.name] = c.data
+                    elif c.offsets is not None and c.chars is not None:
+                        out[f.name + "__offsets"] = c.offsets
+                        out[f.name + "__chars"] = c.chars
+                    else:
+                        raise TypeError(
+                            f"column {f.name} "
+                            f"({f.dtype.simple_string()}) has no flat "
+                            "device representation for columnar_rdd")
+                    out[f.name + "__valid"] = c.validity
+                yield out
+    except BaseException:
+        ctx.discard_deferred()  # a reused ctx must not report dead flags
+        raise
+    finally:
+        ctx.run_cleanups()
+    ctx.check_deferred()
 
 
 def to_feature_matrix(df, feature_cols: List[str],
@@ -68,7 +80,16 @@ def to_feature_matrix(df, feature_cols: List[str],
     from .ops.gather import ensure_compacted
     pp = df._plan()
     ctx = ExecCtx(df._session.conf)
-    batches = [ensure_compacted(b) for b in pp.root.execute(ctx)]
+    try:
+        with ctx.mm.task_slot():  # admission control (GpuSemaphore analog)
+            batches = [ensure_compacted(b)
+                       for b in pp.root.execute(ctx)]
+    except BaseException:
+        ctx.discard_deferred()
+        raise
+    finally:
+        ctx.run_cleanups()
+    ctx.check_deferred()
     if not batches:
         raise ValueError("empty input")
     big = batches[0] if len(batches) == 1 else concat_batches(batches)

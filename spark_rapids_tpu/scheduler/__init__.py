@@ -1,0 +1,11 @@
+"""Fault-tolerant task scheduling for the process cluster.
+
+TPU analog of Spark's DAGScheduler/TaskSetManager robustness layer
+(SURVEY.md §3.4; the reference inherits it from Spark itself): per-task
+attempt tracking with bounded retry, worker blacklisting, heartbeat
+liveness with kill + respawn, straggler speculation, and a deterministic
+fault-injection harness so every recovery path is testable on one host.
+"""
+from .task_scheduler import TaskScheduler, TaskSpec
+
+__all__ = ["TaskScheduler", "TaskSpec"]

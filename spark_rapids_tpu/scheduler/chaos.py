@@ -1,0 +1,112 @@
+"""Deterministic fault injection for cluster workers.
+
+Driven by ``spark.rapids.tpu.test.injectFaults`` (config.py): a
+semicolon-separated rule list evaluated by the WORKER immediately before
+it runs a claimed task, so a chosen (task, attempt) can be made to
+crash, hang, or run slow — on whichever worker picked it up, or only on
+a specific worker. Rules are pure functions of (worker, task, attempt):
+no randomness, no state — the same spec reproduces the same failure
+schedule every run, which is what makes the recovery paths unit-testable
+on one host (Spark gets the equivalent via its TaskSetManager test
+harness; production clusters get the faults for free).
+
+Grammar (whitespace-insensitive)::
+
+    spec    := rule (';' rule)*
+    rule    := mode ':' task_glob ':' attempt [':' seconds] ['@w' worker]
+    mode    := 'crash' | 'hang' | 'delay'
+    attempt := int | '*'
+
+- ``crash``  — the worker process exits immediately (``os._exit``),
+  leaving no .err marker: the death-detection path.
+- ``hang``   — the worker suspends its heartbeat thread and sleeps,
+  simulating a native call wedged while holding the GIL (a stuck Pallas
+  compile): the heartbeat-staleness path.
+- ``delay``  — sleep ``seconds`` (default 2.0) before running the task
+  normally: the straggler/speculation path.
+
+Examples::
+
+    crash:q1s1m0:0            # kill the worker running map task 0,
+                              # attempt 0, of query 1 / shuffle 1
+    hang:*m1:0                # first attempt of any map task 1 wedges
+    delay:q1s1m0:0:3.5        # attempt 0 runs 3.5s late
+    crash:q1s1m0:0@w1         # only when worker 1 runs it
+"""
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import os
+import time
+from typing import List, Optional
+
+__all__ = ["ChaosRule", "parse_fault_spec", "find_rule", "maybe_inject"]
+
+_MODES = ("crash", "hang", "delay")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosRule:
+    mode: str
+    task_glob: str
+    attempt: Optional[int]  # None = any attempt
+    seconds: float = 2.0
+    worker: Optional[int] = None  # None = any worker
+
+    def matches(self, worker_id: int, task_id: str, attempt: int) -> bool:
+        if self.worker is not None and self.worker != worker_id:
+            return False
+        if self.attempt is not None and self.attempt != attempt:
+            return False
+        return fnmatch.fnmatchcase(task_id, self.task_glob)
+
+
+def parse_fault_spec(spec: str) -> List[ChaosRule]:
+    rules = []
+    for raw in (spec or "").split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        worker = None
+        if "@w" in raw:
+            raw, _, w = raw.rpartition("@w")
+            worker = int(w)
+        parts = [p.strip() for p in raw.split(":")]
+        if len(parts) < 3 or parts[0] not in _MODES:
+            raise ValueError(f"bad injectFaults rule {raw!r} (want "
+                             "mode:task_glob:attempt[:seconds])")
+        mode, glob, att = parts[:3]
+        attempt = None if att == "*" else int(att)
+        seconds = float(parts[3]) if len(parts) > 3 else 2.0
+        rules.append(ChaosRule(mode, glob, attempt, seconds, worker))
+    return rules
+
+
+def find_rule(spec: str, worker_id: int, task_id: str,
+              attempt: int) -> Optional[ChaosRule]:
+    for r in parse_fault_spec(spec):
+        if r.matches(worker_id, task_id, attempt):
+            return r
+    return None
+
+
+def maybe_inject(spec: str, worker_id: int, task_id: str, attempt: int,
+                 heartbeat=None) -> None:
+    """Worker-side hook: apply the first matching rule, if any. ``crash``
+    never returns; ``hang`` effectively never returns (the driver kills
+    the process); ``delay`` returns after sleeping."""
+    rule = find_rule(spec, worker_id, task_id, attempt)
+    if rule is None:
+        return
+    if rule.mode == "crash":
+        os._exit(13)
+    if rule.mode == "hang":
+        # a real wedge (native call holding the GIL) starves the
+        # heartbeat thread too — simulate both halves
+        if heartbeat is not None:
+            heartbeat.suspend()
+        time.sleep(600.0)
+        os._exit(14)  # the driver should have killed us long ago
+    if rule.mode == "delay":
+        time.sleep(rule.seconds)

@@ -1,0 +1,399 @@
+"""Driver-side task scheduler for the filesystem rendezvous.
+
+The TaskSetManager analog for `TpuProcessCluster` (SURVEY.md §3.4):
+`cluster.py` turns a stage into `TaskSpec`s and hands them to
+`TaskScheduler.run_stage`, which owns everything that can go wrong
+between submit and commit:
+
+- **attempt tracking / bounded retry** — a failed attempt (``.err``
+  marker, worker death, or hang) is retried on another worker up to
+  ``spark.rapids.tpu.task.maxAttempts`` times, excluding workers that
+  already failed this task;
+- **worker blacklisting** — a worker with
+  ``maxTaskFailuresPerWorker`` failures gets no new attempts;
+- **liveness** — worker processes are polled for death, and heartbeat
+  files (written by a worker-side thread) for wedging; a dead or wedged
+  worker is killed and respawned (bounded by ``maxWorkerRespawns``)
+  with its stale task files removed so a zombie can't re-claim them;
+- **speculation** — with ``spark.rapids.tpu.speculation``, a task
+  running ``speculation.multiplier``x the stage's median completed-task
+  time gets a duplicate attempt; whichever commits first wins (the
+  attempt-suffixed shuffle commit in shuffle/host.py makes the race
+  safe — a loser's output atomically never appears).
+
+Every transition is appended to ``self.events`` (task, attempt, worker,
+event, wall_s, reason) — `cluster.run_query` forwards them to the event
+log so tools/profiling.py can report retry overhead next to hotspots.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..config import (HEARTBEAT_TIMEOUT, MAX_TASK_FAILURES_PER_WORKER,
+                      MAX_WORKER_RESPAWNS, RapidsConf, SPECULATION,
+                      SPECULATION_MIN_RUNTIME, SPECULATION_MULTIPLIER,
+                      STAGE_TIMEOUT, TASK_MAX_ATTEMPTS, TASK_TIMEOUT)
+
+__all__ = ["TaskSpec", "TaskScheduler"]
+
+_POLL_S = 0.02
+_FIRST_BEAT_GRACE_S = 60.0  # interpreter + jax import before beat 1
+
+
+@dataclasses.dataclass
+class TaskSpec:
+    """One schedulable unit: a picklable (kind, payload) the worker loop
+    knows how to run, under a filesystem-safe stable id."""
+    task_id: str
+    kind: str
+    payload: Dict
+
+
+class _Attempt:
+    def __init__(self, spec: TaskSpec, number: int, worker: int,
+                 path: str):
+        self.spec = spec
+        self.number = number
+        self.worker = worker
+        self.path = path
+        self.submit_ts = time.time()
+        self.claim_ts: Optional[float] = None
+        self.state = "running"  # running | ok | err | lost
+
+    @property
+    def runtime(self) -> float:
+        return time.time() - (self.claim_ts or self.submit_ts)
+
+
+class TaskScheduler:
+    """One instance per query; stages run through it sequentially.
+
+    ``pool`` is the cluster's worker pool: ``n``, ``alive(w)``,
+    ``exit_info(w)``, ``kill(w)``, ``respawn(w)``,
+    ``heartbeat_age(w)``, ``spawn_ts(w)``.
+    """
+
+    def __init__(self, pool, tasks_dir: str, conf: RapidsConf,
+                 query_id: str = "q"):
+        self.pool = pool
+        self.tasks_dir = tasks_dir
+        self.conf = conf
+        self.query_id = query_id
+        self.events: List[Dict] = []
+        self.worker_failures: Dict[int, int] = {}
+        self.blacklist: set = set()
+        self.respawns_used = 0
+        self._max_attempts = max(1, conf.get(TASK_MAX_ATTEMPTS))
+        self._max_wfail = max(1, conf.get(MAX_TASK_FAILURES_PER_WORKER))
+        self._max_respawns = conf.get(MAX_WORKER_RESPAWNS)
+        self._task_timeout = conf.get(TASK_TIMEOUT)
+        self._stage_timeout = conf.get(STAGE_TIMEOUT)
+        self._hb_timeout = conf.get(HEARTBEAT_TIMEOUT)
+        self._speculation = conf.get(SPECULATION)
+        self._spec_mult = conf.get(SPECULATION_MULTIPLIER)
+        self._spec_min_s = conf.get(SPECULATION_MIN_RUNTIME)
+
+    # --- event log --------------------------------------------------------
+
+    def _event(self, event: str, task: str = "", attempt: int = -1,
+               worker: int = -1, wall_s: float = 0.0, reason: str = ""):
+        self.events.append({
+            "ts": time.time(), "event": event, "task": task,
+            "attempt": attempt, "worker": worker,
+            "wall_s": round(wall_s, 6), "reason": reason[-500:]})
+
+    def summary(self) -> Dict:
+        """Rollup for the query event log / profiler."""
+        c = {}
+        for e in self.events:
+            c[e["event"]] = c.get(e["event"], 0) + 1
+        overhead = sum(e["wall_s"] for e in self.events
+                       if e["event"] in ("task_failed", "attempt_lost"))
+        return {
+            "tasks_ok": c.get("task_ok", 0),
+            "failures": c.get("task_failed", 0),
+            "speculative_launched": c.get("speculative_attempt", 0),
+            "speculative_lost": c.get("attempt_lost", 0),
+            "workers_respawned": c.get("worker_respawn", 0),
+            "workers_blacklisted": len(self.blacklist),
+            "retry_overhead_s": round(overhead, 6),
+        }
+
+    # --- worker selection -------------------------------------------------
+
+    def _usable(self, w: int) -> bool:
+        return w not in self.blacklist and self.pool.alive(w)
+
+    def _load(self, running: List[_Attempt], w: int) -> int:
+        return sum(1 for a in running if a.worker == w)
+
+    def _pick_worker(self, running: List[_Attempt],
+                     exclude: set) -> Optional[int]:
+        """Least-loaded usable worker, preferring ones this task hasn't
+        failed on; falls back to excluded workers rather than stalling
+        (Spark does the same when locality/blacklist leave no one).
+        None when every worker is dead or blacklisted — the caller
+        decides whether to spend the respawn budget."""
+        usable = [w for w in range(self.pool.n) if self._usable(w)]
+        preferred = [w for w in usable if w not in exclude]
+        pool = preferred or usable
+        if pool:
+            return min(pool, key=lambda w: (self._load(running, w), w))
+        return None
+
+    def _pick_respawn_candidate(
+            self, running: List[_Attempt]) -> Optional[int]:
+        """Every worker is dead or blacklisted: buy one back with the
+        respawn budget (blacklist is per-incarnation, a fresh process
+        starts clean). Prefer workers with no in-flight attempt —
+        recycling a busy one retires its attempt, which can burn a
+        task's last allowed try."""
+        if self.respawns_used >= self._max_respawns:
+            return None
+        idle = [w for w in range(self.pool.n)
+                if not any(a.worker == w for a in running)]
+        return min(idle or range(self.pool.n),
+                   key=lambda w: self.worker_failures.get(w, 0))
+
+    def _respawn(self, w: int, reason: str):
+        self._clear_worker_tasks(w)
+        self.pool.respawn(w)
+        self.respawns_used += 1
+        self.blacklist.discard(w)
+        self.worker_failures[w] = 0
+        self._event("worker_respawn", worker=w, reason=reason)
+
+    def _clear_worker_tasks(self, w: int):
+        """Unlink task files addressed to a dead/killed worker so its
+        respawned incarnation cannot re-claim them and race the retry
+        as a zombie attempt."""
+        try:
+            names = os.listdir(self.tasks_dir)
+        except FileNotFoundError:
+            return
+        suffix = f".w{w}.task"
+        for n in names:
+            if n.endswith(suffix):
+                try:
+                    os.unlink(os.path.join(self.tasks_dir, n))
+                except OSError:
+                    pass
+
+    # --- submission -------------------------------------------------------
+
+    def _launch(self, spec: TaskSpec, number: int, worker: int,
+                running: List[_Attempt]) -> _Attempt:
+        payload = dict(spec.payload)
+        payload["task_id"] = spec.task_id
+        payload["attempt"] = number
+        name = f"{spec.task_id}.a{number}.w{worker}.task"
+        path = os.path.join(self.tasks_dir, name)
+        with open(path + ".tmp", "wb") as f:
+            pickle.dump((spec.kind, payload), f, protocol=4)
+        os.replace(path + ".tmp", path)
+        att = _Attempt(spec, number, worker, path)
+        running.append(att)
+        return att
+
+    # --- stage loop -------------------------------------------------------
+
+    def run_stage(self, specs: Sequence[TaskSpec],
+                  stage_label: str = "stage") -> None:
+        """Run every spec to a committed ``.ok``; raises RuntimeError /
+        TimeoutError when retries, respawns, or the stage clock run out."""
+        deadline = time.time() + self._stage_timeout
+        running: List[_Attempt] = []
+        done: set = set()
+        attempts_used: Dict[str, int] = {}
+        failed_on: Dict[str, set] = {s.task_id: set() for s in specs}
+        queue: List[TaskSpec] = list(specs)
+        durations: List[float] = []
+
+        def fail_attempt(att: _Attempt, reason: str, worker_fault: bool):
+            att.state = "err"
+            running.remove(att)
+            w = att.worker
+            if worker_fault:
+                self.worker_failures[w] = self.worker_failures.get(w, 0) + 1
+                if self.worker_failures[w] >= self._max_wfail \
+                        and w not in self.blacklist:
+                    self.blacklist.add(w)
+                    self._event("worker_blacklisted", worker=w,
+                                reason=f"{self.worker_failures[w]} failures")
+            failed_on[att.spec.task_id].add(w)
+            self._event("task_failed", att.spec.task_id, att.number, w,
+                        att.runtime, reason)
+            if att.spec.task_id in done:
+                return  # a sibling attempt already committed
+            live = [a for a in running if a.spec.task_id == att.spec.task_id]
+            if live:
+                return  # the speculative sibling is still going
+            if attempts_used[att.spec.task_id] >= self._max_attempts:
+                raise RuntimeError(
+                    f"worker task {att.spec.task_id} failed after "
+                    f"{attempts_used[att.spec.task_id]} attempts "
+                    f"({stage_label}):\n{reason}")
+            queue.append(att.spec)
+
+        def handle_worker_loss(w: int, reason: str):
+            # an attempt that already wrote its .ok finished BEFORE the
+            # worker was lost — leave it for the harvest pass instead of
+            # recording a success as a worker-fault failure
+            victims = [a for a in running if a.worker == w
+                       and not os.path.exists(a.path + ".ok")]
+            self._clear_worker_tasks(w)
+            # pre-assigned-but-unclaimed tasks on w are victims too
+            for att in victims:
+                fail_attempt(att, reason, worker_fault=True)
+            if self.respawns_used < self._max_respawns:
+                self._respawn(w, reason)
+            elif not any(self._usable(x) for x in range(self.pool.n)) \
+                    and (queue or running):
+                raise RuntimeError(
+                    f"{reason}; respawn budget "
+                    f"({self._max_respawns}) exhausted")
+
+        # superseded attempts (task already committed by a sibling) keep
+        # their worker busy but must not block stage completion — there
+        # is no per-task kill in the filesystem protocol, so the stage
+        # is done when every TASK is done, not every attempt
+        def outstanding():
+            return queue or any(a.spec.task_id not in done
+                                for a in running)
+
+        while outstanding():
+            if time.time() > deadline:
+                pending = sorted({a.spec.task_id for a in running
+                                  if a.spec.task_id not in done}
+                                 | {s.task_id for s in queue})
+                raise TimeoutError(
+                    f"{stage_label}: tasks {pending} timed out after "
+                    f"{self._stage_timeout}s")
+
+            # launch queued (re)tries
+            for spec in queue:
+                w = self._pick_worker(running, failed_on[spec.task_id])
+                if w is None:
+                    w = self._pick_respawn_candidate(running)
+                    if w is None:
+                        raise RuntimeError(
+                            f"worker task {spec.task_id} unschedulable: "
+                            f"all workers dead or blacklisted and respawn "
+                            f"budget ({self._max_respawns}) exhausted")
+                    # any attempt still marked running on the candidate
+                    # dies with the old incarnation — retire it first so
+                    # the stage can't wait forever on a ghost
+                    for att in [a for a in running if a.worker == w]:
+                        fail_attempt(att, "worker recycled under attempt",
+                                     worker_fault=False)
+                    self._respawn(w, "no usable worker left")
+                n = attempts_used.get(spec.task_id, 0)
+                attempts_used[spec.task_id] = n + 1
+                self._launch(spec, n, w, running)
+                self._event("task_submitted", spec.task_id, n, w)
+            queue = []
+
+            # harvest markers
+            for att in list(running):
+                if att not in running:
+                    continue  # a handle_worker_loss() earlier in this
+                    # pass already retired this snapshot entry
+                if att.claim_ts is None and os.path.exists(
+                        att.path + ".claim"):
+                    att.claim_ts = time.time()
+                if os.path.exists(att.path + ".ok"):
+                    att.state = "ok"
+                    running.remove(att)
+                    tid = att.spec.task_id
+                    if tid in done:
+                        # zombie / speculation loser: completed after a
+                        # sibling already won the commit race
+                        att.state = "lost"
+                        self._event("attempt_lost", tid, att.number,
+                                    att.worker, att.runtime)
+                    else:
+                        done.add(tid)
+                        durations.append(att.runtime)
+                        self._event("task_ok", tid, att.number,
+                                    att.worker, att.runtime)
+                elif os.path.exists(att.path + ".err"):
+                    try:
+                        with open(att.path + ".err") as f:
+                            tb = f.read()
+                    except OSError:
+                        tb = "(unreadable .err)"
+                    fail_attempt(att, tb, worker_fault=True)
+                elif att.claim_ts is not None \
+                        and att.spec.task_id in done:
+                    pass  # superseded: never kill a healthy worker (or
+                    # spend respawn budget) over an attempt whose result
+                    # no longer matters
+                elif att.claim_ts is not None \
+                        and time.time() - att.claim_ts > self._task_timeout:
+                    self.pool.kill(att.worker)
+                    handle_worker_loss(
+                        att.worker,
+                        f"task {att.spec.task_id} attempt {att.number} "
+                        f"exceeded {self._task_timeout}s; worker "
+                        f"{att.worker} killed")
+
+            # liveness: death + heartbeat staleness. Blacklisted workers
+            # still get checked while they hold running attempts —
+            # otherwise a pre-blacklist attempt stranded on a dead or
+            # wedged worker is only caught by the stage deadline.
+            for w in range(self.pool.n):
+                if w in self.blacklist \
+                        and not any(a.worker == w for a in running):
+                    continue
+                if not self.pool.alive(w):
+                    if not any(a.worker == w for a in running):
+                        continue  # idle corpse; respawn lazily on demand
+                    rc, err = self.pool.exit_info(w)
+                    handle_worker_loss(
+                        w, f"worker died rc={rc}: {err[-2000:]}")
+                    continue
+                age = self.pool.heartbeat_age(w)
+                if age is None:
+                    grace = time.time() - self.pool.spawn_ts(w)
+                    if grace > max(self._hb_timeout, _FIRST_BEAT_GRACE_S):
+                        self.pool.kill(w)
+                        handle_worker_loss(
+                            w, f"worker {w} never heartbeat "
+                            f"({grace:.1f}s since spawn)")
+                elif age > self._hb_timeout:
+                    self.pool.kill(w)
+                    handle_worker_loss(
+                        w, f"worker {w} heartbeat stale ({age:.1f}s > "
+                        f"{self._hb_timeout}s)")
+
+            # speculation: duplicate the stragglers
+            if self._speculation and durations:
+                med = sorted(durations)[len(durations) // 2]
+                cut = max(self._spec_mult * med, self._spec_min_s)
+                for att in list(running):
+                    tid = att.spec.task_id
+                    if tid in done or att.runtime <= cut:
+                        continue
+                    if sum(1 for a in running
+                           if a.spec.task_id == tid) > 1:
+                        continue  # already speculating
+                    n = attempts_used.get(tid, 0)
+                    if n >= self._max_attempts:
+                        continue
+                    w = self._pick_worker(running, {att.worker}
+                                          | failed_on[tid])
+                    if w is None or w == att.worker:
+                        continue
+                    attempts_used[tid] = n + 1
+                    self._launch(att.spec, n, w, running)
+                    self._event("speculative_attempt", tid, n, w,
+                                att.runtime,
+                                f"runtime {att.runtime:.2f}s > "
+                                f"{cut:.2f}s cut")
+
+            if running or queue:
+                time.sleep(_POLL_S)

@@ -23,6 +23,7 @@ codecs Arrow IPC defines; snappy is not an IPC codec and is rejected).
 from __future__ import annotations
 
 import concurrent.futures
+import errno
 import os
 import shutil
 import tempfile
@@ -43,20 +44,23 @@ _IPC_CODECS = ("none", "lz4", "zstd")
 
 class _HostWriter(ShuffleWriteHandle):
     def __init__(self, transport: "HostShuffleTransport", shuffle_id: int,
-                 map_id: int):
+                 map_id: int, subdir: Optional[str] = None):
         self._t = transport
         self._sid = shuffle_id
         self._mid = map_id
+        self._subdir = subdir
 
     def write(self, partition_id: int, batch: TpuBatch) -> None:
         self._t._submit(self._sid,
                         lambda: self._t._write_one(self._sid, self._mid,
-                                                   partition_id, batch))
+                                                   partition_id, batch,
+                                                   self._subdir))
 
     def write_unsplit(self, batch: TpuBatch, pids) -> None:
         self._t._submit(self._sid,
                         lambda: self._t._write_map_batch(
-                            self._sid, self._mid, batch, pids))
+                            self._sid, self._mid, batch, pids,
+                            self._subdir))
 
 
 class HostShuffleTransport(ShuffleTransport):
@@ -96,8 +100,10 @@ class HostShuffleTransport(ShuffleTransport):
     def _sdir(self, shuffle_id: int) -> str:
         return os.path.join(self.root, f"s{shuffle_id}")
 
-    def _path(self, sid: int, mid: int, pid: int) -> str:
-        return os.path.join(self._sdir(sid), f"m{mid:05d}_p{pid}.arrow")
+    def _path(self, sid: int, mid: int, pid: int,
+              subdir: Optional[str] = None) -> str:
+        d = subdir if subdir is not None else self._sdir(sid)
+        return os.path.join(d, f"m{mid:05d}_p{pid}.arrow")
 
     def _submit(self, sid: int, fn):
         if self._pool is None:
@@ -114,24 +120,25 @@ class HostShuffleTransport(ShuffleTransport):
             self._futures.setdefault(sid, []).append(self._pool.submit(run))
 
     def _write_rb(self, sid: int, mid: int, pid: int,
-                  rb: pa.RecordBatch) -> None:
-        path = self._path(sid, mid, pid)
+                  rb: pa.RecordBatch,
+                  subdir: Optional[str] = None) -> None:
+        path = self._path(sid, mid, pid, subdir)
         with pa.OSFile(path, "wb") as f, \
                 pa.ipc.new_file(f, rb.schema,
                                 options=self._ipc_options()) as w:
             w.write_batch(rb)
 
     def _write_one(self, sid: int, mid: int, pid: int,
-                   batch: TpuBatch) -> None:
+                   batch: TpuBatch, subdir: Optional[str] = None) -> None:
         from ..columnar.arrow_bridge import device_to_arrow
         rb = device_to_arrow(batch)  # compacts lazy selections
         with self._lock:
             self._schemas.setdefault(sid, batch.schema)
         if rb.num_rows:
-            self._write_rb(sid, mid, pid, rb)
+            self._write_rb(sid, mid, pid, rb, subdir)
 
     def _write_map_batch(self, sid: int, mid: int, batch: TpuBatch,
-                         pids) -> None:
+                         pids, subdir: Optional[str] = None) -> None:
         """ONE download for the whole map batch: the pid lane rides as an
         extra column (so download compaction keeps alignment), then the
         host split is a numpy take per partition."""
@@ -158,15 +165,88 @@ class HostShuffleTransport(ShuffleTransport):
         for p in np.unique(pid_np):
             idx = np.nonzero(pid_np == p)[0]
             part = core.take(pa.array(idx, pa.int64()))
-            self._write_rb(sid, mid, int(p), part)
+            self._write_rb(sid, mid, int(p), part, subdir)
+
+    # --- task-attempt commit protocol --------------------------------------
+    #
+    # Retried/speculated map tasks need atomic, all-or-nothing output:
+    # a zombie attempt must never interleave its partition files with
+    # the winner's. Each attempt writes into a private staging dir and
+    # commits with ONE os.rename onto `<task>.mapout`; POSIX rename
+    # fails when the destination exists non-empty, so exactly one
+    # attempt wins and the loser's files vanish (Spark's
+    # shuffle-output-coordinator / v1 commit-protocol analog).
+
+    def begin_task_attempt(self, shuffle_id: int, task_key: str,
+                           attempt: int) -> str:
+        """Create and return this attempt's private staging dir."""
+        d = os.path.join(self._sdir(shuffle_id),
+                         f"{task_key}.a{attempt}.staging")
+        os.makedirs(d, exist_ok=True)
+        # POSIX rename() succeeds onto an existing EMPTY directory, so a
+        # zero-row map output would let a zombie sibling "win" a second
+        # time — a sentinel keeps a committed .mapout non-empty (readers
+        # only list *_p<N>.arrow, so it is invisible to them)
+        with open(os.path.join(d, ".attempt"), "w") as f:
+            f.write(f"{task_key} a{attempt}")
+        return d
+
+    def commit_task_attempt(self, shuffle_id: int, task_key: str,
+                            attempt: int) -> bool:
+        """Atomically publish the attempt's output; False = a sibling
+        attempt already committed (this attempt was a zombie/loser and
+        its staging dir has been discarded)."""
+        self._drain(shuffle_id)  # settle any outstanding pool writes
+        staging = os.path.join(self._sdir(shuffle_id),
+                               f"{task_key}.a{attempt}.staging")
+        final = os.path.join(self._sdir(shuffle_id), f"{task_key}.mapout")
+        try:
+            os.rename(staging, final)
+            return True
+        except OSError as e:
+            # lost the race (destination committed by a sibling) or the
+            # driver already aborted this attempt (staging gone) — any
+            # other rename failure is real data loss, not a lost race
+            if e.errno in (errno.EEXIST, errno.ENOTEMPTY) \
+                    or not os.path.exists(staging):
+                shutil.rmtree(staging, ignore_errors=True)
+                return False
+            raise
+
+    def abort_task_attempt(self, shuffle_id: int, task_key: str,
+                           attempt: int) -> None:
+        staging = os.path.join(self._sdir(shuffle_id),
+                               f"{task_key}.a{attempt}.staging")
+        shutil.rmtree(staging, ignore_errors=True)
+
+    @staticmethod
+    def committed_partition_files(sdir: str, partition_id: int):
+        """All of a shuffle dir's files for one partition: legacy flat
+        files plus every committed attempt dir — staging dirs are
+        invisible by construction."""
+        suffix = f"_p{partition_id}.arrow"
+        out = []
+        try:
+            names = sorted(os.listdir(sdir))
+        except FileNotFoundError:
+            return out
+        for n in names:
+            p = os.path.join(sdir, n)
+            if n.endswith(suffix):
+                out.append(p)
+            elif n.endswith(".mapout") and os.path.isdir(p):
+                out.extend(os.path.join(p, m) for m in sorted(os.listdir(p))
+                           if m.endswith(suffix))
+        return out
 
     # --- transport interface ----------------------------------------------
 
     def register_shuffle(self, shuffle_id: int, num_partitions: int):
         os.makedirs(self._sdir(shuffle_id), exist_ok=True)
 
-    def writer(self, shuffle_id: int, map_id: int) -> ShuffleWriteHandle:
-        return _HostWriter(self, shuffle_id, map_id)
+    def writer(self, shuffle_id: int, map_id: int,
+               subdir: Optional[str] = None) -> ShuffleWriteHandle:
+        return _HostWriter(self, shuffle_id, map_id, subdir)
 
     def _drain(self, sid: int):
         with self._lock:
@@ -178,11 +258,10 @@ class HostShuffleTransport(ShuffleTransport):
         from ..columnar.arrow_bridge import arrow_to_device
         self._drain(shuffle_id)
         schema = self._schemas.get(shuffle_id)
-        d = self._sdir(shuffle_id)
-        suffix = f"_p{partition_id}.arrow"
-        names = sorted(n for n in os.listdir(d) if n.endswith(suffix))
-        for name in names:
-            with pa.OSFile(os.path.join(d, name), "rb") as f:
+        paths = self.committed_partition_files(self._sdir(shuffle_id),
+                                               partition_id)
+        for path in paths:
+            with pa.OSFile(path, "rb") as f:
                 table = pa.ipc.open_file(f).read_all()
             for rb in table.combine_chunks().to_batches():
                 if rb.num_rows:

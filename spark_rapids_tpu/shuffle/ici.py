@@ -34,6 +34,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax.shard_map moved between JAX releases: top-level alias (>=0.5),
+# jax.experimental before that
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is None:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from ..columnar.batch import TpuBatch, bucket_bytes, bucket_rows
 from ..columnar.column import TpuColumnVector
 from .transport import ShuffleTransport, ShuffleWriteHandle
@@ -171,7 +177,7 @@ def make_ici_all_to_all(mesh: Mesh, axis: str = "x"):
                      tuple(P(axis, None) for _ in ndims),
                      P(axis, None), P(axis),
                      tuple(P(axis, None) for _ in range(n_char)))
-        return jax.jit(jax.shard_map(spmd, mesh=mesh, in_specs=in_specs,
+        return jax.jit(_shard_map(spmd, mesh=mesh, in_specs=in_specs,
                                      out_specs=out_specs))
 
     def fn(datas, valids, pids, live, char_offs=(), char_bytes=(),
@@ -216,7 +222,7 @@ def make_ici_broadcast(mesh: Mesh, axis: str = "x"):
                     tuple(P(axis, None) for _ in ndims), P(axis, None))
         out_specs = (tuple(lane(nd) for nd in ndims),
                      tuple(P(axis, None) for _ in ndims), P(axis, None))
-        return jax.jit(jax.shard_map(spmd, mesh=mesh, in_specs=in_specs,
+        return jax.jit(_shard_map(spmd, mesh=mesh, in_specs=in_specs,
                                      out_specs=out_specs))
 
     def fn(datas, valids, live):

@@ -22,8 +22,8 @@ import os
 import time
 from typing import Dict, Iterator, List, Optional
 
-__all__ = ["EVENT_LOG_DIR", "log_query_event", "read_event_logs",
-           "plan_fingerprint"]
+__all__ = ["EVENT_LOG_DIR", "log_query_event", "log_scheduler_events",
+           "read_event_logs", "plan_fingerprint"]
 
 from ..config import register
 
@@ -85,6 +85,26 @@ def log_query_event(pp, ctx, wall_s: float) -> None:
         "metrics": metrics,
         "conf": {k: str(v) for k, v in pp.conf.items().items()},
         "plan": pp.root.tree_string(),
+    }
+    with open(_app_path(base), "a") as f:
+        f.write(json.dumps(event) + "\n")
+
+
+def log_scheduler_events(conf, query_id: str, sched, wall_s: float) -> None:
+    """Append one scheduler event per cluster query: the attempt
+    timeline (submit/ok/failed/lost/speculative, worker deaths,
+    respawns, blacklists) plus a rollup — what the profiler mines for
+    retry overhead. No-op unless spark.rapids.eventLog.dir is set."""
+    base = conf.get(EVENT_LOG_DIR)
+    if not base:
+        return
+    event = {
+        "type": "scheduler",
+        "ts": time.time(),
+        "query": query_id,
+        "wall_s": round(wall_s, 6),
+        "summary": sched.summary(),
+        "attempts": sched.events,
     }
     with open(_app_path(base), "a") as f:
         f.write(json.dumps(event) + "\n")

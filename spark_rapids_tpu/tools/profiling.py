@@ -72,10 +72,13 @@ def profile_event_logs(path: str) -> str:
     import collections
 
     from .event_log import read_event_logs
-    events = list(read_event_logs(path))
+    all_events = list(read_event_logs(path))
+    sched_events = [ev for ev in all_events
+                    if ev.get("type") == "scheduler"]
+    events = [ev for ev in all_events if ev.get("type") != "scheduler"]
     lines = ["=== TPU profile (event logs) ===",
-             f"events: {len(events)}"]
-    if not events:
+             f"events: {len(events)} query, {len(sched_events)} scheduler"]
+    if not all_events:
         return "\n".join(lines + ["(no events under the given path)"])
 
     # op coverage across every logged plan
@@ -128,7 +131,34 @@ def profile_event_logs(path: str) -> str:
                 f"  {fp}  {min(walls) * 1e3:.1f}ms .. "
                 f"{max(walls) * 1e3:.1f}ms  ({ratio:.1f}x)")
 
+    # scheduler rollup: retry overhead next to the hotspots it hides in
     recs = []
+    if sched_events:
+        tot = collections.Counter()
+        retry_overhead = 0.0
+        cluster_wall = 0.0
+        for ev in sched_events:
+            s = ev.get("summary", {})
+            for k in ("tasks_ok", "failures", "speculative_launched",
+                      "speculative_lost", "workers_respawned",
+                      "workers_blacklisted"):
+                tot[k] += int(s.get(k, 0))
+            retry_overhead += float(s.get("retry_overhead_s", 0.0))
+            cluster_wall += float(ev.get("wall_s", 0.0))
+        lines.append("scheduler (cluster queries):")
+        lines.append(f"  tasks ok {tot['tasks_ok']}, failed attempts "
+                     f"{tot['failures']}, speculative launched "
+                     f"{tot['speculative_launched']} "
+                     f"(lost {tot['speculative_lost']})")
+        lines.append(f"  workers respawned {tot['workers_respawned']}, "
+                     f"blacklisted {tot['workers_blacklisted']}")
+        lines.append(f"  retry overhead {retry_overhead * 1e3:.1f}ms "
+                     f"of {cluster_wall * 1e3:.1f}ms cluster wall")
+        if cluster_wall > 0 and retry_overhead > 0.1 * cluster_wall:
+            recs.append(
+                f"{retry_overhead / max(cluster_wall, 1e-9):.0%} of "
+                "cluster wall went to failed/duplicate attempts — "
+                "check worker stability before tuning kernels")
     spill_total = sum(v for (op, m), v in roll.items()
                       if m == "spillTime")
     if spill_total > 0.1:

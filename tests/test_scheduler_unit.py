@@ -1,0 +1,418 @@
+"""Scheduler unit tests: chaos-rule parsing, the attempt-suffixed
+shuffle commit protocol, and the TaskScheduler retry/blacklist/
+speculation state machine driven through a fake worker pool — no OS
+processes, no JAX. The process-level recovery paths live in
+test_scheduler.py."""
+import os
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.scheduler import TaskScheduler, TaskSpec
+from spark_rapids_tpu.scheduler.chaos import (ChaosRule, find_rule,
+                                              parse_fault_spec)
+
+
+# --- chaos rules -----------------------------------------------------------
+
+def test_chaos_parse_basic():
+    rules = parse_fault_spec(
+        "crash:q1s1m0:0; hang:*m1:*; delay:q2*:1:3.5; crash:t:0@w1")
+    assert rules[0] == ChaosRule("crash", "q1s1m0", 0, 2.0, None)
+    assert rules[1].attempt is None and rules[1].mode == "hang"
+    assert rules[2].seconds == 3.5
+    assert rules[3].worker == 1
+
+
+def test_chaos_parse_empty_and_bad():
+    assert parse_fault_spec("") == []
+    assert parse_fault_spec(None) == []
+    with pytest.raises(ValueError, match="bad injectFaults"):
+        parse_fault_spec("explode:x:0")
+    with pytest.raises(ValueError, match="bad injectFaults"):
+        parse_fault_spec("crash:x")  # missing attempt
+
+
+def test_chaos_matching():
+    spec = "crash:q1s1m0:0; delay:*m1:*:1.0@w1"
+    assert find_rule(spec, 0, "q1s1m0", 0).mode == "crash"
+    assert find_rule(spec, 0, "q1s1m0", 1) is None  # retry runs clean
+    assert find_rule(spec, 1, "q9s3m1", 7).mode == "delay"
+    assert find_rule(spec, 0, "q9s3m1", 7) is None  # wrong worker
+    assert find_rule(spec, 0, "other", 0) is None
+
+
+# --- commit protocol (shuffle/host.py) -------------------------------------
+
+def _rb(vals):
+    return pa.record_batch({"x": pa.array(vals, pa.int64())})
+
+
+def test_commit_first_attempt_wins(tmp_path):
+    from spark_rapids_tpu.shuffle.host import HostShuffleTransport
+    t = HostShuffleTransport(RapidsConf(), threads=0, root=str(tmp_path))
+    t.register_shuffle(1, 2)
+    d0 = t.begin_task_attempt(1, "t0", 0)
+    t._write_rb(1, 0, 0, _rb([1, 2, 3]), subdir=d0)
+    assert t.commit_task_attempt(1, "t0", 0) is True
+    # zombie attempt: full output, commits late, must vanish entirely
+    d1 = t.begin_task_attempt(1, "t0", 1)
+    t._write_rb(1, 0, 0, _rb([9, 9, 9]), subdir=d1)
+    t._write_rb(1, 0, 1, _rb([8]), subdir=d1)
+    assert t.commit_task_attempt(1, "t0", 1) is False
+    assert not os.path.exists(d1)
+    files = t.committed_partition_files(t._sdir(1), 0)
+    assert len(files) == 1 and "t0.mapout" in files[0]
+    with pa.OSFile(files[0], "rb") as f:
+        got = pa.ipc.open_file(f).read_all()
+    assert got.column("x").to_pylist() == [1, 2, 3]
+    # the loser's partition-1 file must not exist anywhere
+    assert t.committed_partition_files(t._sdir(1), 1) == []
+
+
+def test_empty_output_commit_still_exclusive(tmp_path):
+    """rename() succeeds onto an empty dir, so a zero-row map output
+    needs the staging sentinel to keep first-commit-wins exclusive."""
+    from spark_rapids_tpu.shuffle.host import HostShuffleTransport
+    t = HostShuffleTransport(RapidsConf(), threads=0, root=str(tmp_path))
+    t.register_shuffle(1, 1)
+    t.begin_task_attempt(1, "t0", 0)
+    assert t.commit_task_attempt(1, "t0", 0) is True
+    t.begin_task_attempt(1, "t0", 1)  # zombie with empty output too
+    assert t.commit_task_attempt(1, "t0", 1) is False
+    assert t.committed_partition_files(t._sdir(1), 0) == []
+
+
+def test_writer_map_batch_stages_under_subdir(tmp_path):
+    """The real map-task path (writer -> write_unsplit ->
+    _write_map_batch) must honor the attempt staging dir end to end —
+    a flat write here would let concurrent attempts tear each other's
+    partition files."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar.arrow_bridge import arrow_to_device
+    from spark_rapids_tpu.shuffle.host import HostShuffleTransport
+    t = HostShuffleTransport(RapidsConf(), threads=0, root=str(tmp_path))
+    t.register_shuffle(1, 2)
+    d = t.begin_task_attempt(1, "t0", 0)
+    batch = arrow_to_device(_rb([10, 20, 30]))
+    pids = jnp.array([0, 1, 0], jnp.int32)
+    w = t.writer(1, map_id=0, subdir=d)
+    w.write_unsplit(batch, pids)
+    # nothing flat, everything staged
+    flat = [n for n in os.listdir(t._sdir(1)) if n.endswith(".arrow")]
+    assert flat == []
+    assert sorted(os.listdir(d)) == [".attempt", "m00000_p0.arrow",
+                                     "m00000_p1.arrow"]
+    assert t.commit_task_attempt(1, "t0", 0) is True
+    assert len(t.committed_partition_files(t._sdir(1), 0)) == 1
+
+
+def test_staging_invisible_until_commit(tmp_path):
+    from spark_rapids_tpu.shuffle.host import HostShuffleTransport
+    t = HostShuffleTransport(RapidsConf(), threads=0, root=str(tmp_path))
+    t.register_shuffle(1, 1)
+    d = t.begin_task_attempt(1, "t0", 0)
+    t._write_rb(1, 0, 0, _rb([1]), subdir=d)
+    assert t.committed_partition_files(t._sdir(1), 0) == []
+    t.abort_task_attempt(1, "t0", 0)
+    assert not os.path.exists(d)
+
+
+def test_process_shuffle_read_sees_only_committed(tmp_path):
+    from spark_rapids_tpu import datatypes as dt
+    from spark_rapids_tpu.cluster import ProcessShuffleReadExec
+    from spark_rapids_tpu.shuffle.host import HostShuffleTransport
+    t = HostShuffleTransport(RapidsConf(), threads=0, root=str(tmp_path))
+    t.register_shuffle(3, 1)
+    d = t.begin_task_attempt(3, "m0", 0)
+    t._write_rb(3, 0, 0, _rb([4, 5]), subdir=d)
+    t.commit_task_attempt(3, "m0", 0)
+    d = t.begin_task_attempt(3, "m1", 0)  # uncommitted straggler
+    t._write_rb(3, 100000, 0, _rb([7]), subdir=d)
+    schema = dt.Schema([dt.StructField("x", dt.INT64, True)])
+    read = ProcessShuffleReadExec(str(tmp_path), 3, [0], schema)
+    rows = [v for rb in read.execute_cpu(None)
+            for v in rb.column(0).to_pylist()]
+    assert rows == [4, 5]
+
+
+# --- TaskScheduler over a fake pool ----------------------------------------
+
+class FakePool:
+    def __init__(self, n):
+        self.n = n
+        self.dead = set()
+        self.respawned = []
+        self._ts = time.time()
+
+    def alive(self, w):
+        return w not in self.dead
+
+    def exit_info(self, w):
+        return 1, "fake worker death"
+
+    def kill(self, w):
+        self.dead.add(w)
+
+    def respawn(self, w):
+        self.dead.discard(w)
+        self.respawned.append(w)
+
+    def heartbeat_age(self, w):
+        return 0.0
+
+    def spawn_ts(self, w):
+        return self._ts
+
+
+class Responder:
+    """Plays the worker side: polls the tasks dir and answers each new
+    attempt file per `script(task_id, attempt, worker) -> 'ok' | 'err'
+    | None` (None = leave it running)."""
+
+    def __init__(self, tasks_dir, script):
+        self.tasks_dir = tasks_dir
+        self.script = script
+        self._stop = threading.Event()
+        self._seen = set()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(0.01):
+            self.poll()
+
+    def poll(self):
+        for name in sorted(os.listdir(self.tasks_dir)):
+            if not name.endswith(".task") or name in self._seen:
+                continue
+            stem = name[:-len(".task")]
+            tid, a, w = stem.rsplit(".", 2)
+            verdict = self.script(tid, int(a[1:]), int(w[1:]))
+            if verdict is None:
+                continue
+            self._seen.add(name)
+            path = os.path.join(self.tasks_dir, name)
+            with open(path + ".claim", "w") as f:
+                f.write("claimed")
+            with open(path + "." + verdict, "w") as f:
+                f.write("synthetic failure" if verdict == "err" else "ok")
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
+def _conf(**over):
+    base = {
+        "spark.rapids.tpu.task.maxAttempts": 2,
+        "spark.rapids.tpu.task.timeout": 5.0,
+        "spark.rapids.tpu.scheduler.stageTimeout": 10.0,
+        "spark.rapids.tpu.scheduler.maxTaskFailuresPerWorker": 2,
+        "spark.rapids.tpu.heartbeat.timeout": 100.0,
+    }
+    base.update(over)
+    return RapidsConf(base)
+
+
+def _specs(*ids):
+    return [TaskSpec(t, "noop", {"conf": {}}) for t in ids]
+
+
+def test_retry_lands_on_other_worker(tmp_path):
+    def script(tid, attempt, worker):
+        if tid == "t0" and worker == 0:
+            return "err"
+        return "ok"
+
+    pool = FakePool(2)
+    sched = TaskScheduler(pool, str(tmp_path), _conf())
+    r = Responder(str(tmp_path), script)
+    try:
+        sched.run_stage(_specs("t0", "t1"))
+    finally:
+        r.stop()
+    oks = [e for e in sched.events if e["event"] == "task_ok"]
+    assert {e["task"] for e in oks} == {"t0", "t1"}
+    t0_ok = next(e for e in oks if e["task"] == "t0")
+    assert t0_ok["attempt"] == 1 and t0_ok["worker"] == 1
+    assert sched.worker_failures.get(0) == 1
+    assert sched.summary()["failures"] == 1
+
+
+def test_blacklist_after_max_failures(tmp_path):
+    def script(tid, attempt, worker):
+        return "err" if worker == 0 else "ok"
+
+    pool = FakePool(2)
+    sched = TaskScheduler(
+        pool, str(tmp_path),
+        _conf(**{"spark.rapids.tpu.scheduler.maxTaskFailuresPerWorker": 1,
+                 "spark.rapids.tpu.task.maxAttempts": 4}))
+    r = Responder(str(tmp_path), script)
+    try:
+        sched.run_stage(_specs("t0", "t1", "t2"))
+    finally:
+        r.stop()
+    assert 0 in sched.blacklist
+    assert any(e["event"] == "worker_blacklisted" and e["worker"] == 0
+               for e in sched.events)
+    # everything after the blacklist landed on worker 1
+    oks = [e for e in sched.events if e["event"] == "task_ok"]
+    assert len(oks) == 3 and all(e["worker"] == 1 for e in oks)
+
+
+def test_bounded_retry_exhaustion_raises(tmp_path):
+    pool = FakePool(2)
+    sched = TaskScheduler(pool, str(tmp_path), _conf())
+    r = Responder(str(tmp_path), lambda *a: "err")
+    try:
+        with pytest.raises(RuntimeError, match="worker task t0 failed "
+                                               "after 2 attempts"):
+            sched.run_stage(_specs("t0"))
+    finally:
+        r.stop()
+
+
+def test_worker_death_respawns_and_retries(tmp_path):
+    pool = FakePool(2)
+    state = {"killed": False}
+
+    def script(tid, attempt, worker):
+        if tid == "t0" and attempt == 0 and not state["killed"]:
+            state["killed"] = True
+            pool.dead.add(worker)  # process "dies" mid-task
+            return None
+        return "ok"
+
+    sched = TaskScheduler(pool, str(tmp_path), _conf())
+    r = Responder(str(tmp_path), script)
+    try:
+        sched.run_stage(_specs("t0"))
+    finally:
+        r.stop()
+    assert pool.respawned, "dead worker was not respawned"
+    assert any(e["event"] == "worker_respawn" for e in sched.events)
+    assert any(e["event"] == "task_ok" and e["task"] == "t0"
+               and e["attempt"] == 1 for e in sched.events)
+
+
+def test_blacklisted_worker_death_still_detected(tmp_path):
+    """Blacklisting must not blind the liveness loop: an attempt
+    assigned (but never claimed) on a worker that is blacklisted and
+    THEN dies has no claim_ts for the task timeout — only the death
+    check can recover it before the stage deadline."""
+    pool = FakePool(2)
+
+    def script(tid, attempt, worker):
+        if worker == 0:
+            if tid == "t0":
+                return "err"  # one failure -> w0 blacklisted
+            # t2 assigned to w0: kill w0 while it sits unclaimed
+            pool.dead.add(0)
+            return None
+        return "ok"
+
+    sched = TaskScheduler(
+        pool, str(tmp_path),
+        _conf(**{"spark.rapids.tpu.scheduler.maxTaskFailuresPerWorker": 1,
+                 "spark.rapids.tpu.task.maxAttempts": 4,
+                 "spark.rapids.tpu.scheduler.stageTimeout": 8.0}))
+    r = Responder(str(tmp_path), script)
+    t0 = time.time()
+    try:
+        sched.run_stage(_specs("t0", "t1", "t2"))
+    finally:
+        r.stop()
+    wall = time.time() - t0
+    oks = {e["task"] for e in sched.events if e["event"] == "task_ok"}
+    assert oks == {"t0", "t1", "t2"}
+    assert any(e["event"] == "worker_respawn" and e["worker"] == 0
+               for e in sched.events)
+    assert wall < 5.0, f"recovered only via stage deadline ({wall:.1f}s)"
+
+
+def test_speculation_duplicates_straggler(tmp_path):
+    tasks_dir = str(tmp_path)
+    done_b1 = threading.Event()
+
+    def slow_ok_marks():
+        return sum(1 for n in os.listdir(tasks_dir)
+                   if n.startswith("slow.") and n.endswith(".ok"))
+
+    def script(tid, attempt, worker):
+        if tid == "fast":
+            return "ok"
+        if tid == "slow":
+            if attempt == 0:
+                # straggles until the speculative sibling is done, then
+                # completes as a zombie — exactly one of the two .oks
+                # may win, the other must be recorded as lost
+                return "ok" if done_b1.is_set() else None
+            done_b1.set()
+            return "ok"
+        # tail holds the stage open until both slow attempts landed so
+        # the winner/loser bookkeeping is observable deterministically
+        return "ok" if slow_ok_marks() >= 2 else None
+
+    pool = FakePool(2)
+    sched = TaskScheduler(
+        pool, str(tmp_path),
+        _conf(**{"spark.rapids.tpu.speculation": "true",
+                 "spark.rapids.tpu.speculation.multiplier": 1.0,
+                 "spark.rapids.tpu.speculation.minRuntime": 0.1,
+                 "spark.rapids.tpu.task.maxAttempts": 4}))
+    r = Responder(str(tmp_path), script)
+    try:
+        sched.run_stage(_specs("fast", "slow", "tail"))
+    finally:
+        r.stop()
+    assert any(e["event"] == "speculative_attempt" and e["task"] == "slow"
+               for e in sched.events)
+    oks = [e for e in sched.events
+           if e["event"] == "task_ok" and e["task"] == "slow"]
+    lost = [e for e in sched.events
+            if e["event"] == "attempt_lost" and e["task"] == "slow"]
+    assert len(oks) == 1 and len(lost) == 1
+    assert {oks[0]["attempt"], lost[0]["attempt"]} == {0, 1}
+
+
+def test_speculation_win_completes_stage_without_straggler(tmp_path):
+    """The point of speculation is the latency win: once the duplicate
+    commits, the stage must finish WITHOUT waiting out (or killing) the
+    still-running original attempt."""
+    def script(tid, attempt, worker):
+        if tid == "slow" and attempt == 0:
+            return None  # original straggles forever
+        return "ok"
+
+    pool = FakePool(2)
+    sched = TaskScheduler(
+        pool, str(tmp_path),
+        _conf(**{"spark.rapids.tpu.speculation": "true",
+                 "spark.rapids.tpu.speculation.multiplier": 1.0,
+                 "spark.rapids.tpu.speculation.minRuntime": 0.1,
+                 "spark.rapids.tpu.task.timeout": 6.0,
+                 "spark.rapids.tpu.scheduler.stageTimeout": 10.0}))
+    r = Responder(str(tmp_path), script)
+    t0 = time.time()
+    try:
+        sched.run_stage(_specs("fast", "slow"))
+    finally:
+        r.stop()
+    wall = time.time() - t0
+    assert wall < 4.0, f"stage blocked on superseded attempt ({wall:.1f}s)"
+    assert any(e["event"] == "task_ok" and e["task"] == "slow"
+               and e["attempt"] == 1 for e in sched.events)
+    # the straggler's worker was neither killed nor blamed
+    assert not pool.dead and not pool.respawned
+    assert sched.summary()["failures"] == 0
+
+
+def test_speculation_off_by_default(tmp_path):
+    conf = RapidsConf()
+    from spark_rapids_tpu.config import SPECULATION
+    assert conf.get(SPECULATION) is False

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# CI smoke: the gate that keeps a syntax error (or any import-breaking
+# change) out of a seed.  Three escalating checks; fails fast:
+#
+#   1. byte-compile every module           (catches SyntaxError anywhere)
+#   2. import the package                  (catches import-time errors)
+#   3. pytest collection of the full suite (catches collection errors in
+#      tests -- the failure mode that hid the window.py f-string bug)
+#
+# Pass --full to also run the tier-1 suite (see ROADMAP.md), bounded to
+# 870s like the driver's own gate.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== 1/3 compileall =="
+python -m compileall -q spark_rapids_tpu tests
+
+echo "== 2/3 package import =="
+JAX_PLATFORMS=cpu python -c "import spark_rapids_tpu; print('import ok:', spark_rapids_tpu.__name__)"
+
+echo "== 3/3 pytest collection =="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q --collect-only -m 'not slow' \
+    -p no:cacheprovider 2>&1 | tail -3
+
+if [[ "${1:-}" == "--full" ]]; then
+    echo "== tier-1 (full) =="
+    timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+        -m 'not slow' --continue-on-collection-errors -p no:cacheprovider
+fi
+
+echo "smoke OK"
