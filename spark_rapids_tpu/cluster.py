@@ -32,6 +32,7 @@ are pickled BEFORE any execution, so jit caches are empty).
 from __future__ import annotations
 
 import copy
+import json
 import os
 import pickle
 import subprocess
@@ -47,7 +48,13 @@ import pyarrow as pa
 from . import datatypes as dt
 from .config import HEARTBEAT_INTERVAL, INJECT_FAULTS, RapidsConf
 from .exec.base import ExecCtx, LeafExec, TpuExec
+from .obs.metrics import (METRICS_ENABLED, REGISTRY,
+                          flush_worker_metrics, maybe_start_http_server,
+                          read_worker_metrics, render_merged_snapshots)
+from .obs.tracer import (NULL_TRACER, TRACE_DIR, Tracer, tracer_from_conf)
 from .scheduler import TaskScheduler, TaskSpec
+from .shuffle.host import (SHUF_BYTES_FETCHED, SHUF_FETCH_WAIT,
+                           SHUF_PARTS_FETCHED)
 
 __all__ = ["TpuProcessCluster", "ProcessShuffleReadExec",
            "run_process_query"]
@@ -85,27 +92,48 @@ class ProcessShuffleReadExec(LeafExec):
         d = os.path.join(self.shuffle_root, f"s{self.shuffle_id}")
         return HostShuffleTransport.committed_partition_files(d, pid)
 
-    def _host_batches(self):
+    def _host_batches(self, ctx: Optional[ExecCtx] = None):
+        tracer = ctx.tracer if ctx is not None else NULL_TRACER
+        fetched = SHUF_PARTS_FETCHED.labels("process")
+        fbytes = SHUF_BYTES_FETCHED.labels("process")
+        fwait = SHUF_FETCH_WAIT.labels("process")
         for pid in self.partitions:
+            # stream one file at a time (large shuffles must not pin a
+            # whole partition's tables in host memory); the fetch span
+            # covers only blocked-on-IO time and is emitted
+            # retroactively, parented on the enclosing op/task span
+            parent = tracer.current_span_id()
+            t_wall = time.time()
+            io_s = 0.0
             for path in self._files(pid):
+                t1 = time.perf_counter()
                 with pa.OSFile(path, "rb") as f:
                     table = pa.ipc.open_file(f).read_all()
+                dt_io = time.perf_counter() - t1
+                io_s += dt_io
+                fwait.observe(dt_io)
+                fbytes.inc(table.nbytes)
                 for rb in table.combine_chunks().to_batches():
                     if rb.num_rows:
                         yield rb
+            fetched.inc()
+            if tracer.enabled:
+                tracer.emit(
+                    f"shuffle_fetch s{self.shuffle_id} p{pid}",
+                    "shuffle", t_wall, io_s, parent_id=parent)
 
     def execute(self, ctx: ExecCtx):
         from .columnar.arrow_bridge import arrow_to_device
-        for rb in self._host_batches():
+        for rb in self._host_batches(ctx):
             yield arrow_to_device(rb, self._schema)
 
     def execute_cpu(self, ctx: ExecCtx):
-        yield from self._host_batches()
+        yield from self._host_batches(ctx)
 
 
 # --- worker-side task execution (one function per task kind) ---------------
 
-def _run_map_task(payload: Dict) -> None:
+def _run_map_task(payload: Dict, tracer=NULL_TRACER) -> None:
     """Execute a map plan slice and write its partitions as Arrow IPC
     files into an attempt-private staging dir, then commit atomically
     (HostShuffleTransport is the writer; batch i of this slice is map id
@@ -123,20 +151,25 @@ def _run_map_task(payload: Dict) -> None:
     transport.register_shuffle(sid, partitioning.num_partitions)
     staging = transport.begin_task_attempt(sid, task_key, attempt)
     ctx = ExecCtx(conf)
+    ctx.tracer = tracer  # join the driver's trace, not a fresh one
     base = payload["map_id_base"]
     try:
         for i, batch in enumerate(plan.execute(ctx)):
-            pids = partitioning.partition_ids_device(batch, ctx.eval_ctx)
-            writer = transport.writer(sid, base + i, subdir=staging)
-            writer.write_unsplit(batch, pids)
-            writer.close()
+            with tracer.span(f"shuffle_write s{sid} m{base + i}",
+                             cat="shuffle"):
+                pids = partitioning.partition_ids_device(batch,
+                                                         ctx.eval_ctx)
+                writer = transport.writer(sid, base + i, subdir=staging)
+                writer.write_unsplit(batch, pids)
+                writer.close()
     except BaseException:
         transport.abort_task_attempt(sid, task_key, attempt)
         raise
-    transport.commit_task_attempt(sid, task_key, attempt)
+    with tracer.span(f"shuffle_commit s{sid}", cat="shuffle"):
+        transport.commit_task_attempt(sid, task_key, attempt)
 
 
-def _run_collect_task(payload: Dict) -> None:
+def _run_collect_task(payload: Dict, tracer=NULL_TRACER) -> None:
     """Execute a (reduce/final) plan slice on this worker's device and
     publish the result as one Arrow IPC file; the final hard link is the
     commit — first attempt to link wins, a later (speculative/zombie)
@@ -145,6 +178,7 @@ def _run_collect_task(payload: Dict) -> None:
     conf = RapidsConf(payload["conf"])
     plan: TpuExec = payload["plan"]
     ctx = ExecCtx(conf)
+    ctx.tracer = tracer
     rbs = [device_to_arrow(b) for b in plan.execute(ctx)]
     target = arrow_schema(plan.output_schema)
     out = payload["out"]
@@ -166,6 +200,28 @@ def _run_collect_task(payload: Dict) -> None:
 
 
 _TASK_KINDS = {"map": _run_map_task, "collect": _run_collect_task}
+
+
+def _flush_task_obs(root: str, worker_id: int, task_path: str, tracer,
+                    settings: Dict) -> None:
+    """Commit this attempt's spans next to its task file (BEFORE the
+    .ok/.err marker, so the driver's harvest pass finds them) and
+    rewrite the worker's metrics snapshot in the rendezvous. Best
+    effort: observability failures must never fail the task."""
+    try:
+        if tracer.enabled:
+            tmp = task_path + ".spans.tmp"
+            with open(tmp, "w") as f:
+                # dropped count rides along so the driver's stitched
+                # trace reports worker-side drops too
+                json.dump({"spans": tracer.drain(),
+                           "dropped": tracer.dropped}, f)
+            os.replace(tmp, task_path + ".spans")
+        from .config import _to_bool
+        if _to_bool(settings.get(METRICS_ENABLED.key, False)):
+            flush_worker_metrics(root, worker_id)
+    except Exception:  # noqa: BLE001 — observability is best-effort
+        pass
 
 
 class _Heartbeat:
@@ -243,20 +299,36 @@ def worker_main(root: str, worker_id: int, poll_s: float = 0.02,
                 os.replace(err + ".tmp", err)
                 ran = True
                 continue
+            # trace context propagated in the task pickle: this task's
+            # spans join the driver's trace under its attempt span
+            tctx = payload.get("trace")
+            tracer = Tracer(
+                trace_id=tctx["trace_id"], pid=worker_id + 1,
+                max_spans=tctx.get("max_spans", 100_000),
+                id_prefix=f"{payload.get('task_id', 't')}."
+                          f"a{payload.get('attempt', 0)}.") \
+                if tctx else NULL_TRACER
+            settings = payload.get("conf", {}) or {}
             try:
                 with open(path + ".claim.tmp", "w") as f:
                     f.write(f"{worker_id} {time.time()}")
                 os.replace(path + ".claim.tmp", path + ".claim")
-                settings = payload.get("conf", {}) or {}
                 chaos.maybe_inject(
                     settings.get(INJECT_FAULTS.key, ""), worker_id,
                     payload.get("task_id", ""),
                     payload.get("attempt", 0), hb)
-                _TASK_KINDS[kind](payload)
+                with tracer.span(
+                        f"task {payload.get('task_id', '?')} "
+                        f"a{payload.get('attempt', 0)}", cat="task",
+                        parent_id=tctx["parent"] if tctx else None,
+                        args={"kind": kind, "worker": worker_id}):
+                    _TASK_KINDS[kind](payload, tracer)
+                _flush_task_obs(root, worker_id, path, tracer, settings)
                 with open(done + ".tmp", "w") as f:
                     f.write("ok")
                 os.replace(done + ".tmp", done)
             except BaseException:
+                _flush_task_obs(root, worker_id, path, tracer, settings)
                 with open(err + ".tmp", "w") as f:
                     f.write(traceback.format_exc())
                 os.replace(err + ".tmp", err)
@@ -279,6 +351,11 @@ class _WorkerPool:
         self._procs: List[Optional[subprocess.Popen]] = [None] * n
         self._errlogs: List[Optional[Tuple[str, object]]] = [None] * n
         self._spawn_ts = [0.0] * n
+        # last observed (hb mtime, monotonic-at-observation) per worker:
+        # staleness is measured on the driver's monotonic clock from the
+        # moment the beat was SEEN to change, so neither a wall-clock
+        # step nor a filesystem/driver clock skew can fire a respawn
+        self._hb_seen: List[Optional[Tuple[float, float]]] = [None] * n
         for w in range(n):
             self.spawn(w)
 
@@ -294,9 +371,12 @@ class _WorkerPool:
              "--root", self.root, "--worker", str(w),
              "--heartbeat", str(self._hb_interval)],
             env=self._env, stdout=subprocess.DEVNULL, stderr=errf)
-        self._spawn_ts[w] = time.time()
+        # monotonic: the scheduler's first-heartbeat grace must not be
+        # inflated/deflated by wall-clock steps
+        self._spawn_ts[w] = time.monotonic()
         # a fresh incarnation must not look wedged through its
         # predecessor's last (stale) beat
+        self._hb_seen[w] = None
         try:
             os.unlink(self._hb_path(w))
         except OSError:
@@ -341,9 +421,15 @@ class _WorkerPool:
 
     def heartbeat_age(self, w: int) -> Optional[float]:
         try:
-            return time.time() - os.stat(self._hb_path(w)).st_mtime
+            mtime = os.stat(self._hb_path(w)).st_mtime
         except OSError:
             return None  # no beat yet this incarnation
+        seen = self._hb_seen[w]
+        now = time.monotonic()
+        if seen is None or seen[0] != mtime:
+            self._hb_seen[w] = (mtime, now)
+            return 0.0
+        return now - seen[1]
 
     def spawn_ts(self, w: int) -> float:
         return self._spawn_ts[w]
@@ -410,6 +496,10 @@ class TpuProcessCluster:
         # interpreter start (the axon tunnel does) need the worker to
         # re-assert the platform after imports — carried separately
         wenv["RAPIDS_TPU_WORKER_PLATFORM"] = platform
+        # role marker: workers must not race the driver for the
+        # spark.rapids.metrics.port HTTP bind — they flush snapshots
+        # through the rendezvous instead (see obs/metrics.py)
+        wenv["RAPIDS_TPU_IS_WORKER"] = "1"
         if env:
             wenv.update(env)
         self.pool = _WorkerPool(self.root, n_workers, wenv,
@@ -417,6 +507,10 @@ class TpuProcessCluster:
         self._query_seq = 0
         self._sid_seq = 0
         self.last_scheduler: Optional[TaskScheduler] = None
+        self.last_trace_path: Optional[str] = None
+        # the /metrics port belongs to the driver; the cluster driver
+        # never builds an ExecCtx, so bind it here rather than lazily
+        maybe_start_http_server(self.conf)
 
     def shutdown(self) -> None:
         self.pool.shutdown()
@@ -451,19 +545,43 @@ class TpuProcessCluster:
         plan = _strip_aqe_reads(plan)
         self._query_seq += 1
         qid = self._query_seq
+        tracer = tracer_from_conf(conf)
         sched = TaskScheduler(self.pool, os.path.join(self.root, "tasks"),
-                              conf, query_id=f"q{qid}")
+                              conf, query_id=f"q{qid}", tracer=tracer)
         self.last_scheduler = sched
         t0 = time.time()
         try:
-            return self._run_query_stages(plan, conf, settings, qid,
-                                          sched)
+            args = None
+            if tracer.enabled:  # tree-walk + sha1 only when traced
+                from .tools.event_log import plan_fingerprint
+                args = {"fingerprint": plan_fingerprint(plan)}
+            with tracer.span(f"query q{qid}", cat="query", args=args):
+                return self._run_query_stages(plan, conf, settings, qid,
+                                              sched)
         finally:
             # failed queries are exactly the ones whose attempt
-            # timeline the profiler needs — log unconditionally
+            # timeline and trace the profiler needs — emit
+            # unconditionally
+            if tracer.enabled:
+                try:
+                    self.last_trace_path = tracer.write_chrome(
+                        conf.get(TRACE_DIR),
+                        name=f"trace-{tracer.trace_id}-q{qid}.json")
+                except OSError:
+                    pass  # observability must never fail the query
             from .tools.event_log import log_scheduler_events
             log_scheduler_events(conf, f"q{qid}", sched,
                                  time.time() - t0)
+
+    def prometheus_text(self) -> str:
+        """One Prometheus exposition document over the driver's registry
+        plus every worker snapshot flushed through the rendezvous
+        (spark.rapids.metrics.enabled), each series labeled
+        ``proc="driver"|"w<K>"`` — summing across processes is the
+        scraper's job."""
+        tagged = [("driver", REGISTRY.snapshot())]
+        tagged.extend(read_worker_metrics(self.root))
+        return render_merged_snapshots(tagged)
 
     def _run_query_stages(self, plan: TpuExec, conf: RapidsConf,
                           settings: Dict, qid: int,

@@ -73,6 +73,13 @@ class ExecCtx:
         # process-level: concurrent queries share one semaphore + ledger
         # (the reference's GpuSemaphore/RapidsBufferCatalog are singletons)
         self.mm = DeviceMemoryManager.shared(self.conf)
+        # span tracer: the shared no-op unless spark.rapids.trace.dir is
+        # set; cluster workers overwrite this with a tracer joined to
+        # the driver's trace context
+        from ..obs.tracer import tracer_from_conf
+        self.tracer = tracer_from_conf(self.conf)
+        from ..obs.metrics import maybe_start_http_server
+        maybe_start_http_server(self.conf)
 
     def metric(self, node: "TpuExec", name: str) -> TpuMetric:
         m = self.metrics.setdefault(node.node_label(), {})
@@ -256,17 +263,20 @@ def fused_batches(consumer: TpuExec, ctx: ExecCtx, tail_fn=None,
     jitted = entry[0]
     rows = ctx.metric(consumer, "numOutputRows") if ctx.sync_metrics \
         else None
+    label = consumer.node_label()
     for b in node.execute(ctx):
-        t0 = time.perf_counter()
-        # split-and-retry on device OOM: the fused stage re-runs over
-        # batch halves (memory.py; SURVEY.md §5.3 layer 3)
-        outs = ctx.mm.with_retry(b, lambda bb: jitted(bb, ctx.eval_ctx))
-        if ctx.sync_metrics:
-            for out in outs:
-                out.block_until_ready()
-                rows += out.num_rows  # syncs; DEBUG metrics mode only
-        if metric is not None:
-            metric.value += time.perf_counter() - t0
+        with ctx.tracer.span(label, cat="op"):
+            t0 = time.perf_counter()
+            # split-and-retry on device OOM: the fused stage re-runs over
+            # batch halves (memory.py; SURVEY.md §5.3 layer 3)
+            outs = ctx.mm.with_retry(b,
+                                     lambda bb: jitted(bb, ctx.eval_ctx))
+            if ctx.sync_metrics:
+                for out in outs:
+                    out.block_until_ready()
+                    rows += out.num_rows  # syncs; DEBUG metrics only
+            if metric is not None:
+                metric.value += time.perf_counter() - t0
         yield from outs
 
 
@@ -322,10 +332,13 @@ class HostBatchSourceExec(LeafExec):
     def execute(self, ctx):
         rows = ctx.metric(self, "numOutputRows")
         t = ctx.metric(self, "uploadTime")
+        label = self.node_label()
         for rb in self._normalized():
-            t0 = time.perf_counter()
-            b = arrow_to_device(rb, self._schema)
-            t.value += time.perf_counter() - t0
+            with ctx.tracer.span(label, cat="op",
+                                 args={"phase": "upload"}):
+                t0 = time.perf_counter()
+                b = arrow_to_device(rb, self._schema)
+                t.value += time.perf_counter() - t0
             rows += rb.num_rows
             yield b
 

@@ -29,6 +29,7 @@ from typing import Callable, List, Optional
 from .config import (ALLOC_FRACTION, CONCURRENT_TPU_TASKS, OOM_MAX_SPLITS,
                      OOM_RETRY_BLOCKING, OOM_RETRY_ENABLED, RapidsConf,
                      TEST_RETRY_OOM_INJECT, register, _bytes_conv)
+from .obs.metrics import REGISTRY as _METRICS
 
 __all__ = ["DeviceMemoryManager", "SpillableBatch", "TpuRetryOOM",
            "split_batch"]
@@ -38,6 +39,31 @@ DEVICE_BUDGET = register(
     "Device HBM byte budget for the spillable-batch catalog; 0 = auto "
     "(allocFraction x the device's reported memory, 6GiB fallback). "
     "Tests set this low to force spill.", conv=_bytes_conv)
+
+# Live ledger state (gauges follow the shared manager; processes with
+# several isolated managers — OOM-injection tests — report the last
+# writer) plus monotonic pressure counters, scrapeable mid-query.
+_MEM_DEVICE_IN_USE = _METRICS.gauge(
+    "rapids_memory_device_bytes_in_use",
+    "Device bytes the spillable-batch ledger currently charges "
+    "against the HBM budget.")
+_MEM_DEVICE_BUDGET = _METRICS.gauge(
+    "rapids_memory_device_budget_bytes",
+    "Device HBM budget the ledger evicts against "
+    "(spark.rapids.memory.device.budgetBytes, resolved).")
+_MEM_HOST_IN_USE = _METRICS.gauge(
+    "rapids_memory_host_bytes_in_use",
+    "Host-tier bytes held by spilled batches.")
+_MEM_SPILL_BYTES = _METRICS.counter(
+    "rapids_memory_spill_bytes_total",
+    "Total bytes ever spilled device -> host.")
+_MEM_DISK_SPILL_BYTES = _METRICS.counter(
+    "rapids_memory_disk_spill_bytes_total",
+    "Total bytes ever tiered host -> disk.")
+_MEM_OOM_RETRIES = _METRICS.counter(
+    "rapids_memory_oom_retries_total",
+    "Device OOM events answered by split-and-retry (each splits one "
+    "batch in half and reruns).")
 
 
 class TpuRetryOOM(RuntimeError):
@@ -162,6 +188,8 @@ class SpillableBatch:
                 self._mgr.device_bytes -= self.nbytes
                 self._mgr.spill_bytes += self.nbytes
                 self._mgr.host_bytes += self.host_nbytes
+            _MEM_SPILL_BYTES.inc(self.nbytes)
+            self._mgr._sync_gauges()
         finally:
             self._state_lock.release()
         if cascade:
@@ -195,6 +223,8 @@ class SpillableBatch:
             with self._mgr._lock:
                 self._mgr.host_bytes -= self.host_nbytes
                 self._mgr.disk_spill_bytes += self.host_nbytes
+            _MEM_DISK_SPILL_BYTES.inc(self.host_nbytes)
+            self._mgr._sync_gauges()
         finally:
             self._state_lock.release()
 
@@ -330,6 +360,14 @@ class DeviceMemoryManager:
         self._mem_debug = self.conf.get(MEM_DEBUG) == "STDOUT"
         self._leak_debug = self.conf.get(LEAK_DEBUG)
         self._alloc_sites: dict = {}  # id -> traceback summary
+        _MEM_DEVICE_BUDGET.set(self.budget)
+        self._sync_gauges()
+
+    def _sync_gauges(self):
+        """Publish the ledger to the process registry — plain attribute
+        writes, cheap enough to run on every transition."""
+        _MEM_DEVICE_IN_USE.set(self.device_bytes)
+        _MEM_HOST_IN_USE.set(self.host_bytes)
 
     def _debug(self, event: str, sb: "SpillableBatch"):
         if self._mem_debug:
@@ -384,6 +422,7 @@ class DeviceMemoryManager:
                 self._alloc_sites[id(sb)] = "".join(
                     traceback.format_stack(limit=6)[:-1]).strip()
         self._evict_to_fit(exclude=id(sb) if pinned else None)
+        self._sync_gauges()
         self._debug("register", sb)
         return sb
 
@@ -396,6 +435,7 @@ class DeviceMemoryManager:
         # acquire on an RLock would succeed — the batch would tier
         # itself to disk mid-re-upload and skew the host ledger
         self._evict_to_fit(exclude=id(sb))
+        self._sync_gauges()
 
     def _touch(self, sb: SpillableBatch):
         with self._lock:
@@ -411,6 +451,7 @@ class DeviceMemoryManager:
                     self.host_bytes -= sb.host_nbytes
             self._pin_counts.pop(id(sb), None)
             self._alloc_sites.pop(id(sb), None)
+        self._sync_gauges()
         self._debug("release", sb)
 
     def _evict_host_to_disk(self, exclude: Optional[int] = None):
@@ -526,6 +567,7 @@ class DeviceMemoryManager:
             if not self._retry_enabled or depth >= self.max_splits \
                     or not _is_oom_error(e):
                 raise
+            _MEM_OOM_RETRIES.inc()
             b1, b2 = split_batch(batch)
             out = self.with_retry(b1, fn, depth + 1)
             out.extend(self.with_retry(b2, fn, depth + 1))

@@ -26,6 +26,31 @@ from typing import Dict, Iterator, List, Optional, Tuple
 __all__ = ["columnar_rdd", "to_feature_matrix", "to_torch"]
 
 
+def _ml_query_span(pp, ctx):
+    """The root query span collect() gets from the planner — the ML
+    execute loop needs the same so its trace stitches under one root."""
+    if not ctx.tracer.enabled:
+        import contextlib
+        return contextlib.nullcontext()
+    from .tools.event_log import plan_fingerprint
+    return ctx.tracer.span("query", cat="query",
+                           args={"fingerprint": plan_fingerprint(pp.root)})
+
+
+def _emit_ml_query_event(pp, ctx, wall_s: float) -> None:
+    """The end-of-query observability collect() performs: write the
+    Chrome trace this event's embedded summary references, then append
+    the query event. Best effort — never fails the ML handoff."""
+    if ctx.tracer.enabled:
+        from .obs.tracer import TRACE_DIR
+        try:
+            ctx.tracer.write_chrome(pp.conf.get(TRACE_DIR))
+        except OSError:
+            pass
+    from .tools.event_log import log_query_event
+    log_query_event(pp, ctx, wall_s)
+
+
 def columnar_rdd(df) -> Iterator[Dict[str, object]]:
     """Execute the DataFrame's plan on device and yield per-batch
     column dicts of jax.Arrays, padded to the batch capacity with
@@ -33,15 +58,19 @@ def columnar_rdd(df) -> Iterator[Dict[str, object]]:
     data lane + `<name>__valid`; string/binary columns contribute
     `<name>__offsets` + `<name>__chars` + `<name>__valid` (the ragged
     Arrow layout — still jax.Arrays, never wrapper objects)."""
+    import time as _time
+
     from .exec.base import ExecCtx
     from .ops.gather import ensure_compacted
     pp = df._plan()
     ctx = ExecCtx(df._session.conf)
+    _t0 = _time.perf_counter()
     # same lifecycle as collect_arrow: device admission for the whole
     # iteration, cleanups (shared-exchange handles) even on abandonment,
     # deferred device checks raised at the natural end-of-stream sync
     try:
-        with ctx.mm.task_slot():  # admission control (GpuSemaphore analog)
+        with _ml_query_span(pp, ctx), \
+                ctx.mm.task_slot():  # admission (GpuSemaphore analog)
             for batch in pp.root.execute(ctx):
                 batch = ensure_compacted(batch)
                 out: Dict[str, object] = {"row_count": batch.row_count}
@@ -64,6 +93,10 @@ def columnar_rdd(df) -> Iterator[Dict[str, object]]:
     finally:
         ctx.run_cleanups()
     ctx.check_deferred()
+    # ML pipelines must be visible to the qualification/profiling
+    # tools too: collect() never runs on this path, so emit the query
+    # event here (completed iterations only, mirroring collect())
+    _emit_ml_query_event(pp, ctx, _time.perf_counter() - _t0)
 
 
 def to_feature_matrix(df, feature_cols: List[str],
@@ -73,6 +106,8 @@ def to_feature_matrix(df, feature_cols: List[str],
     executed plan; nulls become 0.0 with the row kept (the reference's
     DMatrix treats missing via a sentinel; mask columns are available
     through columnar_rdd for trainers that model missingness)."""
+    import time as _time
+
     import jax.numpy as jnp
 
     from .ops.concat import concat_batches
@@ -80,8 +115,10 @@ def to_feature_matrix(df, feature_cols: List[str],
     from .ops.gather import ensure_compacted
     pp = df._plan()
     ctx = ExecCtx(df._session.conf)
+    _t0 = _time.perf_counter()
     try:
-        with ctx.mm.task_slot():  # admission control (GpuSemaphore analog)
+        with _ml_query_span(pp, ctx), \
+                ctx.mm.task_slot():  # admission (GpuSemaphore analog)
             batches = [ensure_compacted(b)
                        for b in pp.root.execute(ctx)]
     except BaseException:
@@ -90,6 +127,7 @@ def to_feature_matrix(df, feature_cols: List[str],
     finally:
         ctx.run_cleanups()
     ctx.check_deferred()
+    _emit_ml_query_event(pp, ctx, _time.perf_counter() - _t0)
     if not batches:
         raise ValueError("empty input")
     big = batches[0] if len(batches) == 1 else concat_batches(batches)

@@ -1,0 +1,34 @@
+"""Observability layer: distributed tracing + live metrics.
+
+The reference's observability story is a GpuMetric surface plus offline
+event-log miners (SURVEY.md §2.2-F, §5.1). After the fault-tolerant
+scheduler, the interesting behavior — retries, respawns, speculation,
+spill cascades, shuffle waits — happens *across processes*; this package
+makes it visible live:
+
+- ``tracer``  — span-based distributed tracing. Driver query/stage/
+  operator spans, scheduler attempt spans, and worker-side spans joined
+  through a trace context (trace_id + parent span id) propagated in
+  ``TaskSpec`` payloads and committed alongside task output, so the
+  driver stitches ONE coherent Chrome ``trace_event`` JSON per query
+  (chrome://tracing / Perfetto).
+- ``metrics`` — a process-wide MetricsRegistry (counters / gauges /
+  histograms with bounded label sets) exposed as Prometheus text via
+  ``dump_prometheus`` and an optional HTTP endpoint
+  (``spark.rapids.metrics.port``); cluster workers flush snapshots
+  through the filesystem rendezvous for driver-side aggregation.
+
+Everything is off by default and near-zero overhead when disabled:
+the null tracer's ``span()`` is a shared no-op context manager and
+registry updates are plain attribute arithmetic.
+"""
+from .tracer import (NULL_TRACER, Span, Tracer, TRACE_DIR, TRACE_MAX_SPANS,
+                     tracer_from_conf)
+from .metrics import (METRICS_ENABLED, METRICS_PORT, MetricsRegistry,
+                      REGISTRY, dump_prometheus, maybe_start_http_server,
+                      render_merged_snapshots)
+
+__all__ = ["NULL_TRACER", "Span", "Tracer", "TRACE_DIR", "TRACE_MAX_SPANS",
+           "tracer_from_conf", "METRICS_ENABLED", "METRICS_PORT",
+           "MetricsRegistry", "REGISTRY", "dump_prometheus",
+           "maybe_start_http_server", "render_merged_snapshots"]

@@ -170,30 +170,45 @@ class PhysicalPlan:
             tracer = jax.profiler.trace(prof_dir)
         else:
             tracer = contextlib.nullcontext()
-        with tracer:
-            if self.root_on_device:
+        from .tools.event_log import plan_fingerprint
+        qspan = ctx.tracer.span(
+            "query", cat="query",
+            args={"fingerprint": plan_fingerprint(self.root)}) \
+            if ctx.tracer.enabled else contextlib.nullcontext()
+        try:
+            with tracer, qspan:
+                if self.root_on_device:
+                    try:
+                        with ctx.mm.task_slot():  # GpuSemaphore admission
+                            rbs = [device_to_arrow(b)
+                                   for b in self.root.execute(ctx)]
+                    except BaseException:
+                        ctx.discard_deferred()  # dead query's flags
+                        raise
+                    finally:
+                        ctx.run_cleanups()
+                    ctx.check_deferred()  # downloads were the sync point
+                else:
+                    # CPU-rooted plans can still contain device islands
+                    # (under DeviceToHostExec): their cleanups and
+                    # deferred device checks must run here too
+                    try:
+                        rbs = list(self.root.execute_cpu(ctx))
+                    except BaseException:
+                        ctx.discard_deferred()
+                        raise
+                    finally:
+                        ctx.run_cleanups()
+                    ctx.check_deferred()
+        finally:
+            # failed queries are exactly the ones whose timeline is
+            # needed; a trace-dir write failure must never fail a query
+            if ctx.tracer.enabled:
+                from .obs.tracer import TRACE_DIR
                 try:
-                    with ctx.mm.task_slot():  # GpuSemaphore admission
-                        rbs = [device_to_arrow(b)
-                               for b in self.root.execute(ctx)]
-                except BaseException:
-                    ctx.discard_deferred()  # dead query's flags
-                    raise
-                finally:
-                    ctx.run_cleanups()
-                ctx.check_deferred()  # downloads were the sync point
-            else:
-                # CPU-rooted plans can still contain device islands
-                # (under DeviceToHostExec): their cleanups and deferred
-                # device checks must run here too
-                try:
-                    rbs = list(self.root.execute_cpu(ctx))
-                except BaseException:
-                    ctx.discard_deferred()
-                    raise
-                finally:
-                    ctx.run_cleanups()
-                ctx.check_deferred()
+                    ctx.tracer.write_chrome(self.conf.get(TRACE_DIR))
+                except OSError:
+                    pass
         from .tools.event_log import log_query_event
         log_query_event(self, ctx, _time.perf_counter() - _t0)
         return pa.Table.from_batches(rbs, schema=schema)

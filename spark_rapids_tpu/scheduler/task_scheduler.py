@@ -28,6 +28,7 @@ log so tools/profiling.py can report retry overhead next to hotspots.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import pickle
 import time
@@ -37,11 +38,21 @@ from ..config import (HEARTBEAT_TIMEOUT, MAX_TASK_FAILURES_PER_WORKER,
                       MAX_WORKER_RESPAWNS, RapidsConf, SPECULATION,
                       SPECULATION_MIN_RUNTIME, SPECULATION_MULTIPLIER,
                       STAGE_TIMEOUT, TASK_MAX_ATTEMPTS, TASK_TIMEOUT)
+from ..obs.metrics import REGISTRY as _METRICS
+from ..obs.tracer import NULL_TRACER
 
 __all__ = ["TaskSpec", "TaskScheduler"]
 
 _POLL_S = 0.02
 _FIRST_BEAT_GRACE_S = 60.0  # interpreter + jax import before beat 1
+
+# live scheduler health, scrapeable mid-query (the event list is only
+# mined after the fact)
+_SCHED_EVENTS = _METRICS.counter(
+    "rapids_scheduler_events_total",
+    "Task scheduler lifecycle events by type: task_submitted / task_ok "
+    "/ task_failed / attempt_lost / speculative_attempt / "
+    "worker_respawn / worker_blacklisted.", ("event",))
 
 
 @dataclasses.dataclass
@@ -54,19 +65,28 @@ class TaskSpec:
 
 
 class _Attempt:
+    # duration/timeout math runs on time.monotonic() so a wall-clock
+    # step (NTP, manual set) can't fire spurious timeouts or respawns;
+    # submit_wall exists only for event/span timestamps
     def __init__(self, spec: TaskSpec, number: int, worker: int,
                  path: str):
         self.spec = spec
         self.number = number
         self.worker = worker
         self.path = path
-        self.submit_ts = time.time()
-        self.claim_ts: Optional[float] = None
+        self.submit_ts = time.monotonic()
+        self.submit_wall = time.time()
+        self.claim_ts: Optional[float] = None  # monotonic
         self.state = "running"  # running | ok | err | lost
 
     @property
     def runtime(self) -> float:
-        return time.time() - (self.claim_ts or self.submit_ts)
+        return time.monotonic() - (self.claim_ts or self.submit_ts)
+
+    @property
+    def age(self) -> float:
+        """Submit-to-now wall span for the attempt's trace span."""
+        return time.monotonic() - self.submit_ts
 
 
 class TaskScheduler:
@@ -78,11 +98,13 @@ class TaskScheduler:
     """
 
     def __init__(self, pool, tasks_dir: str, conf: RapidsConf,
-                 query_id: str = "q"):
+                 query_id: str = "q", tracer=NULL_TRACER):
         self.pool = pool
         self.tasks_dir = tasks_dir
         self.conf = conf
         self.query_id = query_id
+        self.tracer = tracer
+        self._stage_span_id: Optional[str] = None
         self.events: List[Dict] = []
         self.worker_failures: Dict[int, int] = {}
         self.blacklist: set = set()
@@ -105,6 +127,49 @@ class TaskScheduler:
             "ts": time.time(), "event": event, "task": task,
             "attempt": attempt, "worker": worker,
             "wall_s": round(wall_s, 6), "reason": reason[-500:]})
+        _SCHED_EVENTS.labels(event).inc()
+
+    # --- tracing ----------------------------------------------------------
+
+    @staticmethod
+    def attempt_span_id(task_id: str, number: int) -> str:
+        """Deterministic id: workers parent their task spans onto the
+        attempt span BEFORE the driver emits it (at harvest)."""
+        return f"{task_id}.a{number}"
+
+    def _close_attempt_span(self, att: _Attempt, state: str,
+                            reason: str = ""):
+        """Retroactive driver-side span covering submit -> retirement,
+        on the worker's trace track (the attempt ran there)."""
+        if not self.tracer.enabled:
+            return
+        args = {"worker": att.worker, "state": state}
+        if reason:
+            args["reason"] = reason[-200:]
+        self.tracer.emit(
+            f"attempt {att.spec.task_id} a{att.number}", "attempt",
+            att.submit_wall, att.age,
+            span_id=self.attempt_span_id(att.spec.task_id, att.number),
+            parent_id=self._stage_span_id, pid=att.worker + 1, args=args)
+
+    def _absorb_worker_spans(self, att: _Attempt):
+        """Pull in the span file the worker committed next to its
+        .ok/.err marker; a crashed worker simply has none."""
+        if not self.tracer.enabled:
+            return
+        try:
+            with open(att.path + ".spans") as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return
+        if isinstance(doc, dict):
+            self.tracer.absorb(doc.get("spans") or [])
+            # worker-side drops surface in the stitched trace's
+            # dropped_spans (check_obs_output keys parent-linkage
+            # strictness off it)
+            self.tracer.dropped += int(doc.get("dropped", 0) or 0)
+        else:  # bare span list (older flush shape)
+            self.tracer.absorb(doc)
 
     def summary(self) -> Dict:
         """Rollup for the query event log / profiler."""
@@ -190,6 +255,14 @@ class TaskScheduler:
         payload = dict(spec.payload)
         payload["task_id"] = spec.task_id
         payload["attempt"] = number
+        if self.tracer.enabled:
+            # trace context rides the task pickle: the worker's spans
+            # join the driver's trace under this attempt's span, and
+            # the worker's span buffer honors the same bound
+            payload["trace"] = {
+                "trace_id": self.tracer.trace_id,
+                "parent": self.attempt_span_id(spec.task_id, number),
+                "max_spans": self.tracer.max_spans}
         name = f"{spec.task_id}.a{number}.w{worker}.task"
         path = os.path.join(self.tasks_dir, name)
         with open(path + ".tmp", "wb") as f:
@@ -205,7 +278,17 @@ class TaskScheduler:
                   stage_label: str = "stage") -> None:
         """Run every spec to a committed ``.ok``; raises RuntimeError /
         TimeoutError when retries, respawns, or the stage clock run out."""
-        deadline = time.time() + self._stage_timeout
+        with self.tracer.span(f"stage {stage_label}", cat="stage",
+                              args={"tasks": len(specs)}) as sp:
+            self._stage_span_id = getattr(sp, "span_id", None)
+            try:
+                self._run_stage(specs, stage_label)
+            finally:
+                self._stage_span_id = None
+
+    def _run_stage(self, specs: Sequence[TaskSpec],
+                   stage_label: str) -> None:
+        deadline = time.monotonic() + self._stage_timeout
         running: List[_Attempt] = []
         done: set = set()
         attempts_used: Dict[str, int] = {}
@@ -216,6 +299,7 @@ class TaskScheduler:
         def fail_attempt(att: _Attempt, reason: str, worker_fault: bool):
             att.state = "err"
             running.remove(att)
+            self._close_attempt_span(att, "err", reason)
             w = att.worker
             if worker_fault:
                 self.worker_failures[w] = self.worker_failures.get(w, 0) + 1
@@ -266,7 +350,7 @@ class TaskScheduler:
                                 for a in running)
 
         while outstanding():
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 pending = sorted({a.spec.task_id for a in running
                                   if a.spec.task_id not in done}
                                  | {s.task_id for s in queue})
@@ -304,20 +388,23 @@ class TaskScheduler:
                     # pass already retired this snapshot entry
                 if att.claim_ts is None and os.path.exists(
                         att.path + ".claim"):
-                    att.claim_ts = time.time()
+                    att.claim_ts = time.monotonic()
                 if os.path.exists(att.path + ".ok"):
                     att.state = "ok"
                     running.remove(att)
+                    self._absorb_worker_spans(att)
                     tid = att.spec.task_id
                     if tid in done:
                         # zombie / speculation loser: completed after a
                         # sibling already won the commit race
                         att.state = "lost"
+                        self._close_attempt_span(att, "lost")
                         self._event("attempt_lost", tid, att.number,
                                     att.worker, att.runtime)
                     else:
                         done.add(tid)
                         durations.append(att.runtime)
+                        self._close_attempt_span(att, "ok")
                         self._event("task_ok", tid, att.number,
                                     att.worker, att.runtime)
                 elif os.path.exists(att.path + ".err"):
@@ -326,6 +413,7 @@ class TaskScheduler:
                             tb = f.read()
                     except OSError:
                         tb = "(unreadable .err)"
+                    self._absorb_worker_spans(att)
                     fail_attempt(att, tb, worker_fault=True)
                 elif att.claim_ts is not None \
                         and att.spec.task_id in done:
@@ -333,7 +421,8 @@ class TaskScheduler:
                     # spend respawn budget) over an attempt whose result
                     # no longer matters
                 elif att.claim_ts is not None \
-                        and time.time() - att.claim_ts > self._task_timeout:
+                        and time.monotonic() - att.claim_ts \
+                        > self._task_timeout:
                     self.pool.kill(att.worker)
                     handle_worker_loss(
                         att.worker,
@@ -358,7 +447,9 @@ class TaskScheduler:
                     continue
                 age = self.pool.heartbeat_age(w)
                 if age is None:
-                    grace = time.time() - self.pool.spawn_ts(w)
+                    # spawn_ts is monotonic (see _WorkerPool.spawn) so a
+                    # wall-clock step can't kill a starting worker
+                    grace = time.monotonic() - self.pool.spawn_ts(w)
                     if grace > max(self._hb_timeout, _FIRST_BEAT_GRACE_S):
                         self.pool.kill(w)
                         handle_worker_loss(

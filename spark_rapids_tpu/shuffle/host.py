@@ -35,11 +35,39 @@ import pyarrow as pa
 
 from ..config import (RapidsConf, SHUFFLE_COMPRESSION, SHUFFLE_THREADS)
 from ..columnar.batch import TpuBatch
+from ..obs.metrics import REGISTRY as _METRICS
 from .transport import ShuffleTransport, ShuffleWriteHandle
 
-__all__ = ["HostShuffleTransport"]
+__all__ = ["HostShuffleTransport", "SHUF_PARTS_WRITTEN",
+           "SHUF_BYTES_WRITTEN", "SHUF_PARTS_FETCHED",
+           "SHUF_BYTES_FETCHED", "SHUF_FETCH_WAIT"]
 
 _IPC_CODECS = ("none", "lz4", "zstd")
+
+# Live shuffle health, shared by every transport through a `transport`
+# label (host = in-process file shuffle, process = the cluster's
+# ProcessShuffleReadExec, ici = the device-mesh collective). The
+# per-query TpuMetric surface is mined after the fact; these are
+# scrapeable mid-query via obs.metrics.
+SHUF_PARTS_WRITTEN = _METRICS.counter(
+    "rapids_shuffle_partitions_written_total",
+    "Shuffle partition files (or collective blocks) written.",
+    ("transport",))
+SHUF_BYTES_WRITTEN = _METRICS.counter(
+    "rapids_shuffle_bytes_written_total",
+    "Bytes of shuffle output written (serialized size).",
+    ("transport",))
+SHUF_PARTS_FETCHED = _METRICS.counter(
+    "rapids_shuffle_partitions_fetched_total",
+    "Shuffle partitions fetched by the read side.", ("transport",))
+SHUF_BYTES_FETCHED = _METRICS.counter(
+    "rapids_shuffle_bytes_fetched_total",
+    "Bytes of shuffle input fetched (deserialized size).",
+    ("transport",))
+SHUF_FETCH_WAIT = _METRICS.histogram(
+    "rapids_shuffle_fetch_wait_seconds",
+    "Time the read side blocked waiting for shuffle data (file reads "
+    "or collective realization).", ("transport",))
 
 
 class _HostWriter(ShuffleWriteHandle):
@@ -127,6 +155,8 @@ class HostShuffleTransport(ShuffleTransport):
                 pa.ipc.new_file(f, rb.schema,
                                 options=self._ipc_options()) as w:
             w.write_batch(rb)
+        SHUF_PARTS_WRITTEN.labels("host").inc()
+        SHUF_BYTES_WRITTEN.labels("host").inc(rb.nbytes)
 
     def _write_one(self, sid: int, mid: int, pid: int,
                    batch: TpuBatch, subdir: Optional[str] = None) -> None:
@@ -255,14 +285,22 @@ class HostShuffleTransport(ShuffleTransport):
             f.result()  # re-raise writer errors on the reader
 
     def read_partition(self, shuffle_id: int, partition_id: int):
+        import time as _time
         from ..columnar.arrow_bridge import arrow_to_device
-        self._drain(shuffle_id)
+        t0 = _time.perf_counter()
+        self._drain(shuffle_id)  # the multithreaded-writer wait
         schema = self._schemas.get(shuffle_id)
         paths = self.committed_partition_files(self._sdir(shuffle_id),
                                                partition_id)
+        SHUF_FETCH_WAIT.labels("host").observe(_time.perf_counter() - t0)
+        SHUF_PARTS_FETCHED.labels("host").inc()
         for path in paths:
+            t1 = _time.perf_counter()
             with pa.OSFile(path, "rb") as f:
                 table = pa.ipc.open_file(f).read_all()
+            SHUF_FETCH_WAIT.labels("host").observe(
+                _time.perf_counter() - t1)
+            SHUF_BYTES_FETCHED.labels("host").inc(table.nbytes)
             for rb in table.combine_chunks().to_batches():
                 if rb.num_rows:
                     yield arrow_to_device(rb, schema)

@@ -741,10 +741,13 @@ class IciShuffleTransport(ShuffleTransport):
         return _IciWriter(self, shuffle_id, map_id)
 
     def read_partition(self, shuffle_id: int, partition_id: int):
+        from .host import SHUF_BYTES_FETCHED, SHUF_PARTS_FETCHED
         self._realize(shuffle_id)
         nparts = self._nparts.get(shuffle_id, self.ndev)
+        SHUF_PARTS_FETCHED.labels("ici").inc()
         for b in self._results.get(shuffle_id, [[]] * nparts)[
                 partition_id]:
+            SHUF_BYTES_FETCHED.labels("ici").inc(b.device_size_bytes())
             yield b
 
     def unregister_shuffle(self, shuffle_id: int):
@@ -757,6 +760,7 @@ class IciShuffleTransport(ShuffleTransport):
     # -- the collective epochs --------------------------------------------
 
     def _realize(self, sid: int):
+        import time as _time
         with self._lock:
             if sid in self._results:
                 return
@@ -765,10 +769,19 @@ class IciShuffleTransport(ShuffleTransport):
         # stable sort by map id: deterministic epoch schedule, arrival
         # order preserved within a map task's batches
         blocks.sort(key=lambda e: e[0])
+        t0 = _time.perf_counter()
         results: List[List[TpuBatch]] = [[] for _ in range(nparts)]
         for e0 in range(0, len(blocks), self.ndev):
             self._run_epoch(blocks[e0:e0 + self.ndev], nparts, results,
                             sid)
+        if blocks:
+            from .host import (SHUF_BYTES_WRITTEN, SHUF_FETCH_WAIT,
+                               SHUF_PARTS_WRITTEN)
+            SHUF_FETCH_WAIT.labels("ici").observe(
+                _time.perf_counter() - t0)
+            SHUF_PARTS_WRITTEN.labels("ici").inc(len(blocks))
+            SHUF_BYTES_WRITTEN.labels("ici").inc(
+                sum(b.device_size_bytes() for _, b, _ in blocks))
         with self._lock:
             self._results[sid] = results
             self._pending.pop(sid, None)

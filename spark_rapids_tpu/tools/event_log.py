@@ -86,6 +86,11 @@ def log_query_event(pp, ctx, wall_s: float) -> None:
         "conf": {k: str(v) for k, v in pp.conf.items().items()},
         "plan": pp.root.tree_string(),
     }
+    tr = getattr(ctx, "tracer", None) if ctx is not None else None
+    if tr is not None and getattr(tr, "enabled", False):
+        # span rollup (counts + seconds per category, trace_id) so the
+        # profiler can tie this event to its Chrome trace file
+        event["trace"] = tr.summary()
     with open(_app_path(base), "a") as f:
         f.write(json.dumps(event) + "\n")
 
@@ -106,6 +111,9 @@ def log_scheduler_events(conf, query_id: str, sched, wall_s: float) -> None:
         "summary": sched.summary(),
         "attempts": sched.events,
     }
+    tr = getattr(sched, "tracer", None)
+    if tr is not None and getattr(tr, "enabled", False):
+        event["trace"] = tr.summary()
     with open(_app_path(base), "a") as f:
         f.write(json.dumps(event) + "\n")
 

@@ -9,9 +9,10 @@ the annotated plan plus ranked hotspots and recommendations.
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Dict, List, Optional
 
-__all__ = ["profile_report", "profile_event_logs"]
+__all__ = ["profile_report", "profile_event_logs", "critical_path",
+           "profile_trace"]
 
 
 def profile_report(pp, ctx=None) -> str:
@@ -23,12 +24,19 @@ def profile_report(pp, ctx=None) -> str:
         lines.append("(no metrics: run collect() first)")
         return "\n".join(lines)
 
-    # ranked hotspots by opTime
-    hot = []
+    # ranked hotspots by opTime, merged across instance labels: AQE
+    # re-planning deep-copies re-used sub-plans (a reused exchange gets
+    # a fresh #id per use), which showed as duplicate rows — merge
+    # same-operator instances before ranking
+    merged: Dict[str, List[float]] = {}
     for label, ms in ctx.metrics.items():
         t = ms.get("opTime")
         if t is not None and t.value:
-            hot.append((t.value, label))
+            agg = merged.setdefault(label.split("#", 1)[0], [0.0, 0])
+            agg[0] += t.value
+            agg[1] += 1
+    hot = [(v[0], f"{op} (x{v[1]})" if v[1] > 1 else op)
+           for op, v in merged.items()]
     hot.sort(reverse=True)
     if hot:
         lines.append("hotspots:")
@@ -159,6 +167,20 @@ def profile_event_logs(path: str) -> str:
                 f"{retry_overhead / max(cluster_wall, 1e-9):.0%} of "
                 "cluster wall went to failed/duplicate attempts — "
                 "check worker stability before tuning kernels")
+    # trace rollups from embedded span summaries (queries that ran with
+    # spark.rapids.trace.dir set; the full timeline is in the trace
+    # JSON — `profiling <trace.json>` mines its critical path)
+    tr_cats = collections.defaultdict(lambda: [0, 0.0])
+    for ev in all_events:
+        for cat, c in (ev.get("trace", {}).get("by_cat") or {}).items():
+            tr_cats[cat][0] += int(c.get("spans", 0))
+            tr_cats[cat][1] += float(c.get("total_s", 0.0))
+    if tr_cats:
+        lines.append("trace span rollup (by category):")
+        for cat, (n, tot) in sorted(tr_cats.items(),
+                                    key=lambda kv: -kv[1][1]):
+            lines.append(f"  {cat:<12} {n:5d} spans {tot * 1e3:9.1f}ms")
+
     spill_total = sum(v for (op, m), v in roll.items()
                       if m == "spillTime")
     if spill_total > 0.1:
@@ -173,13 +195,111 @@ def profile_event_logs(path: str) -> str:
     return "\n".join(lines)
 
 
+# --- critical-path analysis over a stitched trace ---------------------------
+# The hotspot table answers "which operator burned the most device
+# time"; the critical path answers the question a timeline viewer
+# answers visually — WHAT was the wall time actually spent on, across
+# processes: "62% of wall time is shuffle fetch wait on stage 2", or
+# "the retry of q1s1m0 added 1.8s".
+
+def critical_path(spans: List[dict]) -> List[dict]:
+    """The longest parent->child chain through a span forest (dicts as
+    produced by Tracer.drain / load_chrome_trace). Starting from the
+    root span with the largest duration, descend into the child
+    covering the most time, to a leaf. Each step reports its span
+    fields plus ``self_s`` (duration not covered by the next step) and
+    ``frac`` (self_s / root duration)."""
+    children: Dict[str, List[dict]] = {}
+    by_id = {}
+    for s in spans:
+        if s.get("span_id") is not None:
+            by_id[s["span_id"]] = s
+    for s in spans:
+        p = s.get("parent_id")
+        if p is not None and p in by_id:
+            children.setdefault(p, []).append(s)
+    roots = [s for s in spans
+             if s.get("parent_id") not in by_id]
+    if not roots:
+        return []
+    root = max(roots, key=lambda s: s.get("dur", 0.0))
+    total = max(root.get("dur", 0.0), 1e-12)
+    path = []
+    node = root
+    while node is not None:
+        kids = children.get(node.get("span_id"), [])
+        nxt = max(kids, key=lambda s: s.get("dur", 0.0)) if kids else None
+        self_s = node.get("dur", 0.0) - (nxt.get("dur", 0.0) if nxt else 0)
+        path.append(dict(node, self_s=max(self_s, 0.0),
+                         frac=max(self_s, 0.0) / total))
+        node = nxt
+    return path
+
+
+def format_critical_path(spans: List[dict]) -> List[str]:
+    """Render the critical path plus the retry overhead it names."""
+    path = critical_path(spans)
+    if not path:
+        return ["(no spans)"]
+    total = max(path[0].get("dur", 0.0), 1e-12)
+    lines = [f"critical path ({total * 1e3:.1f}ms wall):"]
+    for depth, step in enumerate(path):
+        where = "driver" if step.get("pid", 0) == 0 \
+            else f"worker {step['pid'] - 1}"
+        lines.append(
+            f"  {'  ' * depth}{step['name']} [{step.get('cat', '?')}, "
+            f"{where}]  {step['dur'] * 1e3:9.1f}ms  "
+            f"self {step['self_s'] * 1e3:.1f}ms ({step['frac']:.0%})")
+    top = max(path, key=lambda s: s["self_s"])
+    lines.append(
+        f"  => {top['frac']:.0%} of wall time is {top['name']} "
+        f"({top.get('cat', '?')})")
+    # name the retry overhead: attempt spans that ended err/lost are
+    # pure waste the timeline hides inside stage spans
+    wasted = [s for s in spans if s.get("cat") == "attempt"
+              and (s.get("args") or {}).get("state") in ("err", "lost")]
+    if wasted:
+        w = sum(s.get("dur", 0.0) for s in wasted)
+        names = sorted({s["name"] for s in wasted})
+        lines.append(
+            f"  retry overhead: {w * 1e3:.1f}ms "
+            f"({w / total:.0%} of wall) across {len(wasted)} "
+            f"failed/duplicate attempts: {', '.join(names[:5])}"
+            + (" ..." if len(names) > 5 else ""))
+    return lines
+
+
+def profile_trace(path: str) -> str:
+    """Mine one Chrome trace JSON (spark.rapids.trace.dir output):
+    per-category rollup + the critical path."""
+    import collections
+
+    from ..obs.tracer import load_chrome_trace
+    spans = load_chrome_trace(path)
+    lines = [f"=== TPU trace profile ({path}) ===",
+             f"spans: {len(spans)}"]
+    if not spans:
+        return "\n".join(lines)
+    by_cat = collections.defaultdict(float)
+    for s in spans:
+        by_cat[s.get("cat", "?")] += s.get("dur", 0.0)
+    lines.append("time by category (overlapping spans sum):")
+    for cat, tot in sorted(by_cat.items(), key=lambda kv: -kv[1]):
+        lines.append(f"  {cat:<12} {tot * 1e3:9.1f}ms")
+    lines.extend(format_critical_path(spans))
+    return "\n".join(lines)
+
+
 def _main(argv):
     import sys
     if not argv:
         print("usage: python -m spark_rapids_tpu.tools.profiling "
-              "<event-log dir>", file=sys.stderr)
+              "<event-log dir | trace-*.json>", file=sys.stderr)
         return 2
-    print(profile_event_logs(argv[0]))
+    if argv[0].endswith(".json"):
+        print(profile_trace(argv[0]))
+    else:
+        print(profile_event_logs(argv[0]))
     return 0
 
 

@@ -1,0 +1,486 @@
+"""Observability tier tests: span tracer, metrics registry, Prometheus
+exposition, Chrome trace export, critical-path mining, event-log reader
+guarantees — plus the ISSUE acceptance test: a process-cluster query
+with an injected worker crash produces ONE stitched Chrome trace with
+driver query/stage spans, both task attempts (failed + retried) under
+the right parents, and worker-side operator spans."""
+import importlib.util
+import json
+import os
+import threading
+import urllib.request
+
+import pyarrow as pa
+import pytest
+
+from data_gen import IntegerGen, LongGen, gen_table
+
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.obs.metrics import (MetricsRegistry, dump_prometheus,
+                                          render_merged_snapshots)
+from spark_rapids_tpu.obs.tracer import (NULL_TRACER, Tracer,
+                                         load_chrome_trace,
+                                         tracer_from_conf)
+from spark_rapids_tpu.tools.profiling import (critical_path,
+                                              format_critical_path,
+                                              profile_trace)
+
+
+def _load_checker():
+    """The CI schema checker doubles as the test oracle for emitted
+    observability artifacts."""
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "check_obs_output.py")
+    spec = importlib.util.spec_from_file_location("check_obs", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --- tracer -----------------------------------------------------------------
+
+def test_disabled_tracer_is_shared_noop():
+    t = tracer_from_conf(RapidsConf())
+    assert t is NULL_TRACER and not t.enabled
+    # span() must return ONE shared object: no allocation when disabled
+    assert t.span("a") is t.span("b")
+    with t.span("x") as sp:
+        assert sp.span_id is None
+    assert t.drain() == [] and t.write_chrome("/nonexistent") == ""
+
+
+def test_tracer_from_conf_enabled(tmp_path):
+    conf = RapidsConf({"spark.rapids.trace.dir": str(tmp_path),
+                       "spark.rapids.trace.maxSpans": 7})
+    t = tracer_from_conf(conf, pid=3)
+    assert t.enabled and t.pid == 3 and t.max_spans == 7
+
+
+def test_span_nesting_thread_local_stack():
+    t = Tracer()
+    with t.span("outer", cat="query") as o:
+        with t.span("inner", cat="op"):
+            pass
+    spans = {s["name"]: s for s in t.drain()}
+    assert spans["outer"]["parent_id"] is None
+    assert spans["inner"]["parent_id"] == spans["outer"]["span_id"]
+    assert spans["inner"]["dur"] <= spans["outer"]["dur"]
+
+
+def test_span_stack_is_per_thread():
+    t = Tracer()
+    seen = {}
+
+    def work(name):
+        with t.span(name):
+            seen[name] = t._stack()[:]
+
+    with t.span("root"):
+        th = threading.Thread(target=work, args=("other-thread",))
+        th.start()
+        th.join()
+    # the other thread must not have nested under this thread's root
+    other = [s for s in t.drain() if s["name"] == "other-thread"][0]
+    assert other["parent_id"] is None
+
+
+def test_emit_deterministic_ids_and_absorb():
+    t = Tracer(trace_id="abc", pid=0)
+    sid = t.emit("attempt t1 a0", "attempt", ts=100.0, dur=2.0,
+                 span_id="t1.a0", parent_id=None)
+    assert sid == "t1.a0"
+    # a worker serialized spans parented on the attempt id
+    t.absorb([{"name": "task t1 a0", "cat": "task", "span_id": "t1.a0.1.1",
+               "parent_id": "t1.a0", "ts": 100.5, "dur": 1.0, "pid": 1},
+              {"garbage": True},  # torn entry: skipped, not fatal
+              {"name": "no-id"}])
+    spans = t.drain()
+    assert len(spans) == 2
+    task = [s for s in spans if s["cat"] == "task"][0]
+    assert task["parent_id"] == "t1.a0" and task["pid"] == 1
+
+
+def test_span_buffer_bound_counts_drops():
+    t = Tracer(max_spans=3)
+    for i in range(5):
+        with t.span(f"s{i}"):
+            pass
+    assert len(t.drain()) == 3 and t.dropped == 2
+
+
+def test_worker_id_prefix_prevents_collisions():
+    a = Tracer(trace_id="x", pid=1, id_prefix="t1.a0.")
+    b = Tracer(trace_id="x", pid=1, id_prefix="t1.a1.")
+    with a.span("s"):
+        pass
+    with b.span("s"):
+        pass
+    ids = {a.drain()[0]["span_id"], b.drain()[0]["span_id"]}
+    assert len(ids) == 2
+
+
+def test_chrome_roundtrip(tmp_path):
+    t = Tracer(trace_id="deadbeef", pid=0)
+    with t.span("query q1", cat="query", args={"fingerprint": "f"}):
+        with t.span("stage map s1", cat="stage"):
+            pass
+    t.absorb([{"name": "task", "cat": "task", "span_id": "w.1",
+               "parent_id": None, "ts": 1.0, "dur": 0.5, "pid": 2}])
+    path = t.write_chrome(str(tmp_path))
+    assert os.path.basename(path) == "trace-deadbeef.json"
+    # the checker is the schema oracle
+    assert _load_checker().check_trace(path) == []
+    back = load_chrome_trace(path)
+    by_name = {s["name"]: s for s in back}
+    assert by_name["stage map s1"]["parent_id"] == \
+        by_name["query q1"]["span_id"]
+    assert by_name["task"]["pid"] == 2
+    assert abs(by_name["task"]["dur"] - 0.5) < 1e-6
+    # process metadata rows for driver + worker 1
+    doc = json.load(open(path))
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M"}
+    assert {"driver", "worker 1"} <= names
+
+
+def test_summary_rolls_up_by_category():
+    t = Tracer()
+    t.emit("a", "shuffle", 0.0, 2.0)
+    t.emit("b", "shuffle", 0.0, 3.0)
+    t.emit("c", "op", 0.0, 1.0)
+    s = t.summary()
+    assert s["spans"] == 3
+    assert s["by_cat"]["shuffle"] == {"spans": 2, "total_s": 5.0}
+
+
+# --- metrics registry -------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    r = MetricsRegistry()
+    c = r.counter("c_total", "help", ("k",))
+    c.labels("x").inc()
+    c.labels("x").inc(2)
+    g = r.gauge("g")
+    g.set(5)
+    g.dec(2)
+    h = r.histogram("h_seconds", buckets=(0.1, 1.0, float("inf")))
+    for v in (0.05, 0.5, 10.0):
+        h.observe(v)
+    snap = r.snapshot()
+    assert snap["c_total"]["samples"]["x"] == 3
+    assert snap["g"]["samples"][""] == 3
+    hs = snap["h_seconds"]["samples"][""]
+    # bucket counts are CUMULATIVE (Prometheus histogram semantics)
+    assert hs["count"] == 3 and hs["counts"] == [1, 2, 3]
+    assert abs(hs["sum"] - 10.55) < 1e-9
+
+
+def test_family_redeclaration_idempotent_kind_checked():
+    r = MetricsRegistry()
+    assert r.counter("x") is r.counter("x")
+    with pytest.raises(ValueError):
+        r.gauge("x")
+
+
+def test_bounded_label_sets_overflow_to_other():
+    from spark_rapids_tpu.obs.metrics import MAX_CHILDREN, _OTHER
+    r = MetricsRegistry()
+    c = r.counter("c", "", ("id",))
+    for i in range(MAX_CHILDREN + 10):
+        c.labels(f"id{i}").inc()
+    snap = r.snapshot()["c"]["samples"]
+    assert len(snap) == MAX_CHILDREN + 1
+    assert snap[_OTHER] == 10  # the overflow collapsed into one series
+
+
+def test_prometheus_text_valid_per_checker():
+    r = MetricsRegistry()
+    r.counter("rapids_test_total", 'escapes "quoted" help',
+              ("a",)).labels('v"1"').inc()
+    r.histogram("rapids_wait_seconds").observe(0.2)
+    text = dump_prometheus(r)
+    assert _load_checker().check_prometheus(text) == []
+    assert "# TYPE rapids_test_total counter" in text
+    assert 'le="+Inf"' in text
+
+
+def test_merged_snapshots_proc_labels():
+    d, w = MetricsRegistry(), MetricsRegistry()
+    d.counter("c_total").inc(1)
+    w.counter("c_total").inc(41)
+    text = render_merged_snapshots([("driver", d.snapshot()),
+                                    ("w0", w.snapshot())])
+    assert 'c_total{proc="driver"} 1' in text
+    assert 'c_total{proc="w0"} 41' in text
+    # one TYPE line per family, not per process
+    assert text.count("# TYPE c_total") == 1
+    assert _load_checker().check_prometheus(text) == []
+
+
+def test_http_metrics_endpoint():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    from spark_rapids_tpu.obs import metrics as M
+    conf = RapidsConf({"spark.rapids.metrics.port": port})
+    bound = M.maybe_start_http_server(conf)
+    if bound is None and M._http_server == "failed":
+        pytest.skip("port raced away")
+    assert bound == port
+    # idempotent: second call reuses the server
+    assert M.maybe_start_http_server(conf) == port
+    M.REGISTRY.counter("rapids_http_test_total").inc()
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+    assert _load_checker().check_prometheus(body) == []
+    assert urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/", timeout=5).status == 200
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/nope",
+                               timeout=5)
+
+
+def test_worker_snapshot_flush_and_read(tmp_path):
+    from spark_rapids_tpu.obs.metrics import (flush_worker_metrics,
+                                              read_worker_metrics)
+    r = MetricsRegistry()
+    r.counter("n_total").inc(7)
+    flush_worker_metrics(str(tmp_path), 0, r)
+    # a torn snapshot must not break the merge
+    with open(os.path.join(str(tmp_path), "metrics", "w1.json"),
+              "w") as f:
+        f.write('{"torn":')
+    tagged = read_worker_metrics(str(tmp_path))
+    assert [t for t, _ in tagged] == ["w0"]
+    assert tagged[0][1]["n_total"]["samples"][""] == 7
+
+
+# --- critical path ----------------------------------------------------------
+
+def _span(name, cat, sid, parent, ts, dur, pid=0, args=None):
+    return {"name": name, "cat": cat, "span_id": sid, "parent_id": parent,
+            "ts": ts, "dur": dur, "pid": pid, "args": args or {}}
+
+
+def test_critical_path_follows_dominant_child():
+    spans = [
+        _span("query", "query", "q", None, 0.0, 10.0),
+        _span("stage 1", "stage", "s1", "q", 0.0, 2.0),
+        _span("stage 2", "stage", "s2", "q", 2.0, 7.0),
+        _span("shuffle_fetch", "shuffle", "f", "s2", 2.0, 6.2, pid=1),
+    ]
+    path = critical_path(spans)
+    assert [p["name"] for p in path] == ["query", "stage 2",
+                                         "shuffle_fetch"]
+    leaf = path[-1]
+    assert leaf["self_s"] == pytest.approx(6.2)
+    assert leaf["frac"] == pytest.approx(0.62)
+    text = "\n".join(format_critical_path(spans))
+    assert "62% of wall time is shuffle_fetch (shuffle)" in text
+
+
+def test_critical_path_names_retry_overhead():
+    spans = [
+        _span("query", "query", "q", None, 0.0, 10.0),
+        _span("attempt t1 a0", "attempt", "t1.a0", "q", 0.0, 4.0,
+              pid=1, args={"state": "err"}),
+        _span("attempt t1 a1", "attempt", "t1.a1", "q", 4.0, 6.0,
+              pid=2, args={"state": "ok"}),
+    ]
+    text = "\n".join(format_critical_path(spans))
+    assert "retry overhead" in text and "attempt t1 a0" in text
+    assert "40% of wall" in text
+
+
+def test_critical_path_empty_and_orphans():
+    assert critical_path([]) == []
+    # orphan parents (dropped spans) must not crash the miner
+    spans = [_span("a", "op", "1", "gone", 0.0, 1.0)]
+    assert [p["name"] for p in critical_path(spans)] == ["a"]
+
+
+# --- hotspot dedup (satellite) ---------------------------------------------
+
+def test_profile_report_merges_duplicate_instance_labels():
+    from spark_rapids_tpu.exec.base import TpuMetric
+    from spark_rapids_tpu.exec import HostBatchSourceExec, TpuProjectExec
+    from spark_rapids_tpu.expr import Alias, UnresolvedColumn as col
+    from spark_rapids_tpu.planner import overrides
+    from spark_rapids_tpu.tools import profile_report
+    src = HostBatchSourceExec([gen_table([IntegerGen()], 50, seed=1)])
+    pp = overrides(TpuProjectExec([Alias(col("c0"), "x")], src),
+                   RapidsConf())
+    pp.collect()
+    ctx = pp.last_ctx
+    # simulate an AQE re-used exchange: same operator class, two
+    # instance labels — must merge into one ranked row
+    for label, v in (("ShuffleExchangeExec#90", 0.5),
+                     ("ShuffleExchangeExec#91", 0.25)):
+        m = TpuMetric("opTime")
+        m.value = v
+        ctx.metrics[label] = {"opTime": m}
+    rep = profile_report(pp)
+    assert "ShuffleExchangeExec (x2)" in rep
+    assert rep.count("ShuffleExchangeExec") == 1
+    assert "750.00ms" in rep
+
+
+# --- event-log reader guarantees (satellite) --------------------------------
+
+def test_read_event_logs_tolerates_torn_last_line(tmp_path):
+    from spark_rapids_tpu.tools.event_log import read_event_logs
+    p = tmp_path / "app-1-1.jsonl"
+    p.write_text(json.dumps({"a": 1}) + "\n"
+                 + json.dumps({"b": 2}) + "\n"
+                 + '{"torn": tru')  # crashed writer mid-line
+    evs = list(read_event_logs(str(tmp_path)))
+    assert evs == [{"a": 1}, {"b": 2}]
+
+
+def test_plan_fingerprint_stable_and_sensitive():
+    from spark_rapids_tpu.exec import HostBatchSourceExec, TpuProjectExec
+    from spark_rapids_tpu.expr import Alias, UnresolvedColumn as col
+    from spark_rapids_tpu.tools.event_log import plan_fingerprint
+
+    def build(extra_project):
+        src = HostBatchSourceExec([gen_table([IntegerGen()], 10, seed=1)])
+        plan = TpuProjectExec([Alias(col("c0"), "x")], src)
+        if extra_project:
+            plan = TpuProjectExec([Alias(col("x"), "y")], plan)
+        return plan
+
+    # stable across runs: instance ids (#N) differ between the two
+    # builds but must not leak into the fingerprint
+    assert plan_fingerprint(build(False)) == plan_fingerprint(build(False))
+    # sensitive to the operator tree
+    assert plan_fingerprint(build(False)) != plan_fingerprint(build(True))
+
+
+# --- ML path query events (satellite) ---------------------------------------
+
+def test_ml_path_emits_query_events(tmp_path):
+    from spark_rapids_tpu import TpuSession
+    from spark_rapids_tpu.ml import columnar_rdd, to_feature_matrix
+    from spark_rapids_tpu.tools.event_log import read_event_logs
+    trace_dir = str(tmp_path / "traces")
+    s = TpuSession({"spark.rapids.eventLog.dir": str(tmp_path),
+                    "spark.rapids.trace.dir": trace_dir})
+    df = s.create_dataframe({"a": [1.0, 2.0, 3.0], "b": [4, 5, 6]})
+    list(columnar_rdd(df))
+    to_feature_matrix(df, ["a"], "b")
+    evs = [e for e in read_event_logs(str(tmp_path))
+           if e.get("type") != "scheduler"]
+    assert len(evs) == 2
+    assert all("fingerprint" in e and e["wall_s"] > 0 for e in evs)
+    # the embedded trace summary must reference a trace that EXISTS
+    written = {n for n in os.listdir(trace_dir)}
+    for e in evs:
+        assert f"trace-{e['trace']['trace_id']}.json" in written
+
+
+# --- the acceptance test: stitched trace across a worker crash --------------
+
+def _crash_plan():
+    """2-stage query (map shuffle + reduce agg), two source batches so
+    the map stage splits across both workers."""
+    from spark_rapids_tpu.exec.base import HostBatchSourceExec
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.expr import Alias, UnresolvedColumn as col
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.shuffle.partitioner import HashPartitioning
+    rbs = [gen_table([IntegerGen(min_val=0, max_val=9, nullable=False),
+                      LongGen(nullable=False)], n, seed=s,
+                     names=["k", "v"])
+           for n, s in [(400, 1), (350, 2)]]
+    src = HostBatchSourceExec(rbs)
+    exch = TpuShuffleExchangeExec(HashPartitioning([col("k")], 4), src)
+    return TpuHashAggregateExec(
+        [col("k")], [Alias(Sum(col("v")), "s")], exch)
+
+
+def test_cluster_crash_produces_single_stitched_trace(tmp_path):
+    """ISSUE acceptance: injected worker crash; ONE Chrome trace JSON
+    holding driver query/stage spans, BOTH attempts of the crashed task
+    (failed + retried) with correct parent linkage, and worker-side
+    operator spans; metrics aggregate across processes; the trace
+    profiler names the retry overhead."""
+    from spark_rapids_tpu.cluster import TpuProcessCluster
+    from spark_rapids_tpu.exec.base import ExecCtx
+    trace_dir = str(tmp_path / "traces")
+    conf = RapidsConf({
+        "spark.rapids.tpu.test.injectFaults": "crash:q1s1m0:0",
+        "spark.rapids.trace.dir": trace_dir,
+        "spark.rapids.metrics.enabled": True,
+    })
+    plan = _crash_plan()
+    with TpuProcessCluster(n_workers=2, conf=conf) as c:
+        got = c.run_query(plan)
+        trace_path = c.last_trace_path
+        prom = c.prometheus_text()
+
+    # correct results despite the crash
+    from spark_rapids_tpu.columnar.arrow_bridge import arrow_schema
+    want = pa.Table.from_batches(
+        list(plan.execute_cpu(ExecCtx())),
+        schema=arrow_schema(plan.output_schema))
+    key = lambda t: sorted(t.to_pylist(), key=lambda d: d["k"])
+    assert key(got) == key(want)
+
+    # ONE stitched trace file, schema-valid
+    assert trace_path and os.path.dirname(trace_path) == trace_dir
+    assert [n for n in os.listdir(trace_dir)
+            if n.endswith(".json")] == [os.path.basename(trace_path)]
+    assert _load_checker().check_trace(trace_path) == []
+
+    spans = load_chrome_trace(trace_path)
+    by_id = {s["span_id"]: s for s in spans}
+    # driver query + stage spans
+    query = [s for s in spans if s["cat"] == "query"]
+    assert len(query) == 1 and query[0]["pid"] == 0
+    stages = {s["name"]: s for s in spans if s["cat"] == "stage"}
+    assert "stage map s1" in stages and "stage final" in stages
+    assert all(s["parent_id"] == query[0]["span_id"]
+               for s in stages.values())
+    # both attempts of the crashed task, linked under the map stage
+    atts = {s["name"]: s for s in spans if s["cat"] == "attempt"
+            and "q1s1m0" in s["name"]}
+    assert set(atts) == {"attempt q1s1m0 a0", "attempt q1s1m0 a1"}
+    assert atts["attempt q1s1m0 a0"]["args"]["state"] == "err"
+    assert atts["attempt q1s1m0 a1"]["args"]["state"] == "ok"
+    for s in atts.values():
+        assert by_id[s["parent_id"]]["name"] == "stage map s1"
+    # the retried attempt ran on a worker: its task span parents onto
+    # the deterministic attempt span id, and operator spans nest below
+    task = [s for s in spans if s["cat"] == "task"
+            and s["name"].startswith("task q1s1m0 a1")]
+    assert len(task) == 1 and task[0]["pid"] > 0
+    assert task[0]["parent_id"] == atts["attempt q1s1m0 a1"]["span_id"]
+    ops = [s for s in spans if s["cat"] == "op" and s["pid"] > 0]
+    assert ops, "no worker-side operator spans"
+    shuf = [s for s in spans if s["cat"] == "shuffle" and s["pid"] > 0]
+    assert any(s["name"].startswith("shuffle_write") for s in shuf)
+
+    # cross-process metrics: driver scheduler counters + worker flushes
+    assert _load_checker().check_prometheus(prom) == []
+    assert ('rapids_scheduler_events_total{event="task_failed",'
+            'proc="driver"}') in prom
+    assert 'proc="w' in prom
+    assert "rapids_shuffle_partitions_written_total" in prom
+
+    # the critical-path miner names the retry overhead
+    rep = profile_trace(trace_path)
+    assert "retry overhead" in rep and "attempt q1s1m0 a0" in rep
+
+
+def test_cluster_trace_disabled_has_zero_surface(tmp_path):
+    """With tracing off nothing is written and task payloads carry no
+    trace context (the near-zero-overhead-when-disabled guarantee)."""
+    from spark_rapids_tpu.cluster import TpuProcessCluster
+    plan = _crash_plan()
+    with TpuProcessCluster(n_workers=2) as c:
+        c.run_query(plan)
+        assert c.last_trace_path is None
+        assert c.last_scheduler.tracer is NULL_TRACER \
+            or not c.last_scheduler.tracer.enabled

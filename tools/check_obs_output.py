@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Schema checks for the observability outputs CI smoke exercises.
+
+Two validators and one driver:
+
+- ``--trace FILE``   validate a Chrome trace_event JSON written under
+  ``spark.rapids.trace.dir`` (event shape, unique span ids, resolvable
+  parent linkage, process-name metadata, trace_id consistency);
+- ``--prom FILE``    validate Prometheus text exposition (sample-line
+  grammar, TYPE declarations, histogram bucket monotonicity and
+  _count/+Inf agreement);
+- ``--smoke DIR``    run one tiny in-process query with tracing +
+  metrics enabled, write the trace JSON and a Prometheus dump under
+  DIR, then validate both — the one-command CI gate.
+
+Exit status 0 = all checks passed; failures are listed on stderr.
+"""
+import argparse
+import json
+import os
+import re
+import sys
+
+# runnable from anywhere: the package lives next to this script's parent
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # metric name
+    r"(\{[^{}]*\})?"                        # optional labels
+    r" (-?\d+(\.\d+)?([eE][+-]?\d+)?|[+-]Inf|NaN)$")  # value
+_TYPES = ("counter", "gauge", "histogram")
+
+
+def check_trace(path):
+    errors = []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"trace unreadable: {e}"]
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        return ["trace is not a trace_event JSON object"]
+    trace_id = doc.get("otherData", {}).get("trace_id")
+    if not trace_id:
+        errors.append("otherData.trace_id missing")
+    dropped = int(doc.get("otherData", {}).get("dropped_spans", 0))
+    span_ids, parents, cats = set(), [], set()
+    n_x = n_m = 0
+    for i, ev in enumerate(doc["traceEvents"]):
+        ph = ev.get("ph")
+        if ph == "M":
+            n_m += 1
+            if not (ev.get("args") or {}).get("name"):
+                errors.append(f"event {i}: M event without args.name")
+            continue
+        if ph != "X":
+            errors.append(f"event {i}: unexpected ph {ph!r}")
+            continue
+        n_x += 1
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            errors.append(f"event {i}: missing name")
+        for k in ("ts", "dur"):
+            if not isinstance(ev.get(k), (int, float)) or ev[k] < 0:
+                errors.append(f"event {i}: bad {k} {ev.get(k)!r}")
+        if not isinstance(ev.get("pid"), int):
+            errors.append(f"event {i}: bad pid {ev.get('pid')!r}")
+        args = ev.get("args") or {}
+        sid = args.get("span_id")
+        if not sid:
+            errors.append(f"event {i}: args.span_id missing")
+        elif sid in span_ids:
+            errors.append(f"event {i}: duplicate span_id {sid}")
+        else:
+            span_ids.add(sid)
+        if trace_id and args.get("trace_id") != trace_id:
+            errors.append(f"event {i}: trace_id mismatch")
+        if args.get("parent_id"):
+            parents.append((i, args["parent_id"]))
+        cats.add(ev.get("cat"))
+    if n_x == 0:
+        errors.append("no X (span) events")
+    if n_m == 0:
+        errors.append("no M (process_name) metadata events")
+    if "query" not in cats:
+        errors.append("no query-category span")
+    if not dropped:  # a bounded tracer may legitimately orphan children
+        for i, p in parents:
+            if p not in span_ids:
+                errors.append(f"event {i}: parent_id {p} unresolved")
+    return errors
+
+
+def check_prometheus(text):
+    errors = []
+    typed = {}
+    seen_names = set()
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4 or parts[3] not in _TYPES:
+                errors.append(f"line {ln}: malformed TYPE: {line!r}")
+            else:
+                typed[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            errors.append(f"line {ln}: not a valid sample: {line!r}")
+            continue
+        name = m.group(1)
+        seen_names.add(name)
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        if name not in typed and base not in typed:
+            errors.append(f"line {ln}: sample {name} has no TYPE")
+    # histogram invariants: cumulative buckets non-decreasing, the +Inf
+    # bucket equals _count, per label-set
+    hists = {n for n, t in typed.items() if t == "histogram"}
+    for name in hists:
+        series = {}
+        counts = {}
+        for line in text.splitlines():
+            m = _SAMPLE_RE.match(line)
+            if not m:
+                continue
+            labels = m.group(2) or "{}"
+            if m.group(1) == name + "_bucket":
+                key = re.sub(r'(,?)le="[^"]*"', "", labels)
+                series.setdefault(key, []).append(float(m.group(3)))
+            elif m.group(1) == name + "_count":
+                counts[labels] = float(m.group(3))
+        for key, vals in series.items():
+            if vals != sorted(vals):
+                errors.append(
+                    f"{name}{key}: bucket counts not cumulative: {vals}")
+        for key, vals in series.items():
+            cnt = counts.get(key)
+            if cnt is not None and vals and vals[-1] != cnt:
+                errors.append(
+                    f"{name}{key}: +Inf bucket {vals[-1]} != _count {cnt}")
+    if not seen_names:
+        errors.append("no samples at all")
+    return errors
+
+
+def run_smoke(out_dir):
+    """One tiny query with tracing + metrics on; returns (trace_path,
+    prom_path)."""
+    trace_dir = os.path.join(out_dir, "traces")
+    from spark_rapids_tpu import TpuSession
+    from spark_rapids_tpu.expr import UnresolvedColumn as col
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.obs.metrics import dump_prometheus
+    s = TpuSession({
+        "spark.rapids.trace.dir": trace_dir,
+        "spark.rapids.eventLog.dir": os.path.join(out_dir, "events"),
+    })
+    df = s.create_dataframe({"k": [i % 3 for i in range(100)],
+                             "v": list(range(100))})
+    out = df.group_by(col("k")).agg(Sum(col("v"))).collect()
+    assert out.num_rows == 3, f"smoke query wrong: {out}"
+    traces = [os.path.join(trace_dir, n)
+              for n in sorted(os.listdir(trace_dir))
+              if n.endswith(".json")]
+    assert traces, f"no trace JSON written under {trace_dir}"
+    prom_path = os.path.join(out_dir, "metrics.prom")
+    with open(prom_path, "w") as f:
+        f.write(dump_prometheus())
+    return traces[-1], prom_path
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--trace", help="Chrome trace JSON to validate")
+    ap.add_argument("--prom", help="Prometheus text file to validate")
+    ap.add_argument("--smoke", metavar="DIR",
+                    help="run a tiny traced query, emit + validate")
+    args = ap.parse_args(argv)
+    errors = []
+    trace, prom = args.trace, args.prom
+    if args.smoke:
+        os.makedirs(args.smoke, exist_ok=True)
+        trace, prom = run_smoke(args.smoke)
+        print(f"smoke outputs: {trace} {prom}")
+    if not trace and not prom:
+        ap.error("nothing to do: pass --trace/--prom/--smoke")
+    if trace:
+        errors += [f"[trace] {e}" for e in check_trace(trace)]
+    if prom:
+        try:
+            with open(prom) as f:
+                text = f.read()
+        except OSError as e:
+            errors.append(f"[prom] unreadable: {e}")
+        else:
+            errors += [f"[prom] {e}" for e in check_prometheus(text)]
+    if errors:
+        for e in errors:
+            print(e, file=sys.stderr)
+        return 1
+    print("obs output OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,21 +6,29 @@
 #   2. import the package                  (catches import-time errors)
 #   3. pytest collection of the full suite (catches collection errors in
 #      tests -- the failure mode that hid the window.py f-string bug)
+#   4. observability smoke: one tiny query with tracing + metrics on,
+#      then schema-check the emitted Chrome trace JSON and Prometheus
+#      text (tools/check_obs_output.py)
 #
 # Pass --full to also run the tier-1 suite (see ROADMAP.md), bounded to
 # 870s like the driver's own gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/3 compileall =="
+echo "== 1/4 compileall =="
 python -m compileall -q spark_rapids_tpu tests
 
-echo "== 2/3 package import =="
+echo "== 2/4 package import =="
 JAX_PLATFORMS=cpu python -c "import spark_rapids_tpu; print('import ok:', spark_rapids_tpu.__name__)"
 
-echo "== 3/3 pytest collection =="
+echo "== 3/4 pytest collection =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q --collect-only -m 'not slow' \
     -p no:cacheprovider 2>&1 | tail -3
+
+echo "== 4/4 observability smoke =="
+OBS_TMP="$(mktemp -d)"
+trap 'rm -rf "$OBS_TMP"' EXIT
+JAX_PLATFORMS=cpu python tools/check_obs_output.py --smoke "$OBS_TMP"
 
 if [[ "${1:-}" == "--full" ]]; then
     echo "== tier-1 (full) =="
