@@ -619,6 +619,20 @@ def main():
         if "scanTime" in sm else None
     scan_upload_ms = round(sm["uploadTime"].value * 1e3, 1) \
         if "uploadTime" in sm else None
+    # the overlapped tunnel's split: assembleTime is host blob build,
+    # uploadTime is device_put + dispatch on the feeder threads, and
+    # uploadWaitTime is the only part the CONSUMER actually blocked on —
+    # upload_overlap_frac is the share of uploadTime hidden behind
+    # compute/pipeline
+    scan_assemble_ms = round(sm["assembleTime"].value * 1e3, 1) \
+        if "assembleTime" in sm else None
+    scan_upload_wait_ms = round(sm["uploadWaitTime"].value * 1e3, 1) \
+        if "uploadWaitTime" in sm else None
+    upload_overlap_frac = None
+    if scan_upload_ms and scan_upload_wait_ms is not None:
+        upload_overlap_frac = round(
+            max(0.0, 1.0 - sm["uploadWaitTime"].value
+                / max(sm["uploadTime"].value, 1e-9)), 3)
     # device page decode (VERDICT r4 #1): encoded bytes crossing the
     # host->device link vs the decoded column bytes they expand to
     enc_b = sm["encodedBytes"].value if "encodedBytes" in sm else 0
@@ -672,16 +686,26 @@ def main():
           f"{achieved_gbs:.0f} GB/s of {kind} peak {peak} GB/s "
           f"-> {frac}", file=sys.stderr)
 
-    # --- tunnel bandwidth probe (post-timing-safe: uploads only; best
-    # of 3 — the tunnel's minute-to-minute variance is the point) -------
-    probe = np.zeros(32 << 20, dtype=np.int8)
-    jax.device_put(probe).block_until_ready()  # warm
-    best = float("inf")
-    for _ in range(3):
+    # --- tunnel probes (post-timing-safe: uploads only) ------------------
+    # Bandwidth needs a buffer big enough that per-RPC latency is noise:
+    # a 32MB probe at ~0.2s RTT reported 0.02 GB/s while the scan's own
+    # 41.8MB moved at ~0.46 GB/s — latency-dominated, not bandwidth.
+    # 128MB (>=64MB floor), best-of-5; a separate small probe reports
+    # the latency itself.
+    lat_probe = np.zeros(64 << 10, dtype=np.int8)
+    bw_probe = np.zeros(128 << 20, dtype=np.int8)
+    jax.device_put(lat_probe).block_until_ready()  # warm both paths
+    jax.device_put(bw_probe).block_until_ready()
+    best_lat = best_bw = float("inf")
+    for _ in range(5):
         t0 = time.perf_counter()
-        jax.device_put(probe).block_until_ready()
-        best = min(best, time.perf_counter() - t0)
-    tunnel_gbs = round(probe.nbytes / 1e9 / best, 2)
+        jax.device_put(lat_probe).block_until_ready()
+        best_lat = min(best_lat, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        jax.device_put(bw_probe).block_until_ready()
+        best_bw = min(best_bw, time.perf_counter() - t0)
+    tunnel_gbs = round(bw_probe.nbytes / 1e9 / best_bw, 2)
+    tunnel_latency_ms = round(best_lat * 1e3, 2)
 
     # --- correctness (post-timing: the downloads happen HERE) -----------
     join_check(join_outs, host_join_out)
@@ -716,12 +740,17 @@ def main():
         "hbm_peak_gbs": peak,
         "hbm_achieved_gbs": round(achieved_gbs, 1),
         "hbm_achieved_frac": frac,
-        # from-files breakdown: decode overlaps in the reader pool,
-        # upload is the pipeline floor through the ~1.5 GB/s tunnel (96MB
-        # of columns); on co-located hosts (PCIe/DMA) the same pipeline
-        # is decode-bound at ~scan_decode_ms
+        # from-files breakdown: decode overlaps in the reader pool;
+        # assemble+upload+dispatch run on the upload feeder threads and
+        # uploadWait is the only serial remainder the consumer saw —
+        # upload_overlap_frac = 1 - wait/upload is the share of transfer
+        # hidden behind compute/pipeline. On co-located hosts (PCIe/DMA)
+        # the same pipeline is decode-bound at ~scan_decode_ms
         "scan_decode_ms": scan_decode_ms,
+        "scan_assemble_ms": scan_assemble_ms,
         "scan_upload_ms": scan_upload_ms,
+        "scan_upload_wait_ms": scan_upload_wait_ms,
+        "upload_overlap_frac": upload_overlap_frac,
         "scan_breakdown_wall_ms": round(brk_wall * 1e3, 1),
         # the device-page-decode mechanism: dictionary/RLE columns cross
         # the link at their ENCODED size (SURVEY.md §7.2-P5)
@@ -729,6 +758,7 @@ def main():
         "scan_decoded_mb": round(dec_b / 1e6, 1),
         "scan_encoded_over_decoded": enc_ratio,
         "tunnel_upload_gbs": tunnel_gbs,
+        "tunnel_upload_latency_ms": tunnel_latency_ms,
         "join_agg_mrows_per_sec": join_mrows,
         "join_agg_vs_host": join_vs,
         "join_agg_sync_regime_mrows_per_sec":

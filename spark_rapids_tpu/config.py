@@ -246,6 +246,26 @@ SCAN_PREFETCH_BATCHES = register(
     "Decoded batches uploaded ahead of the consumer: host->device "
     "transfer of batch N+1 overlaps device compute on batch N "
     "(SURVEY.md §7.3.4). 0 disables the upload pipeline.")
+SCAN_UPLOAD_THREADS = register(
+    "spark.rapids.sql.scan.uploadThreads", 3,
+    "Feeder threads for the device-decode parquet scan: blob assembly "
+    "+ device_put + fused-decode dispatch of row group N+1 run here "
+    "while the consumer computes on batch N, so the host->device "
+    "tunnel is never serial with compute. 0 disables the overlap "
+    "(assemble/upload on the consumer thread).")
+SCAN_INFLIGHT_BATCHES = register(
+    "spark.rapids.sql.scan.inFlightBatches", 4,
+    "Bounded in-flight device-residency window for pipelined scan "
+    "uploads: at most this many assembled-but-not-yet-consumed device "
+    "batches may exist at once (each is registered with the device "
+    "memory ledger while in flight, so eviction pressure sees them).")
+SCAN_COALESCE_TARGET_BYTES = register(
+    "spark.rapids.sql.scan.coalesceTargetBytes", 32 << 20,
+    "Device-decode scan: coalesce consecutive small row groups of one "
+    "schema toward this many decoded output bytes before a single "
+    "fused-decode dispatch (fewer, larger transfers and programs; rows "
+    "stay capped by spark.rapids.sql.batchSizeRows). 0 dispatches one "
+    "program per row group.", conv=_bytes_conv)
 
 APPROX_PERCENTILE_EXACT = register(
     "spark.rapids.sql.approxPercentile.exact", True,

@@ -37,8 +37,12 @@ from ..columnar.arrow_bridge import (arrow_schema, arrow_to_device,
 from ..config import (CSV_ENABLED, JSON_ENABLED, MAX_PARTITION_BYTES,
                       ORC_ENABLED, PARQUET_DEVICE_DECODE, PARQUET_ENABLED,
                       PARQUET_MULTITHREADED_THREADS, PARQUET_READER_TYPE,
-                      RapidsConf, SCAN_PREFETCH_BATCHES)
+                      RapidsConf, SCAN_COALESCE_TARGET_BYTES,
+                      SCAN_INFLIGHT_BATCHES, SCAN_PREFETCH_BATCHES,
+                      SCAN_UPLOAD_THREADS)
 from ..exec.base import ExecCtx, LeafExec
+from ..obs.metrics import REGISTRY as _METRICS, TRANSFER_BUCKETS
+from ..pipeline import pipelined_map
 
 __all__ = ["FileSplit", "TpuFileScanExec", "plan_splits"]
 
@@ -48,6 +52,18 @@ HIVE_TEXT_ENABLED = _register(
     "spark.rapids.sql.format.hiveText.enabled", True,
     "Enable accelerated Hive text-serde reads/writes (LazySimpleSerDe "
     "defaults: \\x01 delimiter, \\N nulls).")
+
+# Live transfer-stage health for every scan upload, split the same way
+# the per-query metrics are (assembleTime vs uploadTime). Bounded label:
+# mode = device (fused-decode blob path) | arrow (host-decoded batches).
+SCAN_ASSEMBLE_SECONDS = _METRICS.histogram(
+    "rapids_scan_assemble_seconds",
+    "Host-side blob/batch assembly time per scan output batch.",
+    ("mode",), buckets=TRANSFER_BUCKETS)
+SCAN_UPLOAD_SECONDS = _METRICS.histogram(
+    "rapids_scan_upload_seconds",
+    "Host->device transfer + decode-dispatch time per scan output "
+    "batch.", ("mode",), buckets=TRANSFER_BUCKETS)
 
 _FORMAT_CONF = {"parquet": PARQUET_ENABLED, "orc": ORC_ENABLED,
                 "csv": CSV_ENABLED, "json": JSON_ENABLED,
@@ -636,10 +652,13 @@ class TpuFileScanExec(LeafExec):
                 else None
         return n_rows, plans, host_rb, self._part_values.get(path)
 
-    def _assemble_device_batch(self, n_rows, plans, host_rb, part_vals):
-        """Consumer side: ONE fused decode dispatch for every planned
+    def _assemble_device_batch(self, n_rows, plans, host_rb, part_vals,
+                               timers=None):
+        """Feeder side: ONE fused decode dispatch for every planned
         column + uploads for host-fallback/partition columns, then the
-        TpuBatch (all async — no host sync)."""
+        TpuBatch (all async — no host sync). ``timers`` accumulates the
+        assemble/upload split (decode_row_group_device contributes its
+        own; the per-column uploads here add to "upload")."""
         from .parquet_device import decode_row_group_device
         from ..columnar.batch import bucket_rows
         from ..columnar.arrow_bridge import arrow_column_to_device
@@ -656,7 +675,9 @@ class TpuFileScanExec(LeafExec):
                 encoded += plan.encoded_bytes
                 lane = plan.lane
                 decoded += n_rows * (1 if lane == bool else lane.itemsize)
-        dev_cols = decode_row_group_device(typed, cap) if typed else {}
+        dev_cols = decode_row_group_device(typed, cap, timers) \
+            if typed else {}
+        up_s = 0.0
         cols = []
         for fld in self._schema.fields:
             if fld.name in dev_cols:
@@ -665,25 +686,126 @@ class TpuFileScanExec(LeafExec):
             if fld.name in part_fields:
                 v = (part_vals or {}).get(fld.name)
                 arr = pa.array([v] * n_rows, type=dt.to_arrow(fld.dtype))
-                cols.append(arrow_column_to_device(arr, fld.dtype, cap))
-                continue
-            if host_rb is not None \
+            elif host_rb is not None \
                     and host_rb.schema.get_field_index(fld.name) >= 0:
                 arr = host_rb.column(
                     host_rb.schema.get_field_index(fld.name))
                 if arr.type != dt.to_arrow(fld.dtype):
                     arr = arr.cast(dt.to_arrow(fld.dtype))
-                cols.append(arrow_column_to_device(arr, fld.dtype, cap))
+            else:
+                cols.append(TpuColumnVector.nulls(fld.dtype, cap))
                 continue
-            cols.append(TpuColumnVector.nulls(fld.dtype, cap))
+            t0 = time.perf_counter()
+            cols.append(arrow_column_to_device(arr, fld.dtype, cap))
+            up_s += time.perf_counter() - t0
+        if timers is not None:
+            timers["upload"] = timers.get("upload", 0.0) + up_s
         from ..columnar.batch import TpuBatch
         return TpuBatch(cols, self._schema, n_rows), encoded, decoded
 
+    # --- coalescing (device-decode path) ----------------------------------
+
+    @staticmethod
+    def _decoded_estimate(item) -> int:
+        """Decoded output bytes one planned row group will occupy on
+        device — the coalesce-target currency."""
+        n_rows, plans, host_rb, _ = item
+        est = host_rb.nbytes if host_rb is not None else 0
+        for plan in plans.values():
+            lane = plan.lane
+            est += plan.n_rows * (1 if lane == bool else lane.itemsize)
+            est += plan.str_char_cap
+        return est
+
+    @staticmethod
+    def _coalesce_compatible(a, b) -> bool:
+        """May two consecutive planned row groups merge into one fused
+        dispatch? Same device-plan column set (and lane/string shape),
+        same host-fallback schema, same partition values — the merge
+        itself handles heterogeneous dictionaries and sizes."""
+        _, pa_, ha, va = a
+        _, pb_, hb, vb = b
+        if va != vb or set(pa_) != set(pb_):
+            return False
+        if (ha is None) != (hb is None) \
+                or (ha is not None and not ha.schema.equals(hb.schema)):
+            return False
+        for k, x in pa_.items():
+            y = pb_[k]
+            if x.lane != y.lane \
+                    or (x.str_dict is None) != (y.str_dict is None):
+                return False
+        return True
+
+    @staticmethod
+    def _string_bound_ok(group, item) -> bool:
+        """The merged plan's worst-case string expansion must stay under
+        the device cap plan_chunk enforces per chunk."""
+        from .parquet_device import STR_EXPANSION_CAP
+        rows = sum(g[0] for g in group) + item[0]
+        for k, p in item[1].items():
+            if p.str_dict is None:
+                continue
+            ml = max([g[1][k].str_max_len for g in group]
+                     + [p.str_max_len])
+            if rows * max(ml, 1) > STR_EXPANSION_CAP:
+                return False
+        return True
+
+    def _coalesced_groups(self, planned, target_bytes: int,
+                          max_rows: int):
+        """Group consecutive planned row groups toward the target batch
+        byte size (split-ordered, so output order is deterministic).
+        target_bytes <= 0 keeps one group per dispatch."""
+        group: List = []
+        rows = est = 0
+        for item in planned:
+            if group and (rows + item[0] > max_rows
+                          or not self._coalesce_compatible(group[0], item)
+                          or not self._string_bound_ok(group, item)):
+                yield group
+                group, rows, est = [], 0, 0
+            group.append(item)
+            rows += item[0]
+            est += self._decoded_estimate(item)
+            if target_bytes <= 0 or est >= target_bytes \
+                    or rows >= max_rows:
+                yield group
+                group, rows, est = [], 0, 0
+        if group:
+            yield group
+
+    def _merge_planned(self, group):
+        """Fuse a coalesced group into one assembly unit: per-column
+        plan merge + host-fallback batch concat."""
+        if len(group) == 1:
+            return group[0]
+        from .parquet_device import merge_chunk_plans
+        n_rows = sum(g[0] for g in group)
+        plans = {k: merge_chunk_plans([g[1][k] for g in group])
+                 for k in group[0][1]}
+        host_rbs = [g[2] for g in group if g[2] is not None]
+        host_rb = None
+        if host_rbs:
+            t = pa.Table.from_batches(host_rbs).combine_chunks()
+            bs = t.to_batches()
+            host_rb = bs[0] if bs else host_rbs[0]
+        return n_rows, plans, host_rb, group[0][3]
+
     def _execute_device_decode(self, ctx: ExecCtx):
+        """The overlapped upload tunnel: row-group planning runs on the
+        reader pool, blob assembly + device_put + fused-decode dispatch
+        run on upload feeder thread(s) a bounded window ahead, and the
+        consumer computes on batch N while batch N+1 crosses the link —
+        the same feeder shape the legacy arrow path has, generalized
+        through pipeline.pipelined_map. In-flight batches are registered
+        with the device memory ledger until the consumer takes them."""
         conf = ctx.conf
         rows = ctx.metric(self, "numOutputRows")
         scan_t = ctx.metric(self, "scanTime")
+        asm_t = ctx.metric(self, "assembleTime")
         up_t = ctx.metric(self, "uploadTime")
+        wait_t = ctx.metric(self, "uploadWaitTime")
         enc_m = ctx.metric(self, "encodedBytes")
         dec_m = ctx.metric(self, "decodedBytes")
         tasks = self._device_rg_tasks()
@@ -691,30 +813,95 @@ class TpuFileScanExec(LeafExec):
             return
         nthreads = max(1, conf.get(PARQUET_MULTITHREADED_THREADS))
         depth = nthreads + max(0, conf.get(SCAN_PREFETCH_BATCHES))
-        with concurrent.futures.ThreadPoolExecutor(nthreads) as pool:
-            pending = []
+        up_threads = conf.get(SCAN_UPLOAD_THREADS)
+        window = max(1, conf.get(SCAN_INFLIGHT_BATCHES))
+        target_bytes = conf.get(SCAN_COALESCE_TARGET_BYTES)
+        max_rows = max(1, conf.batch_size_rows)
+        from ..memory import DeviceMemoryManager
+        mgr = DeviceMemoryManager.shared(conf)
+        pool = concurrent.futures.ThreadPoolExecutor(
+            nthreads, thread_name_prefix="scan-plan")
+
+        def planned():
+            pending: List = []
             it = iter(tasks)
+
             def topup():
                 while len(pending) < depth:
                     try:
                         p, g = next(it)
                     except StopIteration:
                         return
-                    pending.append(pool.submit(self._plan_row_group, p, g))
+                    pending.append(
+                        pool.submit(self._plan_row_group, p, g))
             topup()
             while pending:
                 t0 = time.perf_counter()
-                n_rows, plans, host_rb, part_vals = pending.pop(0).result()
+                item = pending.pop(0).result()
                 scan_t.value += time.perf_counter() - t0
                 topup()
-                t1 = time.perf_counter()
-                batch, encoded, decoded = self._assemble_device_batch(
-                    n_rows, plans, host_rb, part_vals)
-                up_t.value += time.perf_counter() - t1
+                yield item
+
+        inflight: set = set()  # ledger entries not yet handed over
+        ilock = threading.Lock()
+        closed = [False]
+
+        def assemble(group):
+            timers = {"assemble": 0.0, "upload": 0.0}
+            t0 = time.perf_counter()
+            n_rows, plans, host_rb, part_vals = self._merge_planned(group)
+            batch, encoded, decoded = self._assemble_device_batch(
+                n_rows, plans, host_rb, part_vals, timers=timers)
+            # whatever the wall spent that was not attributed to the
+            # transfer side is host assembly (merge, arena build, arrow
+            # prep)
+            timers["assemble"] = max(
+                0.0, time.perf_counter() - t0 - timers["upload"])
+            sb = mgr.register(batch, pinned=True)
+            with ilock:
+                if closed[0]:  # consumer already gone: never delivered
+                    sb.release()
+                    return None
+                inflight.add(sb)
+            return batch, sb, n_rows, encoded, decoded, timers
+
+        groups = self._coalesced_groups(planned(), target_bytes, max_rows)
+        gen = pipelined_map(assemble, groups, threads=up_threads,
+                            window=window)
+        try:
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    item = next(gen)
+                except StopIteration:
+                    break
+                wait_t.value += time.perf_counter() - t0
+                batch, sb, n_rows, encoded, decoded, timers = item
+                asm_t.value += timers["assemble"]
+                up_t.value += timers["upload"]
+                SCAN_ASSEMBLE_SECONDS.labels("device").observe(
+                    timers["assemble"])
+                SCAN_UPLOAD_SECONDS.labels("device").observe(
+                    timers["upload"])
                 enc_m.value += encoded
                 dec_m.value += decoded
                 rows.value += n_rows
+                with ilock:
+                    inflight.discard(sb)
+                sb.release()  # the consumer owns the batch now
                 yield batch
+        finally:
+            gen.close()
+            pool.shutdown(wait=False, cancel_futures=True)
+            # early exit: release every ledger charge the consumer never
+            # took delivery of (stragglers see closed[0] and release
+            # their own)
+            with ilock:
+                closed[0] = True
+                leftovers = list(inflight)
+                inflight.clear()
+            for sb in leftovers:
+                sb.release()
 
     def execute(self, ctx: ExecCtx):
         if self._use_device_decode(ctx.conf):
@@ -722,66 +909,49 @@ class TpuFileScanExec(LeafExec):
             return
         rows = ctx.metric(self, "numOutputRows")
         scan_t = ctx.metric(self, "scanTime")
+        asm_t = ctx.metric(self, "assembleTime")
         up_t = ctx.metric(self, "uploadTime")
+        wait_t = ctx.metric(self, "uploadWaitTime")
         target = arrow_schema(self._schema)
-        depth = ctx.conf.get(SCAN_PREFETCH_BATCHES)
-        if depth <= 0:
+
+        def upload(rb):
+            t0 = time.perf_counter()
+            rb = _align(rb, target)
+            t1 = time.perf_counter()
+            b = arrow_to_device(rb, self._schema)  # async DMA
+            return b, rb.num_rows, t1 - t0, time.perf_counter() - t1
+
+        def timed_source():
             t0 = time.perf_counter()
             for rb in self._host_batches(ctx):
                 scan_t.value += time.perf_counter() - t0
-                rb = _align(rb, target)
-                t1 = time.perf_counter()
-                b = arrow_to_device(rb, self._schema)
-                up_t.value += time.perf_counter() - t1
-                rows += rb.num_rows
-                yield b
+                yield rb
                 t0 = time.perf_counter()
-            return
-        # pipelined upload (SURVEY.md §7.3.4): a feeder thread aligns and
-        # ISSUES the host->device transfer for up to `depth` batches
+
+        # pipelined upload (SURVEY.md §7.3.4): a feeder thread aligns
+        # and ISSUES the host->device transfer for up to `depth` batches
         # ahead, so decode/upload of batch N+1 overlap device compute on
-        # batch N — the round-3 pipeline serialized decode -> upload ->
-        # compute per batch (VERDICT r3 weak #2). The queue bounds device
-        # residency of not-yet-consumed uploads.
-        q: "queue.Queue" = queue.Queue(maxsize=depth)
-        stop = threading.Event()
-
-        def feeder():
-            try:
-                t0 = time.perf_counter()
-                for rb in self._host_batches(ctx):
-                    if stop.is_set():
-                        return
-                    scan_t.value += time.perf_counter() - t0
-                    rb = _align(rb, target)
-                    t1 = time.perf_counter()
-                    b = arrow_to_device(rb, self._schema)  # async DMA
-                    up_t.value += time.perf_counter() - t1
-                    rows.value += rb.num_rows
-                    q.put((b, None))
-                    t0 = time.perf_counter()
-                q.put(None)
-            except BaseException as e:  # propagate into the consumer
-                q.put((None, e))
-
-        th = threading.Thread(target=feeder, daemon=True)
-        th.start()
+        # batch N. The window bounds device residency of not-yet-
+        # consumed uploads; depth <= 0 degrades to the serial path.
+        depth = ctx.conf.get(SCAN_PREFETCH_BATCHES)
+        gen = pipelined_map(upload, timed_source(), threads=1,
+                            window=max(depth, 0))
         try:
             while True:
-                item = q.get()
-                if item is None:
+                t0 = time.perf_counter()
+                try:
+                    b, n, asm_s, up_s = next(gen)
+                except StopIteration:
                     break
-                b, err = item
-                if err is not None:
-                    raise err
+                wait_t.value += time.perf_counter() - t0
+                asm_t.value += asm_s
+                up_t.value += up_s
+                SCAN_ASSEMBLE_SECONDS.labels("arrow").observe(asm_s)
+                SCAN_UPLOAD_SECONDS.labels("arrow").observe(up_s)
+                rows.value += n
                 yield b
         finally:
-            stop.set()
-            while True:  # unblock a feeder stuck on a full queue
-                try:
-                    q.get_nowait()
-                except queue.Empty:
-                    break
+            gen.close()
 
     def execute_cpu(self, ctx: ExecCtx):
         target = arrow_schema(self._schema)

@@ -32,7 +32,8 @@ from ..config import register
 
 __all__ = ["METRICS_ENABLED", "METRICS_PORT", "MetricsRegistry",
            "REGISTRY", "dump_prometheus", "maybe_start_http_server",
-           "render_merged_snapshots", "DEFAULT_BUCKETS"]
+           "render_merged_snapshots", "DEFAULT_BUCKETS",
+           "TRANSFER_BUCKETS"]
 
 METRICS_ENABLED = register(
     "spark.rapids.metrics.enabled", False,
@@ -49,6 +50,12 @@ METRICS_PORT = register(
 
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
                    float("inf"))
+# Finer low end for per-batch transfer-stage timings (scan assemble /
+# upload): a healthy overlapped tunnel spends hundreds of microseconds
+# to tens of milliseconds per batch, which DEFAULT_BUCKETS lumps into
+# two buckets.
+TRANSFER_BUCKETS = (0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                    0.05, 0.1, 0.25, 0.5, 1.0, 5.0, float("inf"))
 MAX_CHILDREN = 64
 _OTHER = "__other__"
 

@@ -1,0 +1,105 @@
+"""Shared ordered upload pipeline: overlap producer work with the consumer.
+
+One mechanism, three users (SURVEY.md §7.3.4; the reference hides
+host→device transfer behind compute with cuIO/UCX stream overlap):
+
+- the legacy arrow scan path (decode → align → ``arrow_to_device`` per
+  batch) runs its upload stage on a feeder thread ahead of the consumer;
+- the device-decode parquet path runs blob assembly + ``device_put`` +
+  fused-decode dispatch for row group N+1 on feeder thread(s) while the
+  consumer computes on batch N;
+- the host shuffle read side uploads partition file N+1 while the
+  consumer computes on N.
+
+``pipelined_map`` is the whole contract: results come back in
+submission order, the in-flight window is bounded (a slot is released
+only when the consumer RETRIEVES a result, so not-yet-consumed uploads
+— i.e. device residency — are capped at ``window``), worker and source
+exceptions surface at the consumer's corresponding ``next()``, and
+closing the generator early never deadlocks a feeder stuck on a full
+window.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, TypeVar
+
+__all__ = ["pipelined_map"]
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+_END = "end"
+_ERR = "err"
+_FUT = "fut"
+
+
+def pipelined_map(fn: Callable[[T], R], items: Iterable[T],
+                  threads: int = 1, window: int = 2) -> Iterator[R]:
+    """Yield ``fn(item)`` for each item, in order, with up to ``window``
+    results in flight across ``threads`` worker threads.
+
+    - ``threads <= 0`` or ``window <= 0`` degrades to the serial map
+      (no threads, no overlap) — the kill-switch path.
+    - The source iterator is advanced on a dedicated feeder thread, so
+      a blocking source (e.g. a row-group planner waiting on its own
+      pool) overlaps both the workers and the consumer.
+    - An exception raised by ``fn`` is re-raised at the ``next()`` call
+      that would have yielded that item's result; an exception raised
+      by the source iterator is re-raised after every earlier result
+      was delivered.
+    - ``close()`` (or GC) of the generator stops the feeder, cancels
+      queued work, and returns without waiting for stragglers.
+    """
+    if threads <= 0 or window <= 0:
+        for x in items:
+            yield fn(x)
+        return
+
+    out: "queue.Queue" = queue.Queue()
+    slots = threading.Semaphore(window)
+    stop = threading.Event()
+    pool = concurrent.futures.ThreadPoolExecutor(
+        max_workers=threads, thread_name_prefix="pipelined-map")
+
+    def feeder():
+        try:
+            for x in items:
+                if stop.is_set():
+                    return
+                slots.acquire()
+                if stop.is_set():
+                    return
+                out.put((_FUT, pool.submit(fn, x)))
+            out.put((_END, None))
+        except BaseException as e:  # source iterator failed
+            out.put((_ERR, e))
+
+    th = threading.Thread(target=feeder, daemon=True,
+                          name="pipelined-map-feeder")
+    th.start()
+    try:
+        while True:
+            kind, val = out.get()
+            if kind == _END:
+                return
+            if kind == _ERR:
+                raise val
+            try:
+                result = val.result()  # re-raises worker exceptions
+            finally:
+                slots.release()
+            yield result
+    finally:
+        stop.set()
+        slots.release()  # unblock a feeder parked on a full window
+        while True:  # drop queued work so the pool can drain
+            try:
+                kind, val = out.get_nowait()
+            except queue.Empty:
+                break
+            if kind == _FUT:
+                val.cancel()
+        pool.shutdown(wait=False, cancel_futures=True)

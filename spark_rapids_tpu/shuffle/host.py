@@ -103,6 +103,7 @@ class HostShuffleTransport(ShuffleTransport):
             raise ValueError(
                 f"unsupported host-shuffle codec {self.codec!r}; Arrow "
                 f"IPC supports {_IPC_CODECS}")
+        self._conf = conf
         if threads is None:
             threads = conf.get(SHUFFLE_THREADS)
         self._pool = concurrent.futures.ThreadPoolExecutor(
@@ -287,6 +288,7 @@ class HostShuffleTransport(ShuffleTransport):
     def read_partition(self, shuffle_id: int, partition_id: int):
         import time as _time
         from ..columnar.arrow_bridge import arrow_to_device
+        from ..pipeline import pipelined_map
         t0 = _time.perf_counter()
         self._drain(shuffle_id)  # the multithreaded-writer wait
         schema = self._schemas.get(shuffle_id)
@@ -294,16 +296,59 @@ class HostShuffleTransport(ShuffleTransport):
                                                partition_id)
         SHUF_FETCH_WAIT.labels("host").observe(_time.perf_counter() - t0)
         SHUF_PARTS_FETCHED.labels("host").inc()
-        for path in paths:
-            t1 = _time.perf_counter()
+
+        from ..memory import DeviceMemoryManager
+        mgr = DeviceMemoryManager.shared(self._conf)
+        inflight = set()  # ledger entries not yet handed to the consumer
+        ilock = threading.Lock()
+        closed = [False]
+
+        def load(path):
             with pa.OSFile(path, "rb") as f:
                 table = pa.ipc.open_file(f).read_all()
-            SHUF_FETCH_WAIT.labels("host").observe(
-                _time.perf_counter() - t1)
-            SHUF_BYTES_FETCHED.labels("host").inc(table.nbytes)
-            for rb in table.combine_chunks().to_batches():
-                if rb.num_rows:
-                    yield arrow_to_device(rb, schema)
+            batches = [arrow_to_device(rb, schema)
+                       for rb in table.combine_chunks().to_batches()
+                       if rb.num_rows]
+            # in-flight uploads are ledger-visible until delivered, like
+            # the scan's upload tunnel (eviction pressure must see them)
+            sbs = [mgr.register(b, pinned=True) for b in batches]
+            with ilock:
+                if closed[0]:
+                    for sb in sbs:
+                        sb.release()
+                    return table.nbytes, batches, []
+                inflight.update(sbs)
+            return table.nbytes, batches, sbs
+
+        # fetch->upload overlap, same shape as the scan's upload tunnel:
+        # file N+1 is read, decompressed, and uploaded on a feeder
+        # thread while the consumer computes on N's batches; the window
+        # bounds in-flight (uploaded, unconsumed) partition files — one
+        # RecordBatch per file by the writer's construction.
+        gen = pipelined_map(load, paths, threads=1, window=2)
+        try:
+            while True:
+                t1 = _time.perf_counter()
+                try:
+                    nbytes, batches, sbs = next(gen)
+                except StopIteration:
+                    break
+                SHUF_FETCH_WAIT.labels("host").observe(
+                    _time.perf_counter() - t1)
+                SHUF_BYTES_FETCHED.labels("host").inc(nbytes)
+                with ilock:
+                    inflight.difference_update(sbs)
+                for sb in sbs:
+                    sb.release()  # the consumer owns them now
+                yield from batches
+        finally:
+            gen.close()
+            with ilock:
+                closed[0] = True
+                leftovers = list(inflight)
+                inflight.clear()
+            for sb in leftovers:
+                sb.release()
 
     def unregister_shuffle(self, shuffle_id: int):
         self._drain(shuffle_id)

@@ -74,6 +74,20 @@ def generate_supported_ops() -> str:
     for cls in sorted(_expr_classes(), key=lambda c: c.__name__):
         note = _first_line(cls.__doc__)
         lines.append(f"| {cls.__name__} | {note} |")
+    lines += [
+        "", "## Format notes", "",
+        "- Parquet device decode "
+        "(`spark.rapids.sql.format.parquet.deviceDecode.enabled`): the "
+        "supported envelope is unchanged by the overlapped/coalesced "
+        "upload tunnel — v1 data pages of flat int32/int64/float/"
+        "double/boolean in PLAIN / PLAIN_DICTIONARY / RLE_DICTIONARY "
+        "encodings (plus dictionary-encoded strings), snappy/zstd/gzip/"
+        "brotli codecs, definition depth <= 1. Everything else "
+        "(nested, v2 pages, DELTA_*, LZ4, PLAIN strings) still decodes "
+        "on host per column chunk, and pipelining/coalescing never "
+        "widens that envelope: coalesced row groups merge only when "
+        "every column takes the same (device or host) route.",
+    ]
     return "\n".join(lines)
 
 

@@ -56,10 +56,38 @@ def profile_report(pp, ctx=None) -> str:
             recs.append(f"{label}: {sp.value * 1e3:.0f}ms spilling — "
                         "raise spark.rapids.memory.device.budgetBytes "
                         "or reduce concurrency")
+        asm = ms.get("assembleTime")
+        if asm is not None and asm.value > 0.5:
+            recs.append(f"{label}: {asm.value * 1e3:.0f}ms assembling "
+                        "host blobs — raise "
+                        "spark.rapids.sql.scan.uploadThreads or the "
+                        "reader pool size")
         up = ms.get("uploadTime")
         if up is not None and up.value > 0.5:
-            recs.append(f"{label}: {up.value * 1e3:.0f}ms uploading — "
-                        "keep data device-resident between stages")
+            wait = ms.get("uploadWaitTime")
+            scan_v = ms.get("scanTime")
+            scan_v = scan_v.value if scan_v is not None else 0.0
+            if wait is not None and up.value > 0:
+                # uploadWaitTime is ALL consumer blocking on the next
+                # batch — when planning (scanTime) outweighs uploadTime
+                # the feeder was starved by the reader pool, not the
+                # tunnel, and uploadThreads is the wrong lever
+                hidden = max(0.0, 1.0 - wait.value / up.value)
+                if hidden >= 0.5:
+                    lever = "keep data device-resident between stages"
+                elif scan_v > up.value:
+                    lever = ("the wait is planning-bound — raise the "
+                             "parquet multiThreadedRead.numThreads "
+                             "reader pool, not uploadThreads")
+                else:
+                    lever = ("raise spark.rapids.sql.scan.uploadThreads"
+                             " / inFlightBatches to overlap more of it")
+                recs.append(
+                    f"{label}: {up.value * 1e3:.0f}ms uploading, "
+                    f"~{hidden:.0%} hidden behind compute — " + lever)
+            else:
+                recs.append(f"{label}: {up.value * 1e3:.0f}ms uploading "
+                            "— keep data device-resident between stages")
     fb = pp.fallback_nodes()
     if fb:
         recs.append("CPU fallbacks present: " + ", ".join(sorted(set(fb)))
@@ -110,7 +138,7 @@ def profile_event_logs(path: str) -> str:
         for label, ms in ev.get("metrics", {}).items():
             op = label.split("#")[0]
             for mname in ("opTime", "spillTime", "uploadTime",
-                          "scanTime"):
+                          "assembleTime", "uploadWaitTime", "scanTime"):
                 v = ms.get(mname)
                 if isinstance(v, (int, float)):
                     roll[(op, mname)] += float(v)

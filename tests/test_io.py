@@ -194,6 +194,85 @@ def test_parquet_device_decode_dict_strings(tmp_path):
     assert_tpu_and_cpu_plan_equal(TpuFileScanExec([p2]))
 
 
+def test_parquet_device_decode_coalesced_bit_exact(tmp_path):
+    """Quantized-arena/coalesced path vs the per-row-group path vs the
+    CPU oracle, across dtype lanes, null patterns, and PLAIN/dict/RLE
+    mixes (data_gen generators + crafted encoding-specific columns)."""
+    from data_gen import BooleanGen, DoubleGen
+    rng = np.random.default_rng(11)
+    n = 16_000
+    rb = gen_table([IntegerGen(null_frac=0.3), LongGen(null_frac=0),
+                    DoubleGen(), BooleanGen(null_frac=0),
+                    StringGen(max_len=9, null_frac=0.2), DateGen()],
+                   n=n, seed=5, names=["ni", "l", "d", "b", "s", "dt"])
+    arrays = {name: rb.column(i) for i, name in enumerate(rb.schema.names)}
+    grp = np.arange(n) // 3000
+    # heterogeneous dictionaries: each row group's value set is disjoint
+    arrays["dict_i32"] = pa.array(
+        (rng.integers(0, 7, n) + grp * 1000).astype(np.int32))
+    arrays["rle"] = pa.array(np.sort(rng.integers(0, 5, n))
+                             .astype(np.int64))
+    arrays["plain_f32"] = pa.array(rng.uniform(0, 1, n)
+                                   .astype(np.float32))
+    p = os.path.join(str(tmp_path), "c.parquet")
+    pq.write_table(pa.table(arrays), p, row_group_size=3000,
+                   compression="snappy")
+    want = pa.Table.from_batches(
+        list(TpuFileScanExec([p]).execute_cpu(ExecCtx())))
+    n_batches = {}
+    for label, target in (("per_group", "0"), ("coalesced", "1g")):
+        conf = RapidsConf(
+            {"spark.rapids.sql.scan.coalesceTargetBytes": target})
+        scan = TpuFileScanExec([p], conf=conf)
+        bs = [_to_arrow(b) for b in scan.execute(ExecCtx(conf))]
+        n_batches[label] = len(bs)
+        assert _canon(pa.Table.from_batches(bs)) == _canon(want), label
+    # the coalescer genuinely fused row groups into fewer dispatches
+    assert n_batches["per_group"] == 6
+    assert n_batches["coalesced"] < n_batches["per_group"]
+
+
+def test_parquet_device_decode_jit_cache_quantized(tmp_path):
+    """Heterogeneous row groups of one schema must NOT compile one
+    fused-decode program per row group: the quantized arena collapses
+    the JIT cache to a couple of variants per capacity bucket, and a
+    re-scan is fully cache-hot."""
+    from spark_rapids_tpu.io import parquet_device as pd_
+    rng = np.random.default_rng(13)
+    n = 36_000
+    grp = np.arange(n) // 8000  # 4 full groups + one 4000-row tail
+    arrays = {
+        "a": pa.array((rng.integers(0, 6, n) + grp * 100)
+                      .astype(np.int32)),
+        "b": pa.array(rng.integers(0, 50, n).astype(np.int64),
+                      mask=rng.uniform(0, 1, n) < 0.15),
+        "c": pa.array([f"g{g}x{i % 9}" for i, g in enumerate(grp)]),
+    }
+    p = os.path.join(str(tmp_path), "h.parquet")
+    pq.write_table(pa.table(arrays), p, row_group_size=8000,
+                   compression="zstd")
+    conf = RapidsConf(
+        {"spark.rapids.sql.scan.coalesceTargetBytes": "0"})
+    pd_._JIT_CACHE.clear()
+    scan = TpuFileScanExec([p], conf=conf)
+    got = pa.Table.from_batches(
+        [_to_arrow(b) for b in scan.execute(ExecCtx(conf))])
+    want = pa.Table.from_batches(
+        list(TpuFileScanExec([p]).execute_cpu(ExecCtx())))
+    assert _canon(got) == _canon(want)
+    keys = [k for k in pd_._JIT_CACHE if k[0] == "rg"]
+    caps = {k[1] for k in keys}
+    # 5 heterogeneous row groups, 2 capacity buckets (8192 + the tail's
+    # 4096): at most a couple of program variants per capacity bucket —
+    # the raw-offset cache key compiled one program PER GROUP
+    assert len(keys) < 5, keys
+    assert len(keys) <= 2 * len(caps), keys
+    # second scan: zero new compilations
+    before = len(pd_._JIT_CACHE)
+    list(TpuFileScanExec([p], conf=conf).execute(ExecCtx(conf)))
+    assert len(pd_._JIT_CACHE) == before
+
+
 def test_parquet_device_decode_fallback_encodings(tmp_path):
     """DELTA_BINARY_PACKED / byte-stream-split chunks are outside the
     device envelope: per-chunk host fallback keeps results right."""

@@ -172,12 +172,67 @@ def run_smoke(out_dir):
     return traces[-1], prom_path
 
 
+_SCAN_METRICS = ("assembleTime", "uploadTime", "uploadWaitTime",
+                 "scanTime")
+_SCAN_FAMILIES = ("rapids_scan_assemble_seconds",
+                  "rapids_scan_upload_seconds")
+
+
+def run_scan_smoke(out_dir):
+    """Device-decode parquet scan smoke (CPU backend): run a small
+    multi-row-group scan through the overlapped upload tunnel, check
+    the rows against the host-decode oracle, assert the
+    assemble/upload metric split exists, and dump the process metrics
+    registry for Prometheus validation. Returns the prom path."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.columnar.arrow_bridge import device_to_arrow
+    from spark_rapids_tpu.exec.base import ExecCtx
+    from spark_rapids_tpu.io import TpuFileScanExec
+    from spark_rapids_tpu.obs.metrics import dump_prometheus
+    rng = np.random.default_rng(0)
+    n = 6000
+    t = pa.table({
+        "i": pa.array(rng.integers(0, 9, n).astype(np.int32)),
+        "f": pa.array(rng.uniform(0, 1, n)),
+        "ni": pa.array(rng.integers(0, 40, n).astype(np.int64),
+                       mask=rng.uniform(0, 1, n) < 0.2),
+        "s": pa.array([f"v{i % 11}" for i in range(n)]),
+    })
+    path = os.path.join(out_dir, "scan_smoke.parquet")
+    pq.write_table(t, path, row_group_size=1024, compression="snappy")
+    scan = TpuFileScanExec([path])
+    ctx = ExecCtx()
+    got = pa.Table.from_batches(
+        [device_to_arrow(b) for b in scan.execute(ctx)])
+    want = pa.Table.from_batches(
+        list(TpuFileScanExec([path]).execute_cpu(ExecCtx())))
+    assert got.to_pydict() == want.to_pydict(), \
+        "device-decode scan disagrees with host decode"
+    m = ctx.metrics[scan.node_label()]
+    missing = [name for name in _SCAN_METRICS if name not in m]
+    assert not missing, f"scan metrics missing: {missing}"
+    assert m["uploadTime"].value >= 0 and m["assembleTime"].value >= 0
+    prom = dump_prometheus()
+    missing = [f for f in _SCAN_FAMILIES if f + "_count" not in prom]
+    assert not missing, f"obs families missing samples: {missing}"
+    prom_path = os.path.join(out_dir, "scan_metrics.prom")
+    with open(prom_path, "w") as f:
+        f.write(prom)
+    return prom_path
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--trace", help="Chrome trace JSON to validate")
     ap.add_argument("--prom", help="Prometheus text file to validate")
     ap.add_argument("--smoke", metavar="DIR",
                     help="run a tiny traced query, emit + validate")
+    ap.add_argument("--scan-smoke", metavar="DIR", dest="scan_smoke",
+                    help="run a device-decode parquet scan, check the "
+                         "assemble/upload metric split, emit + validate")
     args = ap.parse_args(argv)
     errors = []
     trace, prom = args.trace, args.prom
@@ -185,8 +240,13 @@ def main(argv=None):
         os.makedirs(args.smoke, exist_ok=True)
         trace, prom = run_smoke(args.smoke)
         print(f"smoke outputs: {trace} {prom}")
+    if args.scan_smoke:
+        os.makedirs(args.scan_smoke, exist_ok=True)
+        prom = run_scan_smoke(args.scan_smoke)
+        print(f"scan smoke output: {prom}")
     if not trace and not prom:
-        ap.error("nothing to do: pass --trace/--prom/--smoke")
+        ap.error("nothing to do: pass --trace/--prom/--smoke/"
+                 "--scan-smoke")
     if trace:
         errors += [f"[trace] {e}" for e in check_trace(trace)]
     if prom:

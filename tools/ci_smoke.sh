@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # CI smoke: the gate that keeps a syntax error (or any import-breaking
-# change) out of a seed.  Three escalating checks; fails fast:
+# change) out of a seed.  Escalating checks; fails fast:
 #
 #   1. byte-compile every module           (catches SyntaxError anywhere)
 #   2. import the package                  (catches import-time errors)
@@ -9,26 +9,33 @@
 #   4. observability smoke: one tiny query with tracing + metrics on,
 #      then schema-check the emitted Chrome trace JSON and Prometheus
 #      text (tools/check_obs_output.py)
+#   5. device-decode scan smoke (CPU backend): a multi-row-group
+#      parquet scan through the overlapped upload tunnel, checked
+#      against the host-decode oracle, with the assemble/upload metric
+#      split validated in the Prometheus dump
 #
 # Pass --full to also run the tier-1 suite (see ROADMAP.md), bounded to
 # 870s like the driver's own gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/4 compileall =="
+echo "== 1/5 compileall =="
 python -m compileall -q spark_rapids_tpu tests
 
-echo "== 2/4 package import =="
+echo "== 2/5 package import =="
 JAX_PLATFORMS=cpu python -c "import spark_rapids_tpu; print('import ok:', spark_rapids_tpu.__name__)"
 
-echo "== 3/4 pytest collection =="
+echo "== 3/5 pytest collection =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q --collect-only -m 'not slow' \
     -p no:cacheprovider 2>&1 | tail -3
 
-echo "== 4/4 observability smoke =="
+echo "== 4/5 observability smoke =="
 OBS_TMP="$(mktemp -d)"
 trap 'rm -rf "$OBS_TMP"' EXIT
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --smoke "$OBS_TMP"
+
+echo "== 5/5 device-decode scan smoke =="
+JAX_PLATFORMS=cpu python tools/check_obs_output.py --scan-smoke "$OBS_TMP/scan"
 
 if [[ "${1:-}" == "--full" ]]; then
     echo "== tier-1 (full) =="
