@@ -46,12 +46,18 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import pyarrow as pa
 
 from . import datatypes as dt
-from .config import HEARTBEAT_INTERVAL, INJECT_FAULTS, RapidsConf
+from .config import (FLIGHT_ENABLED, FLIGHT_STRAGGLER_FACTOR,
+                     HEARTBEAT_INTERVAL, INJECT_FAULTS, RapidsConf)
 from .exec.base import ExecCtx, LeafExec, TpuExec
 from .obs.metrics import (METRICS_ENABLED, REGISTRY,
                           flush_worker_metrics, maybe_start_http_server,
                           read_worker_metrics, render_merged_snapshots)
-from .obs.tracer import (NULL_TRACER, TRACE_DIR, Tracer, tracer_from_conf)
+from .obs.recorder import (RECORDER, flush_worker_ring,
+                           next_incident_seq, read_flight_dumps,
+                           read_worker_rings, resolve_flight_dir,
+                           write_incident_bundle)
+from .obs.tracer import (NULL_TRACER, TRACE_DIR, TRACE_MAX_FILES, Tracer,
+                         tracer_from_conf)
 from .scheduler import TaskScheduler, TaskSpec
 from .shuffle.host import (SHUF_BYTES_FETCHED, SHUF_FETCH_WAIT,
                            SHUF_PARTS_FETCHED)
@@ -117,6 +123,10 @@ class ProcessShuffleReadExec(LeafExec):
                     if rb.num_rows:
                         yield rb
             fetched.inc()
+            # flight-recorder tap: fetch-blocked time lands in the
+            # always-on ring even with tracing disabled
+            RECORDER.record("shuffle", ev="fetch", sid=self.shuffle_id,
+                            part=int(pid), wait_s=round(io_s, 6))
             if tracer.enabled:
                 tracer.emit(
                     f"shuffle_fetch s{self.shuffle_id} p{pid}",
@@ -125,7 +135,15 @@ class ProcessShuffleReadExec(LeafExec):
     def execute(self, ctx: ExecCtx):
         from .columnar.arrow_bridge import arrow_to_device
         for rb in self._host_batches(ctx):
-            yield arrow_to_device(rb, self._schema)
+            b = arrow_to_device(rb, self._schema)
+            # fetched uploads are device-memory-ledger-visible, like the
+            # in-process host transport's (shuffle/host.py): eviction
+            # pressure sees them and the flight recorder gets the
+            # reserve/release transitions for its HBM timeline. Released
+            # on handoff — the consumer owns the batch from here.
+            sb = ctx.mm.register(b, pinned=True)
+            sb.release()
+            yield b
 
     def execute_cpu(self, ctx: ExecCtx):
         yield from self._host_batches(ctx)
@@ -200,6 +218,36 @@ def _run_collect_task(payload: Dict, tracer=NULL_TRACER) -> None:
 
 
 _TASK_KINDS = {"map": _run_map_task, "collect": _run_collect_task}
+
+
+def _flush_task_flight(root: str, worker_id: int, task_path: str,
+                       task_id: str, attempt: int, since: float,
+                       failed: bool, error: str = "") -> None:
+    """Worker-side anomaly evaluation after an attempt: when a trigger
+    fires (task failure, OOM-retry, spill cascade — obs/anomaly.py),
+    atomically commit a ``<task>.flight.json`` dump next to the task's
+    rendezvous markers, then re-flush the incarnation ring. Best
+    effort: forensics must never fail (or resurrect) the task."""
+    if not RECORDER.enabled:
+        return
+    try:
+        from .obs.anomaly import AnomalyDetector
+        trig = AnomalyDetector().check_task(
+            RECORDER.snapshot(since=since), failed, error)
+        if trig is not None:
+            kind, reason = trig
+            doc = {"proc": f"w{worker_id}", "pid": os.getpid(),
+                   "task": task_id, "attempt": attempt,
+                   "trigger": kind, "reason": reason,
+                   "ts": time.time(), "events": RECORDER.snapshot(),
+                   "metrics": REGISTRY.snapshot()}
+            tmp = task_path + ".flight.json.tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, task_path + ".flight.json")
+        flush_worker_ring(root, worker_id)
+    except Exception:  # noqa: BLE001 — observability is best-effort
+        pass
 
 
 def _flush_task_obs(root: str, worker_id: int, task_path: str, tracer,
@@ -309,6 +357,22 @@ def worker_main(root: str, worker_id: int, poll_s: float = 0.02,
                           f"a{payload.get('attempt', 0)}.") \
                 if tctx else NULL_TRACER
             settings = payload.get("conf", {}) or {}
+            task_id = payload.get("task_id", "?")
+            attempt = payload.get("attempt", 0)
+            # the flight recorder is always-on: record the claim and
+            # flush the incarnation ring to disk BEFORE the chaos hook
+            # / user code runs, so even an os._exit crash leaves the
+            # attempt's preceding events behind for the driver harvest
+            RECORDER.configure(RapidsConf(settings))
+            claim_wall = time.time()
+            RECORDER.record("task", ev="claim", task=task_id,
+                            attempt=attempt, task_kind=kind,
+                            worker=worker_id)
+            if RECORDER.enabled:
+                try:
+                    flush_worker_ring(root, worker_id)
+                except OSError:
+                    pass
             try:
                 with open(path + ".claim.tmp", "w") as f:
                     f.write(f"{worker_id} {time.time()}")
@@ -324,13 +388,24 @@ def worker_main(root: str, worker_id: int, poll_s: float = 0.02,
                         args={"kind": kind, "worker": worker_id}):
                     _TASK_KINDS[kind](payload, tracer)
                 _flush_task_obs(root, worker_id, path, tracer, settings)
+                RECORDER.record("task", ev="ok", task=task_id,
+                                attempt=attempt, worker=worker_id)
+                _flush_task_flight(root, worker_id, path, task_id,
+                                   attempt, claim_wall, failed=False)
                 with open(done + ".tmp", "w") as f:
                     f.write("ok")
                 os.replace(done + ".tmp", done)
             except BaseException:
+                tb = traceback.format_exc()
                 _flush_task_obs(root, worker_id, path, tracer, settings)
+                RECORDER.record("task", ev="err", task=task_id,
+                                attempt=attempt, worker=worker_id,
+                                error=tb.strip().splitlines()[-1][:200])
+                _flush_task_flight(root, worker_id, path, task_id,
+                                   attempt, claim_wall, failed=True,
+                                   error=tb)
                 with open(err + ".tmp", "w") as f:
-                    f.write(traceback.format_exc())
+                    f.write(tb)
                 os.replace(err + ".tmp", err)
             ran = True
         if not ran:
@@ -508,9 +583,14 @@ class TpuProcessCluster:
         self._sid_seq = 0
         self.last_scheduler: Optional[TaskScheduler] = None
         self.last_trace_path: Optional[str] = None
+        self.last_incident_path: Optional[str] = None
         # the /metrics port belongs to the driver; the cluster driver
         # never builds an ExecCtx, so bind it here rather than lazily
         maybe_start_http_server(self.conf)
+        # always-on flight recorder (spark.rapids.flight.*): the driver
+        # ring records scheduler/shuffle/memory events passively; an
+        # anomaly turns it into an incident bundle at query end
+        RECORDER.configure(self.conf)
 
     def shutdown(self) -> None:
         self.pool.shutdown()
@@ -546,6 +626,7 @@ class TpuProcessCluster:
         self._query_seq += 1
         qid = self._query_seq
         tracer = tracer_from_conf(conf)
+        RECORDER.configure(conf)
         sched = TaskScheduler(self.pool, os.path.join(self.root, "tasks"),
                               conf, query_id=f"q{qid}", tracer=tracer)
         self.last_scheduler = sched
@@ -572,6 +653,63 @@ class TpuProcessCluster:
             from .tools.event_log import log_scheduler_events
             log_scheduler_events(conf, f"q{qid}", sched,
                                  time.time() - t0)
+            # flight recorder: when anything anomalous happened this
+            # query (failed attempts, worker deaths, stragglers, or a
+            # worker committed a flight dump), harvest every process's
+            # ring into ONE incident bundle — works with tracing and
+            # metrics fully disabled
+            try:
+                self._maybe_write_incident(conf, qid, sched, tracer, t0)
+            except Exception:  # noqa: BLE001 — forensics must never
+                pass           # fail (or mask) the query itself
+
+    def _maybe_write_incident(self, conf: RapidsConf, qid: int,
+                              sched: TaskScheduler, tracer,
+                              t0: float) -> None:
+        """Harvest pass: driver ring + every worker incarnation's ring
+        file + worker flight dumps + metrics snapshots -> one
+        ``incident-<id>-<seq>.json`` under the flight dir. No-op when
+        the query was clean or the recorder is disabled."""
+        if not conf.get(FLIGHT_ENABLED):
+            return
+        from .obs.anomaly import (anomalies_from_scheduler,
+                                  build_incident_bundle)
+        anomalies = anomalies_from_scheduler(sched.events)
+        dumps = read_flight_dumps(os.path.join(self.root, "tasks"),
+                                  query_id=f"q{qid}")
+        if not anomalies and not dumps:
+            return
+        # the incident id reuses the trace id when tracing ran (so the
+        # bundle and the Chrome trace cross-reference); otherwise a
+        # fresh one — the recorder never requires tracing
+        import uuid
+        fid = tracer.trace_id if getattr(tracer, "enabled", False) \
+            else uuid.uuid4().hex[:16]
+        metrics = {"driver": REGISTRY.snapshot()}
+        for tag, snap in read_worker_metrics(self.root):
+            metrics[tag] = snap
+        # scope worker rings to this query like the driver ring: an
+        # unfiltered ring file (esp. a previous query's dead
+        # incarnation) would smear an earlier query's HBM occupancy
+        # into this incident's timeline
+        rings = []
+        for tag, doc in read_worker_rings(self.root):
+            evs = [e for e in doc.get("events", [])
+                   if e.get("ts", 0.0) >= t0]
+            if evs:
+                rings.append((tag, dict(doc, events=evs)))
+        bundle = build_incident_bundle(
+            query_id=f"q{qid}", flight_id=fid, seq=next_incident_seq(),
+            trigger_anomalies=anomalies,
+            driver_events=RECORDER.snapshot(since=t0),
+            worker_rings=rings,
+            worker_dumps=dumps, sched_events=sched.events,
+            metrics_snapshot=metrics, conf=conf,
+            straggler_factor=conf.get(FLIGHT_STRAGGLER_FACTOR),
+            since=t0)
+        self.last_incident_path = write_incident_bundle(
+            resolve_flight_dir(conf, self.root), bundle,
+            max_files=conf.get(TRACE_MAX_FILES))
 
     def prometheus_text(self) -> str:
         """One Prometheus exposition document over the driver's registry

@@ -339,6 +339,38 @@ INJECT_FAULTS = register(
     "ids (e.g. 'q1s1m0'), attempt an int or '*'. See scheduler/chaos.py.",
     internal=True)
 
+# --- Flight recorder ------------------------------------------------------
+FLIGHT_ENABLED = register(
+    "spark.rapids.flight.enabled", True,
+    "Always-on flight recorder: every process keeps a bounded ring of "
+    "recent span closures, memory-ledger transitions, scheduler events "
+    "and shuffle waits, and dumps a self-contained incident bundle "
+    "when an anomaly fires (task failure, worker death, OOM-retry or "
+    "spill cascade, statistical straggler) — forensics without having "
+    "pre-enabled tracing. Recording is a bounded deque append; disable "
+    "only to rule the recorder out while debugging the recorder.")
+FLIGHT_DIR = register(
+    "spark.rapids.flight.dir", "",
+    "Directory for incident bundles "
+    "(incident-<trace_id>-<seq>.json). Empty = <cluster root>/flight "
+    "for process-cluster queries, so bundles land somewhere useful "
+    "even with zero configuration.")
+FLIGHT_MAX_EVENTS = register(
+    "spark.rapids.flight.maxEvents", 2048,
+    "Per-process flight-recorder ring bound in events; the oldest "
+    "events are evicted first (black-box semantics).")
+FLIGHT_MAX_BYTES = register(
+    "spark.rapids.flight.maxBytes", 1 << 20,
+    "Per-process flight-recorder ring bound in (approximate) bytes — "
+    "the second bound that keeps a pathological event burst from "
+    "exhausting memory even under maxEvents.", conv=_bytes_conv)
+FLIGHT_STRAGGLER_FACTOR = register(
+    "spark.rapids.flight.stragglerFactor", 6.0,
+    "Statistical straggler trigger: a running attempt whose runtime "
+    "exceeds this many times the stage's running median completed-task "
+    "time (and the speculation.minRuntime floor) is recorded as an "
+    "anomaly — independent of whether speculation is enabled.")
+
 # --- UDF ------------------------------------------------------------------
 UDF_COMPILER_ENABLED = register(
     "spark.rapids.sql.udfCompiler.enabled", True,

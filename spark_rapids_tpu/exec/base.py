@@ -80,6 +80,11 @@ class ExecCtx:
         self.tracer = tracer_from_conf(self.conf)
         from ..obs.metrics import maybe_start_http_server
         maybe_start_http_server(self.conf)
+        # always-on flight recorder adopts this query's bounds
+        # (spark.rapids.flight.*); recording stays a bounded deque
+        # append whether or not tracing is enabled
+        from ..obs.recorder import RECORDER
+        RECORDER.configure(self.conf)
 
     def metric(self, node: "TpuExec", name: str) -> TpuMetric:
         m = self.metrics.setdefault(node.node_label(), {})

@@ -30,6 +30,7 @@ from .config import (ALLOC_FRACTION, CONCURRENT_TPU_TASKS, OOM_MAX_SPLITS,
                      OOM_RETRY_BLOCKING, OOM_RETRY_ENABLED, RapidsConf,
                      TEST_RETRY_OOM_INJECT, register, _bytes_conv)
 from .obs.metrics import REGISTRY as _METRICS
+from .obs.recorder import RECORDER as _FLIGHT
 
 __all__ = ["DeviceMemoryManager", "SpillableBatch", "TpuRetryOOM",
            "split_batch"]
@@ -190,6 +191,7 @@ class SpillableBatch:
                 self._mgr.host_bytes += self.host_nbytes
             _MEM_SPILL_BYTES.inc(self.nbytes)
             self._mgr._sync_gauges()
+            self._mgr._flight_mem("spill", self.nbytes)
         finally:
             self._state_lock.release()
         if cascade:
@@ -225,6 +227,7 @@ class SpillableBatch:
                 self._mgr.disk_spill_bytes += self.host_nbytes
             _MEM_DISK_SPILL_BYTES.inc(self.host_nbytes)
             self._mgr._sync_gauges()
+            self._mgr._flight_mem("disk_spill", self.host_nbytes)
         finally:
             self._state_lock.release()
 
@@ -362,12 +365,25 @@ class DeviceMemoryManager:
         self._alloc_sites: dict = {}  # id -> traceback summary
         _MEM_DEVICE_BUDGET.set(self.budget)
         self._sync_gauges()
+        self._flight_mem("budget")
 
     def _sync_gauges(self):
         """Publish the ledger to the process registry — plain attribute
         writes, cheap enough to run on every transition."""
         _MEM_DEVICE_IN_USE.set(self.device_bytes)
         _MEM_HOST_IN_USE.set(self.host_bytes)
+
+    def _flight_mem(self, ev: str, nbytes: int = 0, **extra):
+        """Flight-recorder tap: every ledger transition lands in the
+        always-on ring with the in-use bytes AFTER it — the per-process
+        HBM timeline an incident bundle replays (high-water tracking is
+        derived at harvest, obs/recorder.memory_timeline). The budget
+        rides on EVERY event (one int): an incident harvest scopes
+        rings to its query window, which would otherwise drop the lone
+        construction-time budget record of a long-lived manager."""
+        _FLIGHT.record("mem", ev=ev, bytes=int(nbytes),
+                       device=self.device_bytes, host=self.host_bytes,
+                       budget=self.budget, **extra)
 
     def _debug(self, event: str, sb: "SpillableBatch"):
         if self._mem_debug:
@@ -423,6 +439,7 @@ class DeviceMemoryManager:
                     traceback.format_stack(limit=6)[:-1]).strip()
         self._evict_to_fit(exclude=id(sb) if pinned else None)
         self._sync_gauges()
+        self._flight_mem("reserve", sb.nbytes)
         self._debug("register", sb)
         return sb
 
@@ -436,6 +453,7 @@ class DeviceMemoryManager:
         # itself to disk mid-re-upload and skew the host ledger
         self._evict_to_fit(exclude=id(sb))
         self._sync_gauges()
+        self._flight_mem("readback", nbytes)
 
     def _touch(self, sb: SpillableBatch):
         with self._lock:
@@ -452,6 +470,7 @@ class DeviceMemoryManager:
             self._pin_counts.pop(id(sb), None)
             self._alloc_sites.pop(id(sb), None)
         self._sync_gauges()
+        self._flight_mem("release", sb.nbytes)
         self._debug("release", sb)
 
     def _evict_host_to_disk(self, exclude: Optional[int] = None):
@@ -568,6 +587,8 @@ class DeviceMemoryManager:
                     or not _is_oom_error(e):
                 raise
             _MEM_OOM_RETRIES.inc()
+            self._flight_mem("oom_retry", batch.device_size_bytes(),
+                             depth=depth)
             b1, b2 = split_batch(batch)
             out = self.with_retry(b1, fn, depth + 1)
             out.extend(self.with_retry(b2, fn, depth + 1))

@@ -17,18 +17,30 @@ makes it visible live:
   ``dump_prometheus`` and an optional HTTP endpoint
   (``spark.rapids.metrics.port``); cluster workers flush snapshots
   through the filesystem rendezvous for driver-side aggregation.
+- ``recorder`` / ``anomaly`` — the always-on flight recorder: a
+  bounded per-process ring of recent spans, memory-ledger transitions,
+  scheduler events and shuffle waits that turns into a self-contained
+  incident bundle exactly when something goes wrong (task failure,
+  worker death, OOM/spill cascade, statistical straggler) — forensics
+  for queries that ran with tracing and metrics fully OFF.
 
-Everything is off by default and near-zero overhead when disabled:
-the null tracer's ``span()`` is a shared no-op context manager and
-registry updates are plain attribute arithmetic.
+Tracing and metrics export are off by default and near-zero overhead
+when disabled (the null tracer's ``span()`` is a shared no-op context
+manager; registry updates are plain attribute arithmetic); the flight
+recorder is ON by default — its records are bounded deque appends,
+audited by bench.py's ``obs_overhead_frac``.
 """
-from .tracer import (NULL_TRACER, Span, Tracer, TRACE_DIR, TRACE_MAX_SPANS,
-                     tracer_from_conf)
+from .tracer import (NULL_TRACER, Span, Tracer, TRACE_DIR, TRACE_MAX_FILES,
+                     TRACE_MAX_SPANS, tracer_from_conf)
 from .metrics import (METRICS_ENABLED, METRICS_PORT, MetricsRegistry,
                       REGISTRY, dump_prometheus, maybe_start_http_server,
                       render_merged_snapshots)
+from .recorder import RECORDER, FlightRecorder
+from .anomaly import AnomalyDetector, build_incident_bundle
 
 __all__ = ["NULL_TRACER", "Span", "Tracer", "TRACE_DIR", "TRACE_MAX_SPANS",
-           "tracer_from_conf", "METRICS_ENABLED", "METRICS_PORT",
-           "MetricsRegistry", "REGISTRY", "dump_prometheus",
-           "maybe_start_http_server", "render_merged_snapshots"]
+           "TRACE_MAX_FILES", "tracer_from_conf", "METRICS_ENABLED",
+           "METRICS_PORT", "MetricsRegistry", "REGISTRY",
+           "dump_prometheus", "maybe_start_http_server",
+           "render_merged_snapshots", "RECORDER", "FlightRecorder",
+           "AnomalyDetector", "build_incident_bundle"]

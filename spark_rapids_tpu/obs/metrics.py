@@ -23,6 +23,8 @@ Design points:
 """
 from __future__ import annotations
 
+import bisect
+import itertools
 import json
 import os
 import threading
@@ -97,21 +99,36 @@ class _Gauge:
 
 
 class _Histogram:
+    # counts are PER-BUCKET here (one increment per observe, found by
+    # bisection over the bound tuple — TRANSFER_BUCKETS has 14 bounds
+    # and scan feeders observe per batch, so a linear walk under the
+    # global update lock was the registry's most expensive operation);
+    # the cumulative Prometheus view is computed at snapshot time.
     __slots__ = ("buckets", "counts", "sum", "count")
 
     def __init__(self, buckets: Sequence[float]):
-        self.buckets = tuple(buckets)
+        buckets = tuple(buckets)
+        # enforce the +Inf terminal bound (Prometheus requires it, and
+        # observe()'s bisection indexes by it) rather than trusting
+        # every caller's bucket tuple
+        if not buckets or buckets[-1] != float("inf"):
+            buckets = buckets + (float("inf"),)
+        self.buckets = buckets
         self.counts = [0] * len(self.buckets)
         self.sum = 0.0
         self.count = 0
 
     def observe(self, v: float) -> None:
+        # v belongs to the first bucket with le >= v (Prometheus
+        # `v <= le` semantics) — exactly bisect_left; the +Inf bound
+        # last (enforced in __init__) guarantees an index exists. Pure
+        # read of an immutable tuple, so the search runs outside the
+        # lock.
+        i = bisect.bisect_left(self.buckets, v)
         with _update_lock:
             self.sum += v
             self.count += 1
-            for i, le in enumerate(self.buckets):
-                if v <= le:
-                    self.counts[i] += 1
+            self.counts[i] += 1
 
 
 _KINDS = {"counter": _Counter, "gauge": _Gauge, "histogram": _Histogram}
@@ -128,6 +145,11 @@ class _Family:
         self.help = help_
         self.labelnames = labelnames
         self.buckets = tuple(buckets)
+        # keep the family's bound list identical to its children's
+        # (render zips them): _Histogram appends the +Inf terminal
+        # bound when a caller omitted it
+        if not self.buckets or self.buckets[-1] != float("inf"):
+            self.buckets = self.buckets + (float("inf"),)
         self._children: Dict[Tuple[str, ...], object] = {}
         self._lock = threading.Lock()
         if not labelnames:  # unlabeled: the single child exists up front
@@ -180,7 +202,12 @@ class _Family:
             for key, child in self._children.items():
                 k = "\t".join(key)
                 if self.kind == "histogram":
-                    samples[k] = {"counts": list(child.counts),
+                    # cumulate the per-bucket counts here (not in
+                    # observe): the snapshot is the wire/render format,
+                    # so worker flushes and the renderer keep seeing
+                    # Prometheus-cumulative buckets
+                    samples[k] = {"counts": list(itertools.accumulate(
+                                      child.counts)),
                                   "sum": child.sum, "count": child.count}
                 else:
                     samples[k] = child.value
@@ -274,9 +301,9 @@ def _render_family(lines: List[str], name: str, snap: Dict,
     for key, val in sorted(snap["samples"].items()):
         values = key.split("\t") if key else []
         if snap["kind"] == "histogram":
-            # observe() already maintains cumulative bucket counts
-            # (every bucket with v <= le is incremented) — render them
-            # as-is; re-accumulating here would double-count
+            # snapshot() already cumulated the per-bucket counts —
+            # render them as-is; re-accumulating here would
+            # double-count
             for le, c in zip(snap["buckets"], val["counts"]):
                 ls = _label_str(names, values,
                                 dict(extra or {}, le=_fmt_value(le)))

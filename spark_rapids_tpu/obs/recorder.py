@@ -1,0 +1,329 @@
+"""Flight recorder: the always-on bounded black box.
+
+The tracer and metrics (obs/tracer.py, obs/metrics.py) only capture
+what ``spark.rapids.trace.dir`` / ``spark.rapids.metrics.enabled`` were
+already recording when the query started — a surprise OOM-retry
+cascade, worker crash, or straggler at scale leaves nothing behind.
+This module is the production-accelerator flight-recorder pattern: a
+per-process ring buffer (bounded entries AND bounded bytes, lock-cheap
+like ``MetricsRegistry`` updates) that passively records
+
+- span closures      (a tap in ``Tracer._record`` — only when tracing
+                      is on; everything below works with tracing OFF),
+- task lifecycle     (cluster workers record claim/ok/err directly, no
+                      tracer needed),
+- memory transitions (``memory.py`` ledger: reserve / release / spill /
+                      disk-spill / OOM-retry, with in-use bytes after
+                      each — the HBM timeline),
+- scheduler events   (attempt submit/ok/fail, blacklist, respawn,
+                      speculation, straggler detection),
+- shuffle waits      (fetch-blocked time per partition).
+
+When ``obs/anomaly.py`` decides something went wrong, the ring is the
+evidence: workers atomically commit ``<task>.flight.json`` dumps next
+to their rendezvous markers (and flush incarnation-tagged ring files so
+even an ``os._exit`` crash leaves its preceding events on disk), and
+the driver folds everything into ONE incident bundle under
+``spark.rapids.flight.dir``. ``tools/profiling.py triage`` renders it.
+
+The ring is process-wide (``RECORDER``) like the metrics registry:
+concurrent queries share one black box, which is exactly what a black
+box should record.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from ..config import (FLIGHT_DIR, FLIGHT_ENABLED, FLIGHT_MAX_BYTES,
+                      FLIGHT_MAX_EVENTS, RapidsConf)
+
+__all__ = ["FlightRecorder", "RECORDER", "flush_worker_ring",
+           "read_worker_rings", "read_flight_dumps", "memory_timeline",
+           "write_incident_bundle", "resolve_flight_dir", "prune_oldest"]
+
+_EVENT_OVERHEAD = 48  # dict + ts + kind, approximate
+
+
+def _approx_size(fields: Dict) -> int:
+    n = _EVENT_OVERHEAD
+    for k, v in fields.items():
+        n += len(k) + (len(v) if isinstance(v, str) else 8)
+    return n
+
+
+class FlightRecorder:
+    """Bounded (entries + bytes) append-only ring of recent events.
+
+    ``record`` is the hot call: one small dict build and a deque append
+    under a short lock — cheap enough to leave always-on. Eviction is
+    oldest-first and counted, never an error."""
+
+    def __init__(self, max_events: int = 2048, max_bytes: int = 1 << 20):
+        self.enabled = True
+        self.max_events = max_events
+        self.max_bytes = max_bytes
+        self.dropped = 0
+        self._bytes = 0
+        self._total = 0  # records ever; the ring-flush dirty watermark
+        self._ring: "deque[Tuple[Dict, int]]" = deque()
+        self._lock = threading.Lock()
+
+    def configure(self, conf: RapidsConf) -> None:
+        """Adopt a query's flight conf (process-wide, like the metrics
+        registry: the last configurer wins, which is fine — the knobs
+        are bounds, not semantics)."""
+        self.enabled = conf.get(FLIGHT_ENABLED)
+        self.max_events = max(1, conf.get(FLIGHT_MAX_EVENTS))
+        self.max_bytes = max(1024, conf.get(FLIGHT_MAX_BYTES))
+
+    def record(self, kind: str, **fields) -> None:
+        if not self.enabled:
+            return
+        sz = _approx_size(fields)
+        with self._lock:
+            # stamped under the lock: append order == timestamp order,
+            # which the bundle checker's monotonicity invariant needs
+            ev = {"ts": time.time(), "kind": kind}
+            ev.update(fields)
+            self._ring.append((ev, sz))
+            self._bytes += sz
+            self._total += 1
+            while self._ring and (len(self._ring) > self.max_events
+                                  or self._bytes > self.max_bytes):
+                _, s0 = self._ring.popleft()
+                self._bytes -= s0
+                self.dropped += 1
+
+    def record_span(self, span) -> None:
+        """Tracer._record tap: keep the ring's share of a span small —
+        name/cat/extent only, args dropped (they can be unbounded)."""
+        if not self.enabled:
+            return
+        self.record("span", name=span.name, cat=span.cat,
+                    dur=round(span.dur, 6), pid=span.pid)
+
+    def snapshot(self, since: Optional[float] = None) -> List[Dict]:
+        with self._lock:
+            evs = [e for e, _ in self._ring]
+        if since is not None:
+            evs = [e for e in evs if e["ts"] >= since]
+        return evs
+
+    def clear(self) -> None:
+        """Testing: empty the ring."""
+        with self._lock:
+            self._ring.clear()
+            self._bytes = 0
+            self.dropped = 0
+
+
+RECORDER = FlightRecorder()
+
+
+# --- memory timeline ---------------------------------------------------------
+
+def memory_timeline(events: List[Dict]) -> Dict:
+    """The HBM timeline a ring (or several merged rings) implies:
+    ledger transitions ordered by time, the high-water device
+    occupancy, and the budget they ran against.
+
+    Each cluster process owns its OWN device runtime, so per-process
+    occupancy — not a cross-process sum — is the OOM-relevant number;
+    the top-level high-water is the worst single process, and
+    ``per_proc`` breaks it out (merged-bundle events carry a ``proc``
+    tag; untagged events collapse into one series)."""
+    mem = sorted((e for e in events if e.get("kind") == "mem"),
+                 key=lambda e: e.get("ts", 0.0))
+    per_proc: Dict[str, Dict[str, int]] = {}
+    for e in mem:
+        p = per_proc.setdefault(str(e.get("proc", "")),
+                                {"high_water_bytes": 0,
+                                 "budget_bytes": 0})
+        p["high_water_bytes"] = max(p["high_water_bytes"],
+                                    int(e.get("device", 0) or 0))
+        if e.get("budget"):
+            p["budget_bytes"] = int(e["budget"])
+    high = max((p["high_water_bytes"] for p in per_proc.values()),
+               default=0)
+    budget = max((p["budget_bytes"] for p in per_proc.values()),
+                 default=0)
+    return {"events": mem, "high_water_bytes": high,
+            "budget_bytes": budget, "per_proc": per_proc}
+
+
+# --- worker-side persistence -------------------------------------------------
+# A crash (os._exit, SIGKILL) can't write anything at death — so the
+# black box must already be on disk. Workers flush their ring to an
+# incarnation-tagged file at task CLAIM (before the chaos hook / user
+# code runs) and after each task; a respawned incarnation gets a fresh
+# pid-tagged file, so the dead incarnation's last flush survives for
+# the driver's harvest.
+
+def _flight_root(root: str) -> str:
+    return os.path.join(root, "flight")
+
+
+_flush_marks: Dict[Tuple[str, int, int], int] = {}
+_FLUSH_TAIL_EVENTS = 512  # per-flush serialization bound (ring tail)
+
+
+def flush_worker_ring(root: str, worker_id: int,
+                      recorder: Optional[FlightRecorder] = None) -> str:
+    rec = recorder or RECORDER
+    d = _flight_root(root)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"w{worker_id}-{os.getpid()}.ring.json")
+    # dirty watermark: a flush whose ring hasn't grown since the last
+    # one (e.g. the post-task re-flush of a task that recorded nothing
+    # new) is a no-op — the file already holds these events
+    key = (root, worker_id, os.getpid())
+    mark = rec._total
+    if _flush_marks.get(key) == mark and os.path.exists(path):
+        return path
+    # the flush payload is the ring TAIL, not the whole ring: the
+    # claim-time flush runs before EVERY task (it is the crash-forensics
+    # guarantee and cannot be skipped or deferred), so its serialization
+    # cost must stay bounded on a long-lived worker whose ring sits at
+    # maxEvents — and forensics wants the most recent events anyway
+    doc = {"proc": f"w{worker_id}", "pid": os.getpid(),
+           "ts": time.time(), "dropped": rec.dropped,
+           "events": rec.snapshot()[-_FLUSH_TAIL_EVENTS:]}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    _flush_marks[key] = mark
+    # incarnation files accumulate one per respawn: keep a generous
+    # bound so a chaos-heavy long-lived root can't grow without limit
+    # (recent dead incarnations — the ones a harvest wants — survive)
+    prune_oldest(d, 32, suffix=".ring.json")
+    return path
+
+
+def read_worker_rings(root: str) -> List[Tuple[str, Dict]]:
+    """Every worker ring under the rendezvous root, tagged
+    ``w<K>:<pid>`` (one per incarnation — a crashed worker's last
+    flush survives its replacement). Torn/partial files are skipped,
+    never fatal — the same guarantee ``Tracer.absorb`` gives spans."""
+    d = _flight_root(root)
+    out: List[Tuple[str, Dict]] = []
+    try:
+        names = sorted(os.listdir(d))
+    except FileNotFoundError:
+        return out
+    for n in names:
+        if not n.endswith(".ring.json"):
+            continue
+        try:
+            with open(os.path.join(d, n)) as f:
+                doc = json.load(f)
+            tag = f"{doc.get('proc', n)}:{doc.get('pid', '?')}"
+            if not isinstance(doc.get("events"), list):
+                continue
+            out.append((tag, doc))
+        except (OSError, json.JSONDecodeError):
+            continue  # torn write mid-flush
+    return out
+
+
+def read_flight_dumps(tasks_dir: str,
+                      query_id: str = "") -> List[Dict]:
+    """Worker-committed ``<task>.flight.json`` dumps, optionally
+    restricted to one query's tasks; torn files skipped."""
+    out: List[Dict] = []
+    try:
+        names = sorted(os.listdir(tasks_dir))
+    except FileNotFoundError:
+        return out
+    for n in names:
+        if not n.endswith(".flight.json"):
+            continue
+        # prefix + non-digit boundary: "q1" must not claim q10's dumps
+        if query_id and not (n.startswith(query_id)
+                             and len(n) > len(query_id)
+                             and not n[len(query_id)].isdigit()):
+            continue
+        try:
+            with open(os.path.join(tasks_dir, n)) as f:
+                doc = json.load(f)
+            if not isinstance(doc, dict) or "task" not in doc:
+                continue
+            out.append(doc)
+        except (OSError, json.JSONDecodeError):
+            continue
+    return out
+
+
+# --- incident bundles --------------------------------------------------------
+
+_seq_lock = threading.Lock()
+_seq = 0
+
+
+def next_incident_seq() -> int:
+    global _seq
+    with _seq_lock:
+        _seq += 1
+        return _seq
+
+
+def resolve_flight_dir(conf: RapidsConf,
+                       cluster_root: Optional[str] = None) -> str:
+    d = conf.get(FLIGHT_DIR)
+    if d:
+        return d
+    if cluster_root:
+        return _flight_root(cluster_root)
+    return ""
+
+
+def write_incident_bundle(base_dir: str, bundle: Dict,
+                          max_files: int = 200) -> str:
+    """Atomically commit one incident bundle; retention-prunes old
+    incidents so an always-on recorder can't grow the dir unboundedly."""
+    os.makedirs(base_dir, exist_ok=True)
+    path = os.path.join(base_dir, bundle["incident_id"] + ".json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(bundle, f)
+    os.replace(tmp, path)
+    prune_oldest(base_dir, max_files, prefix="incident-", suffix=".json")
+    return path
+
+
+# --- retention ---------------------------------------------------------------
+
+def prune_oldest(base_dir: str, keep: int, prefix: str = "",
+                 suffix: str = "") -> int:
+    """Oldest-first unlink of matching files beyond ``keep`` — the
+    write-time retention bound for trace/event-log/incident dirs. Each
+    unlink is atomic; concurrent pruners racing on the same victim are
+    harmless (ENOENT ignored). Returns the number pruned."""
+    try:
+        names = [n for n in os.listdir(base_dir)
+                 if n.startswith(prefix) and n.endswith(suffix)]
+    except OSError:
+        return 0
+    if len(names) <= keep:
+        return 0
+    entries = []
+    for n in names:
+        p = os.path.join(base_dir, n)
+        try:
+            entries.append((os.stat(p).st_mtime, n, p))
+        except OSError:
+            continue  # already gone
+    entries.sort()
+    pruned = 0
+    for _, _, p in entries[:max(0, len(entries) - keep)]:
+        try:
+            os.unlink(p)
+            pruned += 1
+        except OSError:
+            pass
+    return pruned

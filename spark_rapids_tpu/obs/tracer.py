@@ -36,9 +36,11 @@ import uuid
 from typing import Dict, List, Optional
 
 from ..config import register
+from .recorder import RECORDER as _FLIGHT, prune_oldest
 
-__all__ = ["TRACE_DIR", "TRACE_MAX_SPANS", "Span", "Tracer", "NULL_TRACER",
-           "tracer_from_conf", "spans_to_chrome", "load_chrome_trace"]
+__all__ = ["TRACE_DIR", "TRACE_MAX_SPANS", "TRACE_MAX_FILES", "Span",
+           "Tracer", "NULL_TRACER", "tracer_from_conf", "spans_to_chrome",
+           "load_chrome_trace"]
 
 TRACE_DIR = register(
     "spark.rapids.trace.dir", "",
@@ -52,6 +54,12 @@ TRACE_MAX_SPANS = register(
     "Per-tracer span buffer bound; spans past it are dropped and "
     "counted (trace JSON metadata reports dropped_spans) so a "
     "pathological query cannot exhaust driver memory.")
+TRACE_MAX_FILES = register(
+    "spark.rapids.trace.maxFiles", 200,
+    "On-disk retention for spark.rapids.trace.dir and "
+    "spark.rapids.eventLog.dir: at write time the oldest files beyond "
+    "this count are pruned (atomic unlinks), so a long-lived session "
+    "cannot accumulate trace/event JSONs without bound.")
 
 
 class Span:
@@ -142,7 +150,8 @@ class Tracer:
     enabled = True
 
     def __init__(self, trace_id: Optional[str] = None, pid: int = 0,
-                 max_spans: int = 100_000, id_prefix: str = ""):
+                 max_spans: int = 100_000, id_prefix: str = "",
+                 max_files: int = 200):
         self.trace_id = trace_id or uuid.uuid4().hex[:16]
         self.pid = pid
         # span-id namespace: workers prefix their ids with the attempt
@@ -150,6 +159,7 @@ class Tracer:
         # mint colliding ids into the same stitched trace
         self.id_prefix = id_prefix
         self.max_spans = max_spans
+        self.max_files = max_files
         self.spans: List[Span] = []
         self.dropped = 0
         self._seq = 0
@@ -175,6 +185,10 @@ class Tracer:
                 self.dropped += 1
             else:
                 self.spans.append(span)
+        # flight-recorder tap: span closures join the always-on ring
+        # (the recorder also gets events from tracer-free paths, so it
+        # works with tracing disabled; this tap only ADDS detail)
+        _FLIGHT.record_span(span)
 
     def span(self, name: str, cat: str = "default",
              parent_id: Optional[str] = None,
@@ -242,6 +256,10 @@ class Tracer:
         with open(tmp, "w") as f:
             json.dump(doc, f)
         os.replace(tmp, path)
+        # write-time retention: oldest traces beyond maxFiles pruned so
+        # a long-lived session cannot grow the dir without bound
+        prune_oldest(base_dir, self.max_files, prefix="trace-",
+                     suffix=".json")
         return path
 
 
@@ -336,4 +354,5 @@ def tracer_from_conf(conf, pid: int = 0, trace_id: Optional[str] = None):
     if not conf.get(TRACE_DIR):
         return NULL_TRACER
     return Tracer(trace_id=trace_id, pid=pid,
-                  max_spans=conf.get(TRACE_MAX_SPANS))
+                  max_spans=conf.get(TRACE_MAX_SPANS),
+                  max_files=conf.get(TRACE_MAX_FILES))

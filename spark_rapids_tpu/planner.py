@@ -329,6 +329,23 @@ class TpuOverrides:
         self._tag(meta)
         root = self._convert(meta)
         pp = PhysicalPlan(root, meta.on_device, meta, self.conf)
+        # flight-recorder tap: an incident bundle wants to know what
+        # fell back to CPU and why without re-planning — one bounded
+        # event per planned query in the always-on ring
+        from .obs.recorder import RECORDER
+        if RECORDER.enabled:
+            reasons = []
+
+            def _fb(m: NodeMeta):
+                if not m.on_device and m.reasons:
+                    reasons.append(f"{m.node.pretty_name()}: "
+                                   + "; ".join(m.reasons)[:120])
+                for c in m.children:
+                    _fb(c)
+
+            _fb(meta)
+            RECORDER.record("plan", n_fallbacks=len(reasons),
+                            fallbacks=" | ".join(reasons[:8])[:600])
         mode = self.conf.get(EXPLAIN)
         if mode in ("ALL", "NOT_ON_GPU"):
             text = pp.explain(mode)

@@ -34,11 +34,13 @@ import pickle
 import time
 from typing import Dict, List, Optional, Sequence
 
-from ..config import (HEARTBEAT_TIMEOUT, MAX_TASK_FAILURES_PER_WORKER,
-                      MAX_WORKER_RESPAWNS, RapidsConf, SPECULATION,
-                      SPECULATION_MIN_RUNTIME, SPECULATION_MULTIPLIER,
-                      STAGE_TIMEOUT, TASK_MAX_ATTEMPTS, TASK_TIMEOUT)
+from ..config import (FLIGHT_STRAGGLER_FACTOR, HEARTBEAT_TIMEOUT,
+                      MAX_TASK_FAILURES_PER_WORKER, MAX_WORKER_RESPAWNS,
+                      RapidsConf, SPECULATION, SPECULATION_MIN_RUNTIME,
+                      SPECULATION_MULTIPLIER, STAGE_TIMEOUT,
+                      TASK_MAX_ATTEMPTS, TASK_TIMEOUT)
 from ..obs.metrics import REGISTRY as _METRICS
+from ..obs.recorder import RECORDER as _FLIGHT
 from ..obs.tracer import NULL_TRACER
 
 __all__ = ["TaskSpec", "TaskScheduler"]
@@ -52,7 +54,8 @@ _SCHED_EVENTS = _METRICS.counter(
     "rapids_scheduler_events_total",
     "Task scheduler lifecycle events by type: task_submitted / task_ok "
     "/ task_failed / attempt_lost / speculative_attempt / "
-    "worker_respawn / worker_blacklisted.", ("event",))
+    "worker_respawn / worker_blacklisted / straggler_detected.",
+    ("event",))
 
 
 @dataclasses.dataclass
@@ -118,6 +121,11 @@ class TaskScheduler:
         self._speculation = conf.get(SPECULATION)
         self._spec_mult = conf.get(SPECULATION_MULTIPLIER)
         self._spec_min_s = conf.get(SPECULATION_MIN_RUNTIME)
+        # flight-recorder straggler trigger — always on, independent of
+        # speculation (which LAUNCHES duplicates; this only RECORDS)
+        self._straggler_factor = conf.get(FLIGHT_STRAGGLER_FACTOR)
+        self._stragglers_seen: set = set()
+        self._current_stage = ""
 
     # --- event log --------------------------------------------------------
 
@@ -126,8 +134,14 @@ class TaskScheduler:
         self.events.append({
             "ts": time.time(), "event": event, "task": task,
             "attempt": attempt, "worker": worker,
+            "stage": self._current_stage,
             "wall_s": round(wall_s, 6), "reason": reason[-500:]})
         _SCHED_EVENTS.labels(event).inc()
+        # flight-recorder tap: scheduler transitions join the driver's
+        # always-on ring (works with tracing disabled)
+        _FLIGHT.record("sched", event=event, task=task, attempt=attempt,
+                       worker=worker, stage=self._current_stage,
+                       wall_s=round(wall_s, 6), reason=reason[-200:])
 
     # --- tracing ----------------------------------------------------------
 
@@ -281,10 +295,12 @@ class TaskScheduler:
         with self.tracer.span(f"stage {stage_label}", cat="stage",
                               args={"tasks": len(specs)}) as sp:
             self._stage_span_id = getattr(sp, "span_id", None)
+            self._current_stage = stage_label
             try:
                 self._run_stage(specs, stage_label)
             finally:
                 self._stage_span_id = None
+                self._current_stage = ""
 
     def _run_stage(self, specs: Sequence[TaskSpec],
                    stage_label: str) -> None:
@@ -460,6 +476,28 @@ class TaskScheduler:
                     handle_worker_loss(
                         w, f"worker {w} heartbeat stale ({age:.1f}s > "
                         f"{self._hb_timeout}s)")
+
+            # flight-recorder straggler trigger: RECORD (don't act on)
+            # any attempt running stragglerFactor x the stage's running
+            # median — always on, so a straggler leaves forensics even
+            # with speculation disabled. minRuntime floors it so short
+            # healthy stages can't fire incidents.
+            if durations:
+                med = sorted(durations)[len(durations) // 2]
+                cut = max(self._straggler_factor * med, self._spec_min_s)
+                for att in running:
+                    key = (att.spec.task_id, att.number)
+                    if att.spec.task_id in done \
+                            or key in self._stragglers_seen \
+                            or att.runtime <= cut:
+                        continue
+                    self._stragglers_seen.add(key)
+                    self._event(
+                        "straggler_detected", att.spec.task_id,
+                        att.number, att.worker, att.runtime,
+                        f"runtime {att.runtime:.2f}s > "
+                        f"{self._straggler_factor}x stage median "
+                        f"{med:.2f}s")
 
             # speculation: duplicate the stragglers
             if self._speculation and durations:

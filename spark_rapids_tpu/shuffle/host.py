@@ -36,6 +36,7 @@ import pyarrow as pa
 from ..config import (RapidsConf, SHUFFLE_COMPRESSION, SHUFFLE_THREADS)
 from ..columnar.batch import TpuBatch
 from ..obs.metrics import REGISTRY as _METRICS
+from ..obs.recorder import RECORDER as _FLIGHT
 from .transport import ShuffleTransport, ShuffleWriteHandle
 
 __all__ = ["HostShuffleTransport", "SHUF_PARTS_WRITTEN",
@@ -294,8 +295,13 @@ class HostShuffleTransport(ShuffleTransport):
         schema = self._schemas.get(shuffle_id)
         paths = self.committed_partition_files(self._sdir(shuffle_id),
                                                partition_id)
-        SHUF_FETCH_WAIT.labels("host").observe(_time.perf_counter() - t0)
+        drain_s = _time.perf_counter() - t0
+        SHUF_FETCH_WAIT.labels("host").observe(drain_s)
         SHUF_PARTS_FETCHED.labels("host").inc()
+        # flight-recorder tap: the read side's writer-drain wait is the
+        # shuffle stall an incident bundle wants on its timeline
+        _FLIGHT.record("shuffle", ev="drain_wait", sid=int(shuffle_id),
+                       part=int(partition_id), wait_s=round(drain_s, 6))
 
         from ..memory import DeviceMemoryManager
         mgr = DeviceMemoryManager.shared(self._conf)

@@ -45,6 +45,16 @@ def _app_path(base: str) -> str:
     return _APP_FILE[key]
 
 
+def _prune_event_logs(conf, base: str) -> None:
+    """Write-time retention (spark.rapids.trace.maxFiles, shared with
+    the trace dir): oldest app-*.jsonl beyond the bound are unlinked —
+    safe for the file just appended, which is the newest by mtime."""
+    from ..obs.recorder import prune_oldest
+    from ..obs.tracer import TRACE_MAX_FILES
+    prune_oldest(base, conf.get(TRACE_MAX_FILES), prefix="app-",
+                 suffix=".jsonl")
+
+
 def plan_fingerprint(root) -> str:
     """Stable id for 'the same query shape' across runs: a hash of the
     operator tree with per-instance labels stripped."""
@@ -93,6 +103,7 @@ def log_query_event(pp, ctx, wall_s: float) -> None:
         event["trace"] = tr.summary()
     with open(_app_path(base), "a") as f:
         f.write(json.dumps(event) + "\n")
+    _prune_event_logs(pp.conf, base)
 
 
 def log_scheduler_events(conf, query_id: str, sched, wall_s: float) -> None:
@@ -116,6 +127,7 @@ def log_scheduler_events(conf, query_id: str, sched, wall_s: float) -> None:
         event["trace"] = tr.summary()
     with open(_app_path(base), "a") as f:
         f.write(json.dumps(event) + "\n")
+    _prune_event_logs(conf, base)
 
 
 def read_event_logs(path: str) -> Iterator[dict]:

@@ -12,7 +12,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 __all__ = ["profile_report", "profile_event_logs", "critical_path",
-           "profile_trace"]
+           "profile_trace", "triage_report"]
 
 
 def profile_report(pp, ctx=None) -> str:
@@ -318,13 +318,171 @@ def profile_trace(path: str) -> str:
     return "\n".join(lines)
 
 
+# --- incident-bundle triage --------------------------------------------------
+# The flight recorder (obs/recorder.py) dumps incident bundles when an
+# anomaly fires; triage renders one for a human: what fired, the 30s of
+# ring events preceding it per process, the HBM high-water curve, and
+# per-stage straggler/attempt attribution.
+
+_TRIAGE_WINDOW_S = 30.0
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TB"
+
+
+def _fmt_ring_event(e: dict) -> str:
+    kind = e.get("kind", "?")
+    if kind == "sched":
+        return (f"sched {e.get('event', '?')} {e.get('task', '')} "
+                f"a{e.get('attempt', '?')} w{e.get('worker', '?')} "
+                f"{e.get('reason', '')}").rstrip()
+    if kind == "mem":
+        return (f"mem {e.get('ev', '?')} {_fmt_bytes(e.get('bytes', 0))} "
+                f"(device {_fmt_bytes(e.get('device', 0))}, "
+                f"host {_fmt_bytes(e.get('host', 0))})")
+    if kind == "task":
+        extra = e.get("error", "")
+        return (f"task {e.get('ev', '?')} {e.get('task', '')} "
+                f"a{e.get('attempt', '?')} {extra}").rstrip()
+    if kind == "shuffle":
+        return (f"shuffle {e.get('ev', '?')} s{e.get('sid', '?')} "
+                f"p{e.get('part', '?')} wait "
+                f"{e.get('wait_s', 0) * 1e3:.1f}ms")
+    if kind == "span":
+        return (f"span {e.get('name', '?')} [{e.get('cat', '?')}] "
+                f"{e.get('dur', 0) * 1e3:.1f}ms")
+    if kind == "plan":
+        return (f"plan {e.get('n_fallbacks', 0)} CPU fallbacks "
+                f"{e.get('fallbacks', '')}").rstrip()
+    return f"{kind} {e}"
+
+
+def _memory_curve(timeline: dict, width: int = 24) -> List[str]:
+    """Text rendering of the HBM timeline: in-use device bytes after
+    each transition, bar-scaled to the high-water mark. Every cluster
+    process owns its own device runtime, so rows are labeled by
+    process — occupancy values from different processes are separate
+    series, not one curve."""
+    evs = timeline.get("events") or []
+    high = max(int(timeline.get("high_water_bytes", 0) or 0), 1)
+    budget = int(timeline.get("budget_bytes", 0) or 0)
+    lines = [f"  high water {_fmt_bytes(timeline.get('high_water_bytes', 0))}"
+             + (f" of {_fmt_bytes(budget)} budget" if budget else "")
+             + " (worst single process)"]
+    for proc, p in sorted((timeline.get("per_proc") or {}).items()):
+        if proc:
+            lines.append(f"    {proc}: high water "
+                         f"{_fmt_bytes(p.get('high_water_bytes', 0))}")
+    if not evs:
+        return lines + ["  (no memory-ledger transitions recorded)"]
+    t_origin = evs[0].get("ts", 0.0)
+    shown = evs if len(evs) <= 40 else evs[-40:]
+    if len(evs) > 40:
+        lines.append(f"  (last 40 of {len(evs)} transitions)")
+    for e in shown:
+        dev = int(e.get("device", 0) or 0)
+        bar = "#" * max(0, round(width * dev / high))
+        proc = e.get("proc", "")
+        lines.append(
+            f"  t+{e.get('ts', 0.0) - t_origin:7.3f}s "
+            f"{(proc[:12] if proc else '-'):<12} "
+            f"{_fmt_bytes(dev):>10} {e.get('ev', '?'):<10} {bar}")
+    return lines
+
+
+def triage_report(bundle) -> str:
+    """Render one incident bundle (path or loaded dict) into a human
+    report — the `triage` mode of this tool."""
+    import json
+    if isinstance(bundle, str):
+        with open(bundle) as f:
+            bundle = json.load(f)
+    lines = [f"=== flight-recorder triage "
+             f"({bundle.get('incident_id', '?')}) ===",
+             f"query {bundle.get('query', '?')}"]
+
+    anomalies = bundle.get("anomalies") or []
+    lines.append(f"what fired ({len(anomalies)} anomal"
+                 f"{'y' if len(anomalies) == 1 else 'ies'}):")
+    for a in anomalies:
+        where = a.get("proc", "?")
+        w = a.get("worker", -1)
+        if isinstance(w, int) and w >= 0:
+            where += f" (worker {w})"
+        lines.append(
+            f"  [{a.get('kind', '?')}] {a.get('task', '')} "
+            f"a{a.get('attempt', '?')} on {where}: "
+            f"{(a.get('detail') or '').strip()[:160]}")
+    if not anomalies:
+        lines.append("  (none recorded — bundle written by hand?)")
+
+    # the N seconds of ring events preceding the first trigger, per
+    # process — the black-box playback
+    t_fire = min((a.get("ts", 0.0) for a in anomalies),
+                 default=bundle.get("ts", 0.0)) or bundle.get("ts", 0.0)
+    lines.append(f"last {_TRIAGE_WINDOW_S:.0f}s before the first "
+                 "trigger, per process:")
+    for proc in sorted(bundle.get("rings") or {}):
+        evs = [e for e in bundle["rings"][proc]
+               if t_fire - _TRIAGE_WINDOW_S <= e.get("ts", 0.0)
+               <= t_fire + 1.0]
+        lines.append(f"  [{proc}] {len(evs)} events")
+        for e in evs[-15:]:
+            lines.append(f"    t{e.get('ts', 0.0) - t_fire:+8.3f}s "
+                         + _fmt_ring_event(e))
+
+    lines.append("HBM timeline:")
+    lines.extend(_memory_curve(bundle.get("memory_timeline") or {}))
+
+    lines.append("straggler / attempt attribution:")
+    for stage, st in sorted((bundle.get("attempts") or {}).items()):
+        lines.append(f"  stage {stage}: median ok "
+                     f"{st.get('median_ok_s', 0.0) * 1e3:.1f}ms, "
+                     f"straggler cut "
+                     f"{st.get('straggler_cut_s', 0.0) * 1e3:.1f}ms")
+        for a in st.get("attempts", []):
+            mark = " <-- " + a["state"].upper() \
+                if a in (st.get("flagged") or []) else ""
+            lines.append(
+                f"    {a.get('task', '?')} a{a.get('attempt', '?')} "
+                f"w{a.get('worker', '?')} {a.get('state', '?'):<9} "
+                f"{a.get('runtime_s', 0.0) * 1e3:9.1f}ms"
+                f"{mark} {a.get('reason', '')[:80]}".rstrip())
+
+    fbs = bundle.get("plan_fallbacks") or []
+    if any(f.get("n_fallbacks") for f in fbs):
+        lines.append("plan fallbacks:")
+        for f in fbs:
+            if f.get("n_fallbacks"):
+                lines.append(f"  {f.get('fallbacks', '')[:200]}")
+    delta = bundle.get("conf_delta") or {}
+    if delta:
+        lines.append("non-default conf:")
+        for k in sorted(delta):
+            lines.append(f"  {k} = {delta[k]}")
+    return "\n".join(lines)
+
+
 def _main(argv):
     import sys
     if not argv:
         print("usage: python -m spark_rapids_tpu.tools.profiling "
-              "<event-log dir | trace-*.json>", file=sys.stderr)
+              "<event-log dir | trace-*.json | triage <incident.json>>",
+              file=sys.stderr)
         return 2
-    if argv[0].endswith(".json"):
+    if argv[0] == "triage":
+        if len(argv) < 2:
+            print("usage: profiling triage <incident-*.json>",
+                  file=sys.stderr)
+            return 2
+        print(triage_report(argv[1]))
+    elif argv[0].endswith(".json"):
         print(profile_trace(argv[0]))
     else:
         print(profile_event_logs(argv[0]))

@@ -12,6 +12,12 @@ Two validators and one driver:
 - ``--smoke DIR``    run one tiny in-process query with tracing +
   metrics enabled, write the trace JSON and a Prometheus dump under
   DIR, then validate both — the one-command CI gate.
+- ``--flight FILE``  validate a flight-recorder incident bundle
+  (required keys, monotonic timestamps, non-empty memory timeline);
+- ``--flight-smoke DIR``  run a 2-worker process-cluster query with an
+  injected worker crash and tracing DISABLED, assert exactly one valid
+  incident bundle is produced, schema-check it, and render the triage
+  report — the always-on-forensics CI gate.
 
 Exit status 0 = all checks passed; failures are listed on stderr.
 """
@@ -146,6 +152,108 @@ def check_prometheus(text):
     return errors
 
 
+_FLIGHT_KEYS = ("version", "incident_id", "ts", "query", "anomalies",
+                "rings", "memory_timeline", "metrics", "plan_fallbacks",
+                "conf_delta", "attempts")
+
+
+def check_flight(path):
+    """Incident-bundle schema: required keys present, every ring's and
+    the memory timeline's timestamps monotonic non-decreasing, the
+    memory timeline non-empty with a coherent high-water mark, and at
+    least one anomaly naming a task or worker."""
+    errors = []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"bundle unreadable: {e}"]
+    if not isinstance(doc, dict):
+        return ["bundle is not a JSON object"]
+    for k in _FLIGHT_KEYS:
+        if k not in doc:
+            errors.append(f"missing key {k}")
+    if errors:
+        return errors
+    if not str(doc["incident_id"]).startswith("incident-"):
+        errors.append(f"incident_id malformed: {doc['incident_id']!r}")
+    if not isinstance(doc["anomalies"], list) or not doc["anomalies"]:
+        errors.append("no anomalies — a bundle only exists because "
+                      "something fired")
+    else:
+        for i, a in enumerate(doc["anomalies"]):
+            if not a.get("kind"):
+                errors.append(f"anomaly {i}: no kind")
+            if not (a.get("task") or a.get("worker", -1) >= 0):
+                errors.append(f"anomaly {i}: names neither task nor "
+                              "worker")
+    if not isinstance(doc["rings"], dict) or "driver" not in doc["rings"]:
+        errors.append("rings must include the driver's")
+    else:
+        for proc, evs in doc["rings"].items():
+            ts = [e.get("ts", 0.0) for e in evs]
+            if any(b < a for a, b in zip(ts, ts[1:])):
+                errors.append(f"ring {proc}: timestamps not monotonic")
+    mt = doc["memory_timeline"]
+    if not isinstance(mt, dict) or not mt.get("events"):
+        errors.append("memory timeline empty")
+    else:
+        ts = [e.get("ts", 0.0) for e in mt["events"]]
+        if any(b < a for a, b in zip(ts, ts[1:])):
+            errors.append("memory timeline timestamps not monotonic")
+        high = int(mt.get("high_water_bytes", 0) or 0)
+        seen = max((int(e.get("device", 0) or 0) for e in mt["events"]),
+                   default=0)
+        if high != seen:
+            errors.append(f"high_water_bytes {high} != max device "
+                          f"occupancy in events {seen}")
+    if not isinstance(doc["attempts"], dict):
+        errors.append("attempts attribution is not a dict")
+    return errors
+
+
+def run_flight_smoke(out_dir):
+    """Injected worker crash with tracing DISABLED: the always-on
+    flight recorder must leave exactly one incident bundle, and the
+    triage renderer must accept it. Returns the bundle path."""
+    import pyarrow as pa
+
+    from spark_rapids_tpu.cluster import TpuProcessCluster
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.exec.base import HostBatchSourceExec
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.expr import Alias, UnresolvedColumn as col
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.shuffle.partitioner import HashPartitioning
+    from spark_rapids_tpu.tools.profiling import triage_report
+    flight_dir = os.path.join(out_dir, "incidents")
+    rbs = [pa.record_batch({"k": [i % 5 for i in range(n)],
+                            "v": list(range(n))})
+           for n in (300, 250)]
+    src = HostBatchSourceExec(rbs)
+    plan = TpuHashAggregateExec(
+        [col("k")], [Alias(Sum(col("v")), "s")],
+        TpuShuffleExchangeExec(HashPartitioning([col("k")], 4), src))
+    conf = RapidsConf({
+        "spark.rapids.tpu.test.injectFaults": "crash:q1s1m0:0",
+        "spark.rapids.flight.dir": flight_dir,
+        # tracing deliberately NOT set: forensics must not depend on it
+    })
+    with TpuProcessCluster(n_workers=2, conf=conf) as c:
+        out = c.run_query(plan)
+        assert out.num_rows == 5, f"query wrong across crash: {out}"
+        bundle = c.last_incident_path
+    assert bundle, "no incident bundle written"
+    bundles = [n for n in os.listdir(flight_dir)
+               if n.startswith("incident-") and n.endswith(".json")]
+    assert bundles == [os.path.basename(bundle)], \
+        f"expected exactly one bundle, got {bundles}"
+    report = triage_report(bundle)
+    assert "what fired" in report and "HBM timeline" in report, report
+    return bundle
+
+
 def run_smoke(out_dir):
     """One tiny query with tracing + metrics on; returns (trace_path,
     prom_path)."""
@@ -233,9 +341,14 @@ def main(argv=None):
     ap.add_argument("--scan-smoke", metavar="DIR", dest="scan_smoke",
                     help="run a device-decode parquet scan, check the "
                          "assemble/upload metric split, emit + validate")
+    ap.add_argument("--flight", help="incident bundle JSON to validate")
+    ap.add_argument("--flight-smoke", metavar="DIR", dest="flight_smoke",
+                    help="run an injected-crash cluster query with "
+                         "tracing disabled, assert exactly one valid "
+                         "incident bundle")
     args = ap.parse_args(argv)
     errors = []
-    trace, prom = args.trace, args.prom
+    trace, prom, flight = args.trace, args.prom, args.flight
     if args.smoke:
         os.makedirs(args.smoke, exist_ok=True)
         trace, prom = run_smoke(args.smoke)
@@ -244,11 +357,17 @@ def main(argv=None):
         os.makedirs(args.scan_smoke, exist_ok=True)
         prom = run_scan_smoke(args.scan_smoke)
         print(f"scan smoke output: {prom}")
-    if not trace and not prom:
+    if args.flight_smoke:
+        os.makedirs(args.flight_smoke, exist_ok=True)
+        flight = run_flight_smoke(args.flight_smoke)
+        print(f"flight smoke output: {flight}")
+    if not trace and not prom and not flight:
         ap.error("nothing to do: pass --trace/--prom/--smoke/"
-                 "--scan-smoke")
+                 "--scan-smoke/--flight/--flight-smoke")
     if trace:
         errors += [f"[trace] {e}" for e in check_trace(trace)]
+    if flight:
+        errors += [f"[flight] {e}" for e in check_flight(flight)]
     if prom:
         try:
             with open(prom) as f:

@@ -13,29 +13,36 @@
 #      parquet scan through the overlapped upload tunnel, checked
 #      against the host-decode oracle, with the assemble/upload metric
 #      split validated in the Prometheus dump
+#   6. flight-recorder smoke: a 2-worker cluster query with an injected
+#      worker crash (spark.rapids.tpu.test.injectFaults) and tracing
+#      DISABLED must leave exactly one valid incident bundle, which is
+#      schema-checked and triage-rendered
 #
 # Pass --full to also run the tier-1 suite (see ROADMAP.md), bounded to
 # 870s like the driver's own gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/5 compileall =="
+echo "== 1/6 compileall =="
 python -m compileall -q spark_rapids_tpu tests
 
-echo "== 2/5 package import =="
+echo "== 2/6 package import =="
 JAX_PLATFORMS=cpu python -c "import spark_rapids_tpu; print('import ok:', spark_rapids_tpu.__name__)"
 
-echo "== 3/5 pytest collection =="
+echo "== 3/6 pytest collection =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q --collect-only -m 'not slow' \
     -p no:cacheprovider 2>&1 | tail -3
 
-echo "== 4/5 observability smoke =="
+echo "== 4/6 observability smoke =="
 OBS_TMP="$(mktemp -d)"
 trap 'rm -rf "$OBS_TMP"' EXIT
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --smoke "$OBS_TMP"
 
-echo "== 5/5 device-decode scan smoke =="
+echo "== 5/6 device-decode scan smoke =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --scan-smoke "$OBS_TMP/scan"
+
+echo "== 6/6 flight-recorder smoke =="
+JAX_PLATFORMS=cpu python tools/check_obs_output.py --flight-smoke "$OBS_TMP/flight"
 
 if [[ "${1:-}" == "--full" ]]; then
     echo "== tier-1 (full) =="
