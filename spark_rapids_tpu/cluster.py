@@ -47,7 +47,11 @@ import pyarrow as pa
 
 from . import datatypes as dt
 from .config import (FLIGHT_ENABLED, FLIGHT_STRAGGLER_FACTOR,
-                     HEARTBEAT_INTERVAL, INJECT_FAULTS, RapidsConf)
+                     HEARTBEAT_INTERVAL, HEARTBEAT_TIMEOUT,
+                     INJECT_FAULTS, RapidsConf,
+                     SHUFFLE_FETCH_MAX_RETRIES,
+                     SHUFFLE_FETCH_RETRY_WAIT_MS,
+                     SHUFFLE_MAX_STAGE_RETRIES)
 from .exec.base import ExecCtx, LeafExec, TpuExec
 from .obs.metrics import (METRICS_ENABLED, REGISTRY,
                           flush_worker_metrics, maybe_start_http_server,
@@ -59,11 +63,20 @@ from .obs.recorder import (RECORDER, flush_worker_ring,
 from .obs.tracer import (NULL_TRACER, TRACE_DIR, TRACE_MAX_FILES, Tracer,
                          tracer_from_conf)
 from .scheduler import TaskScheduler, TaskSpec
-from .shuffle.host import (SHUF_BYTES_FETCHED, SHUF_FETCH_WAIT,
-                           SHUF_PARTS_FETCHED)
+from .scheduler.task_scheduler import FetchFailedError
+from .shuffle import integrity
+from .shuffle.host import (HostShuffleTransport, SHUF_BYTES_FETCHED,
+                           SHUF_FETCH_WAIT, SHUF_PARTS_FETCHED)
+from .shuffle.transport import FetchFailure
 
 __all__ = ["TpuProcessCluster", "ProcessShuffleReadExec",
            "run_process_query"]
+
+_STAGE_RERUNS = REGISTRY.counter(
+    "rapids_shuffle_stage_reruns_total",
+    "Map tasks re-executed from lineage because a reader classified "
+    "their committed shuffle output as missing/corrupt/torn or "
+    "persistently unreadable.")
 
 
 class ProcessShuffleReadExec(LeafExec):
@@ -75,12 +88,18 @@ class ProcessShuffleReadExec(LeafExec):
     never interleave files here."""
 
     def __init__(self, shuffle_root: str, shuffle_id: int,
-                 partitions: Sequence[int], schema: dt.Schema):
+                 partitions: Sequence[int], schema: dt.Schema,
+                 expected_mapouts: Optional[Sequence[str]] = None):
         super().__init__()
         self.shuffle_root = shuffle_root
         self.shuffle_id = shuffle_id
         self.partitions = list(partitions)
         self._schema = schema
+        # the driver's lineage knowledge: one task key per map task
+        # that committed output into this shuffle — a whole committed
+        # dir that later vanished is detected as kind=missing instead
+        # of silently reading fewer rows
+        self.expected_mapouts = list(expected_mapouts or [])
 
     @property
     def output_schema(self):
@@ -93,16 +112,29 @@ class ProcessShuffleReadExec(LeafExec):
     def tpu_supported(self):
         return None
 
-    def _files(self, pid: int) -> List[str]:
-        from .shuffle.host import HostShuffleTransport
+    def _block_index(self):
+        """{pid: [(path, manifest_meta)]} the reader must consume —
+        ONE dir walk + manifest parse per task (manifests are immutable
+        after commit), and manifest-driven, so a file that should exist
+        but doesn't is a classified failure, not a shorter stream."""
         d = os.path.join(self.shuffle_root, f"s{self.shuffle_id}")
-        return HostShuffleTransport.committed_partition_files(d, pid)
+        return integrity.expected_partition_index(
+            d, self.expected_mapouts, shuffle_id=self.shuffle_id)
 
     def _host_batches(self, ctx: Optional[ExecCtx] = None):
         tracer = ctx.tracer if ctx is not None else NULL_TRACER
+        conf = ctx.conf if ctx is not None else RapidsConf()
+        retries = conf.get(SHUFFLE_FETCH_MAX_RETRIES)
+        wait_s = conf.get(SHUFFLE_FETCH_RETRY_WAIT_MS) / 1e3
         fetched = SHUF_PARTS_FETCHED.labels("process")
         fbytes = SHUF_BYTES_FETCHED.labels("process")
         fwait = SHUF_FETCH_WAIT.labels("process")
+        try:
+            index = self._block_index()
+        except FetchFailure as ff:
+            HostShuffleTransport._record_fetch_failure(
+                ff, -1, transport="process")
+            raise
         for pid in self.partitions:
             # stream one file at a time (large shuffles must not pin a
             # whole partition's tables in host memory); the fetch span
@@ -111,17 +143,32 @@ class ProcessShuffleReadExec(LeafExec):
             parent = tracer.current_span_id()
             t_wall = time.time()
             io_s = 0.0
-            for path in self._files(pid):
-                t1 = time.perf_counter()
-                with pa.OSFile(path, "rb") as f:
-                    table = pa.ipc.open_file(f).read_all()
-                dt_io = time.perf_counter() - t1
-                io_s += dt_io
-                fwait.observe(dt_io)
-                fbytes.inc(table.nbytes)
-                for rb in table.combine_chunks().to_batches():
-                    if rb.num_rows:
-                        yield rb
+            try:
+                for path, meta in index.get(pid, []):
+                    t1 = time.perf_counter()
+                    payload = integrity.read_block(
+                        path, meta, shuffle_id=self.shuffle_id,
+                        max_retries=retries, retry_wait_s=wait_s,
+                        on_retry=lambda n, e: RECORDER.record(
+                            "shuffle", ev="fetch_retry",
+                            sid=self.shuffle_id, part=int(pid), n=n,
+                            error=str(e)[:120]))
+                    table = pa.ipc.open_file(
+                        pa.BufferReader(payload)).read_all()
+                    dt_io = time.perf_counter() - t1
+                    io_s += dt_io
+                    fwait.observe(dt_io)
+                    fbytes.inc(table.nbytes)
+                    for rb in table.combine_chunks().to_batches():
+                        if rb.num_rows:
+                            yield rb
+            except FetchFailure as ff:
+                # kind-labeled metric + flight-recorder event, then
+                # escalate: the worker loop turns this into a
+                # .fetchfail marker the driver recovers from
+                HostShuffleTransport._record_fetch_failure(
+                    ff, pid, transport="process")
+                raise
             fetched.inc()
             # flight-recorder tap: fetch-blocked time lands in the
             # always-on ring even with tracing disabled
@@ -380,13 +427,30 @@ def worker_main(root: str, worker_id: int, poll_s: float = 0.02,
                 chaos.maybe_inject(
                     settings.get(INJECT_FAULTS.key, ""), worker_id,
                     payload.get("task_id", ""),
-                    payload.get("attempt", 0), hb)
+                    payload.get("attempt", 0), hb,
+                    # bound the simulated wedge by the liveness conf: a
+                    # driver that misses the kill fails the run in
+                    # seconds instead of parking the worker for minutes
+                    hang_bound_s=max(
+                        5.0, RapidsConf(settings).get(
+                            HEARTBEAT_TIMEOUT) * 3))
                 with tracer.span(
                         f"task {payload.get('task_id', '?')} "
                         f"a{payload.get('attempt', 0)}", cat="task",
                         parent_id=tctx["parent"] if tctx else None,
                         args={"kind": kind, "worker": worker_id}):
                     _TASK_KINDS[kind](payload, tracer)
+                if kind == "map":
+                    # shuffle-durability chaos (corrupt/drop/eio) fires
+                    # AFTER the atomic commit: the map task reports
+                    # success and only the read side can discover the
+                    # committed-then-lost output
+                    chaos.maybe_inject_output(
+                        settings.get(INJECT_FAULTS.key, ""), worker_id,
+                        task_id, attempt,
+                        os.path.join(payload["shuffle_root"],
+                                     f"s{payload['shuffle_id']}",
+                                     f"{task_id}.mapout"))
                 _flush_task_obs(root, worker_id, path, tracer, settings)
                 RECORDER.record("task", ev="ok", task=task_id,
                                 attempt=attempt, worker=worker_id)
@@ -395,7 +459,7 @@ def worker_main(root: str, worker_id: int, poll_s: float = 0.02,
                 with open(done + ".tmp", "w") as f:
                     f.write("ok")
                 os.replace(done + ".tmp", done)
-            except BaseException:
+            except BaseException as exc:
                 tb = traceback.format_exc()
                 _flush_task_obs(root, worker_id, path, tracer, settings)
                 RECORDER.record("task", ev="err", task=task_id,
@@ -404,6 +468,20 @@ def worker_main(root: str, worker_id: int, poll_s: float = 0.02,
                 _flush_task_flight(root, worker_id, path, task_id,
                                    attempt, claim_wall, failed=True,
                                    error=tb)
+                if isinstance(exc, FetchFailure):
+                    # structured marker BEFORE the .err it accompanies:
+                    # when the driver harvests the .err, the
+                    # classification is already on disk and the failure
+                    # escalates to lineage recovery instead of burning
+                    # a retry against the same bad bytes
+                    with open(path + ".fetchfail.tmp", "w") as f:
+                        json.dump({"shuffle_id": exc.shuffle_id,
+                                   "map_task": exc.map_task,
+                                   "path": exc.path, "kind": exc.kind,
+                                   "detail": (exc.detail or "")[:500]},
+                                  f)
+                    os.replace(path + ".fetchfail.tmp",
+                               path + ".fetchfail")
                 with open(err + ".tmp", "w") as f:
                     f.write(tb)
                 os.replace(err + ".tmp", err)
@@ -581,6 +659,7 @@ class TpuProcessCluster:
                                 self.conf.get(HEARTBEAT_INTERVAL))
         self._query_seq = 0
         self._sid_seq = 0
+        self._quarantine_seq = 0
         self.last_scheduler: Optional[TaskScheduler] = None
         self.last_trace_path: Optional[str] = None
         self.last_incident_path: Optional[str] = None
@@ -711,6 +790,71 @@ class TpuProcessCluster:
             resolve_flight_dir(conf, self.root), bundle,
             max_files=conf.get(TRACE_MAX_FILES))
 
+    def _run_stage_lineage(self, sched: TaskScheduler,
+                           specs: Sequence[TaskSpec], label: str,
+                           shuffle_root: str,
+                           map_specs: Dict[int, List[TaskSpec]],
+                           budget: List[int]) -> None:
+        """Run one stage with shuffle-lineage recovery: a classified
+        FetchFailure from any reading task quarantines the bad map
+        output, re-executes ONLY the producing map task (recursively
+        protected — regenerating it may surface an even older loss),
+        and resumes the interrupted stage minus its already-committed
+        tasks. ``budget`` is the query-wide rerun allowance
+        (``spark.rapids.shuffle.maxStageRetries``); the attempt-
+        suffixed atomic commit keeps a zombie attempt of the original
+        map task from interleaving with the rerun's output."""
+        pending = list(specs)
+        while True:
+            try:
+                sched.run_stage(pending, stage_label=label)
+                return
+            except FetchFailedError as ff:
+                lost = next((s for s in map_specs.get(ff.shuffle_id, [])
+                             if s.task_id == ff.map_task), None)
+                if lost is None:
+                    raise RuntimeError(
+                        f"{label}: shuffle {ff.shuffle_id} map output "
+                        f"{ff.map_task!r} is {ff.kind} and no lineage "
+                        f"is available to recompute it") from ff
+                if budget[0] <= 0:
+                    raise RuntimeError(
+                        f"{label}: map output {ff.map_task} lost "
+                        f"({ff.kind}) with the stage-rerun budget "
+                        f"(spark.rapids.shuffle.maxStageRetries) "
+                        f"exhausted") from ff
+                budget[0] -= 1
+                self._quarantine_mapout(shuffle_root, ff.shuffle_id,
+                                        ff.map_task)
+                _STAGE_RERUNS.inc()
+                sched._event(
+                    "stage_rerun", task=ff.map_task, worker=ff.worker,
+                    reason=f"{label} hit fetch failure [{ff.kind}] on "
+                           f"{ff.task} a{ff.attempt}; re-executing "
+                           f"{ff.map_task} from lineage")
+                self._run_stage_lineage(
+                    sched, [lost], f"map s{ff.shuffle_id} rerun",
+                    shuffle_root, map_specs, budget)
+                # resume: completed tasks keep their committed output
+                pending = [s for s in pending
+                           if s.task_id not in ff.completed]
+
+    def _quarantine_mapout(self, shuffle_root: str, sid: int,
+                           task_key: str) -> None:
+        """Fence the bad committed output out of every reader's view
+        (readers only consume ``*.mapout`` dirs) while keeping the
+        bytes on disk for forensics. One rename, atomic like the
+        commit it undoes; already-gone output (drop-style loss) is
+        fine — there is nothing to fence."""
+        d = os.path.join(shuffle_root, f"s{sid}", f"{task_key}.mapout")
+        self._quarantine_seq += 1
+        try:
+            os.rename(d, os.path.join(
+                os.path.dirname(d),
+                f"{task_key}.quarantine{self._quarantine_seq}"))
+        except OSError:
+            pass
+
     def prometheus_text(self) -> str:
         """One Prometheus exposition document over the driver's registry
         plus every worker snapshot flushed through the rendezvous
@@ -725,6 +869,12 @@ class TpuProcessCluster:
                           settings: Dict, qid: int,
                           sched: TaskScheduler) -> pa.Table:
         shuffle_root = os.path.join(self.root, "shuffle")
+        # lineage: every shuffle's map TaskSpecs stay addressable for
+        # the life of the query, so a later stage's FetchFailure can
+        # re-execute exactly the producing map task (the RDD-lineage
+        # recovery of Zaharia et al., scoped to one task)
+        map_specs: Dict[int, List[TaskSpec]] = {}
+        rerun_budget = [conf.get(SHUFFLE_MAX_STAGE_RETRIES)]
         # run map stages deepest-first until no exchange remains
         while True:
             exch = _deepest_exchange(plan)
@@ -743,10 +893,15 @@ class TpuProcessCluster:
                     "map_id_base": i * 100_000,
                     "conf": settings,
                 }))
-            sched.run_stage(specs, stage_label=f"map s{sid}")
+            map_specs[sid] = specs
+            self._run_stage_lineage(sched, specs, f"map s{sid}",
+                                    shuffle_root, map_specs,
+                                    rerun_budget)
             n = exch.partitioning.num_partitions
-            read = ProcessShuffleReadExec(shuffle_root, sid, list(range(n)),
-                                          exch.child.output_schema)
+            read = ProcessShuffleReadExec(
+                shuffle_root, sid, list(range(n)),
+                exch.child.output_schema,
+                expected_mapouts=[s.task_id for s in specs])
             plan = _replace_node(plan, exch, read)
         # final stage: split the partition ranges of every shuffle read
         outs = []
@@ -765,7 +920,8 @@ class TpuProcessCluster:
             specs.append(TaskSpec(f"q{qid}r{w}", "collect",
                                   {"plan": final, "out": out,
                                    "conf": settings}))
-        sched.run_stage(specs, stage_label="final")
+        self._run_stage_lineage(sched, specs, "final", shuffle_root,
+                                map_specs, rerun_budget)
         tables = []
         for out in outs:
             with pa.OSFile(out, "rb") as f:

@@ -167,6 +167,24 @@ ICI_MAX_PAYLOAD = register(
     "spark.rapids.shuffle.ici.maxPartitionBytes", 256 << 20,
     "Per-shard payload bucket ceiling for the ICI all-to-all exchange.",
     conv=_bytes_conv)
+SHUFFLE_FETCH_MAX_RETRIES = register(
+    "spark.rapids.shuffle.fetch.maxRetries", 3,
+    "Transient (EIO-class) shuffle block read failures are retried in "
+    "place this many times with exponential backoff before the reader "
+    "escalates a classified FetchFailure to the driver. Missing, "
+    "corrupt, and torn blocks are never retried in place — rereading "
+    "bad bytes cannot fix them.")
+SHUFFLE_FETCH_RETRY_WAIT_MS = register(
+    "spark.rapids.shuffle.fetch.retryWaitMs", 50,
+    "Base wait between in-place shuffle fetch retries, doubling per "
+    "retry.", conv=_to_float)
+SHUFFLE_MAX_STAGE_RETRIES = register(
+    "spark.rapids.shuffle.maxStageRetries", 4,
+    "Lineage-recovery budget per query: how many map-task "
+    "re-executions (regenerating shuffle output a reader found "
+    "missing/corrupt/torn or persistently unreadable) may run before "
+    "the query fails — the spark.stage.maxConsecutiveAttempts analog "
+    "for the process cluster.")
 
 # --- IO -------------------------------------------------------------------
 PARQUET_ENABLED = register(

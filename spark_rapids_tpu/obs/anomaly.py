@@ -38,9 +38,12 @@ __all__ = ["AnomalyDetector", "anomalies_from_scheduler",
            "straggler_attribution", "build_incident_bundle"]
 
 # scheduler event types that are anomalies in themselves (attempt_lost
-# is a benign speculation loser; task_ok/submitted are normal traffic)
+# is a benign speculation loser; task_ok/submitted are normal traffic).
+# fetch_failed / stage_rerun: a committed-then-lost or corrupt shuffle
+# block and its lineage recovery — the query may still succeed, but
+# durability loss is exactly what a flight recorder exists to explain.
 _SCHED_ANOMALIES = ("task_failed", "worker_respawn", "worker_blacklisted",
-                    "straggler_detected")
+                    "straggler_detected", "fetch_failed", "stage_rerun")
 
 
 class AnomalyDetector:

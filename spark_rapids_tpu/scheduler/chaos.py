@@ -1,29 +1,50 @@
 """Deterministic fault injection for cluster workers.
 
 Driven by ``spark.rapids.tpu.test.injectFaults`` (config.py): a
-semicolon-separated rule list evaluated by the WORKER immediately before
-it runs a claimed task, so a chosen (task, attempt) can be made to
-crash, hang, or run slow — on whichever worker picked it up, or only on
-a specific worker. Rules are pure functions of (worker, task, attempt):
-no randomness, no state — the same spec reproduces the same failure
-schedule every run, which is what makes the recovery paths unit-testable
-on one host (Spark gets the equivalent via its TaskSetManager test
-harness; production clusters get the faults for free).
+semicolon-separated rule list evaluated by the WORKER, so a chosen
+(task, attempt) can be made to crash, hang, run slow — or have its
+*committed shuffle output* corrupted, dropped, or made transiently
+unreadable — on whichever worker picked it up, or only on a specific
+worker. Rules are pure functions of (worker, task, attempt): no
+randomness, no state — the same spec reproduces the same failure
+schedule every run, which is what makes the recovery paths
+unit-testable on one host (Spark gets the equivalent via its
+TaskSetManager test harness; production clusters get the faults for
+free).
+
+Two hook points:
+
+- ``maybe_inject``        — BEFORE a claimed task runs (process-level
+  faults: ``crash`` / ``hang`` / ``delay``).
+- ``maybe_inject_output`` — AFTER a map task's atomic commit
+  (shuffle-durability faults: ``corrupt`` / ``drop`` / ``eio``), the
+  committed-then-lost class the lineage-recovery path exists for.
 
 Grammar (whitespace-insensitive)::
 
     spec    := rule (';' rule)*
-    rule    := mode ':' task_glob ':' attempt [':' seconds] ['@w' worker]
-    mode    := 'crash' | 'hang' | 'delay'
+    rule    := mode ':' task_glob ':' attempt [':' arg] ['@w' worker]
+    mode    := 'crash' | 'hang' | 'delay' | 'corrupt' | 'drop' | 'eio'
     attempt := int | '*'
 
-- ``crash``  — the worker process exits immediately (``os._exit``),
+- ``crash``   — the worker process exits immediately (``os._exit``),
   leaving no .err marker: the death-detection path.
-- ``hang``   — the worker suspends its heartbeat thread and sleeps,
-  simulating a native call wedged while holding the GIL (a stuck Pallas
-  compile): the heartbeat-staleness path.
-- ``delay``  — sleep ``seconds`` (default 2.0) before running the task
-  normally: the straggler/speculation path.
+- ``hang``    — the worker suspends its heartbeat thread and sleeps,
+  simulating a native call wedged while holding the GIL (a stuck
+  Pallas compile): the heartbeat-staleness path. The sleep is bounded
+  by the caller (heartbeat timeout x a small factor) so a missed
+  driver kill fails the test in seconds, not minutes.
+- ``delay``   — sleep ``arg`` seconds (default 2.0) before running the
+  task normally: the straggler/speculation path.
+- ``corrupt`` — after the map task commits, flip bytes mid-payload in
+  every committed partition file: the CRC-mismatch (kind=corrupt)
+  fetch-failure path.
+- ``drop``    — after the map task commits, delete the whole committed
+  ``.mapout`` dir: the committed-then-lost (kind=missing) path.
+- ``eio``     — after the map task commits, write ``<file>.eio``
+  countdown sidecars (``arg`` failing reads each, default 2): the
+  transient-IO path; readers burn in-place retries, and counts above
+  ``spark.rapids.shuffle.fetch.maxRetries`` escalate to a stage rerun.
 
 Examples::
 
@@ -32,18 +53,28 @@ Examples::
     hang:*m1:0                # first attempt of any map task 1 wedges
     delay:q1s1m0:0:3.5        # attempt 0 runs 3.5s late
     crash:q1s1m0:0@w1         # only when worker 1 runs it
+    corrupt:q1s1m0:0          # attempt 0's committed output is rotten
+    eio:q1s1m*:0:5            # every map output needs 5 reads to stick
 """
 from __future__ import annotations
 
 import dataclasses
 import fnmatch
 import os
+import shutil
 import time
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
-__all__ = ["ChaosRule", "parse_fault_spec", "find_rule", "maybe_inject"]
+__all__ = ["ChaosRule", "parse_fault_spec", "find_rule", "maybe_inject",
+           "maybe_inject_output"]
 
-_MODES = ("crash", "hang", "delay")
+_PRE_MODES = ("crash", "hang", "delay")
+_POST_MODES = ("corrupt", "drop", "eio")
+_MODES = _PRE_MODES + _POST_MODES
+
+#: fallback hang bound when the caller has no conf in reach — still
+#: finite so an orphaned chaos worker can't outlive its test run
+_DEFAULT_HANG_BOUND_S = 120.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,7 +82,7 @@ class ChaosRule:
     mode: str
     task_glob: str
     attempt: Optional[int]  # None = any attempt
-    seconds: float = 2.0
+    seconds: float = 2.0  # delay seconds / eio failing-read count
     worker: Optional[int] = None  # None = any worker
 
     def matches(self, worker_id: int, task_id: str, attempt: int) -> bool:
@@ -75,7 +106,7 @@ def parse_fault_spec(spec: str) -> List[ChaosRule]:
         parts = [p.strip() for p in raw.split(":")]
         if len(parts) < 3 or parts[0] not in _MODES:
             raise ValueError(f"bad injectFaults rule {raw!r} (want "
-                             "mode:task_glob:attempt[:seconds])")
+                             "mode:task_glob:attempt[:arg])")
         mode, glob, att = parts[:3]
         attempt = None if att == "*" else int(att)
         seconds = float(parts[3]) if len(parts) > 3 else 2.0
@@ -83,20 +114,26 @@ def parse_fault_spec(spec: str) -> List[ChaosRule]:
     return rules
 
 
-def find_rule(spec: str, worker_id: int, task_id: str,
-              attempt: int) -> Optional[ChaosRule]:
+def find_rule(spec: str, worker_id: int, task_id: str, attempt: int,
+              modes: Optional[Sequence[str]] = None) -> Optional[ChaosRule]:
     for r in parse_fault_spec(spec):
+        if modes is not None and r.mode not in modes:
+            continue
         if r.matches(worker_id, task_id, attempt):
             return r
     return None
 
 
 def maybe_inject(spec: str, worker_id: int, task_id: str, attempt: int,
-                 heartbeat=None) -> None:
-    """Worker-side hook: apply the first matching rule, if any. ``crash``
-    never returns; ``hang`` effectively never returns (the driver kills
-    the process); ``delay`` returns after sleeping."""
-    rule = find_rule(spec, worker_id, task_id, attempt)
+                 heartbeat=None,
+                 hang_bound_s: Optional[float] = None) -> None:
+    """Worker-side pre-run hook: apply the first matching process-level
+    rule, if any. ``crash`` never returns; ``hang`` does not return
+    while the driver behaves (it kills the process), but self-destructs
+    after ``hang_bound_s`` — derived by the caller from the heartbeat
+    timeout — so a missed kill fails the test quickly instead of
+    parking for ten minutes; ``delay`` returns after sleeping."""
+    rule = find_rule(spec, worker_id, task_id, attempt, _PRE_MODES)
     if rule is None:
         return
     if rule.mode == "crash":
@@ -106,7 +143,47 @@ def maybe_inject(spec: str, worker_id: int, task_id: str, attempt: int,
         # heartbeat thread too — simulate both halves
         if heartbeat is not None:
             heartbeat.suspend()
-        time.sleep(600.0)
+        time.sleep(hang_bound_s if hang_bound_s is not None
+                   else _DEFAULT_HANG_BOUND_S)
         os._exit(14)  # the driver should have killed us long ago
     if rule.mode == "delay":
         time.sleep(rule.seconds)
+
+
+def maybe_inject_output(spec: str, worker_id: int, task_id: str,
+                        attempt: int, mapout_dir: str) -> None:
+    """Worker-side post-commit hook: damage the (task, attempt)'s
+    COMMITTED shuffle output — the injection point for every
+    shuffle-durability failure the lineage-recovery path must survive.
+    Runs between the atomic commit and the ``.ok`` marker, so from the
+    driver's view the map task succeeded and only the read side can
+    discover the loss."""
+    rule = find_rule(spec, worker_id, task_id, attempt, _POST_MODES)
+    if rule is None or not os.path.isdir(mapout_dir):
+        return
+    if rule.mode == "drop":
+        shutil.rmtree(mapout_dir, ignore_errors=True)
+        return
+    names = sorted(n for n in os.listdir(mapout_dir)
+                   if n.endswith(".arrow"))
+    for n in names:
+        path = os.path.join(mapout_dir, n)
+        if rule.mode == "corrupt":
+            # flip bytes mid-payload: the footer (and the Arrow
+            # framing around the flip) stays intact, so ONLY the CRC
+            # can catch it — exactly the bit-rot class checksums exist
+            # for
+            size = os.path.getsize(path)
+            # stay inside the payload: clobbering the 16-byte trailer
+            # would read as "torn", a different failure class
+            at = min(size // 2, size - 16 - 8)
+            if at <= 0:
+                continue
+            with open(path, "r+b") as f:
+                f.seek(at)
+                chunk = f.read(8)
+                f.seek(at)
+                f.write(bytes(b ^ 0xFF for b in chunk))
+        elif rule.mode == "eio":
+            with open(path + ".eio", "w") as f:
+                f.write(str(int(rule.seconds)))

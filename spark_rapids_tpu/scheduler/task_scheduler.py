@@ -43,7 +43,7 @@ from ..obs.metrics import REGISTRY as _METRICS
 from ..obs.recorder import RECORDER as _FLIGHT
 from ..obs.tracer import NULL_TRACER
 
-__all__ = ["TaskSpec", "TaskScheduler"]
+__all__ = ["TaskSpec", "TaskScheduler", "FetchFailedError"]
 
 _POLL_S = 0.02
 _FIRST_BEAT_GRACE_S = 60.0  # interpreter + jax import before beat 1
@@ -54,8 +54,38 @@ _SCHED_EVENTS = _METRICS.counter(
     "rapids_scheduler_events_total",
     "Task scheduler lifecycle events by type: task_submitted / task_ok "
     "/ task_failed / attempt_lost / speculative_attempt / "
-    "worker_respawn / worker_blacklisted / straggler_detected.",
+    "worker_respawn / worker_blacklisted / straggler_detected / "
+    "fetch_failed / stage_rerun.",
     ("event",))
+
+
+class FetchFailedError(RuntimeError):
+    """Driver-side escalation of a reader-side shuffle FetchFailure:
+    the named committed map output is lost/corrupt, so retrying the
+    READING task against the same bytes is pointless — the caller
+    (cluster.py) quarantines the output, re-executes the producing map
+    task from lineage, and resumes the stage. Deliberately NOT a task
+    failure: it never counts against the reduce task's attempt budget
+    or the reading worker's blacklist score (Spark's FetchFailed
+    semantics)."""
+
+    def __init__(self, shuffle_id: int, map_task: str, kind: str,
+                 path: str, task: str, attempt: int, worker: int,
+                 completed):
+        self.shuffle_id = int(shuffle_id)
+        self.map_task = map_task
+        self.kind = kind
+        self.path = path
+        self.task = task
+        self.attempt = attempt
+        self.worker = worker
+        #: tasks of the interrupted stage that already committed —
+        #: their output survives; only the rest re-run after recovery
+        self.completed = set(completed)
+        super().__init__(
+            f"task {task} a{attempt} (worker {worker}): shuffle "
+            f"{shuffle_id} map output {map_task} unreadable "
+            f"[{kind}] at {path}")
 
 
 @dataclasses.dataclass
@@ -112,6 +142,14 @@ class TaskScheduler:
         self.worker_failures: Dict[int, int] = {}
         self.blacklist: set = set()
         self.respawns_used = 0
+        # attempt NUMBERING is per-QUERY, not per-run_stage call: a
+        # lineage stage rerun re-submits the same task ids, and
+        # restarting at attempt 0 would re-trigger attempt-pinned chaos
+        # rules and collide with the first run's rendezvous markers.
+        # The maxAttempts BUDGET stays per-stage-run (attempts_used in
+        # _run_stage) — successful earlier launches of a rerun task
+        # must not eat its failure allowance.
+        self._attempt_seq: Dict[str, int] = {}
         self._max_attempts = max(1, conf.get(TASK_MAX_ATTEMPTS))
         self._max_wfail = max(1, conf.get(MAX_TASK_FAILURES_PER_WORKER))
         self._max_respawns = conf.get(MAX_WORKER_RESPAWNS)
@@ -191,7 +229,8 @@ class TaskScheduler:
         for e in self.events:
             c[e["event"]] = c.get(e["event"], 0) + 1
         overhead = sum(e["wall_s"] for e in self.events
-                       if e["event"] in ("task_failed", "attempt_lost"))
+                       if e["event"] in ("task_failed", "attempt_lost",
+                                         "fetch_failed"))
         return {
             "tasks_ok": c.get("task_ok", 0),
             "failures": c.get("task_failed", 0),
@@ -199,8 +238,21 @@ class TaskScheduler:
             "speculative_lost": c.get("attempt_lost", 0),
             "workers_respawned": c.get("worker_respawn", 0),
             "workers_blacklisted": len(self.blacklist),
+            "fetch_failures": c.get("fetch_failed", 0),
+            "stage_reruns": c.get("stage_rerun", 0),
             "retry_overhead_s": round(overhead, 6),
         }
+
+    @staticmethod
+    def _read_fetchfail(path: str) -> Optional[Dict]:
+        """The worker's structured ``.fetchfail`` marker (written next
+        to its ``.err``), or None for ordinary task errors."""
+        try:
+            with open(path + ".fetchfail") as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+        return doc if isinstance(doc, dict) else None
 
     # --- worker selection -------------------------------------------------
 
@@ -391,8 +443,10 @@ class TaskScheduler:
                         fail_attempt(att, "worker recycled under attempt",
                                      worker_fault=False)
                     self._respawn(w, "no usable worker left")
-                n = attempts_used.get(spec.task_id, 0)
-                attempts_used[spec.task_id] = n + 1
+                n = self._attempt_seq.get(spec.task_id, 0)
+                self._attempt_seq[spec.task_id] = n + 1
+                attempts_used[spec.task_id] = \
+                    attempts_used.get(spec.task_id, 0) + 1
                 self._launch(spec, n, w, running)
                 self._event("task_submitted", spec.task_id, n, w)
             queue = []
@@ -430,6 +484,28 @@ class TaskScheduler:
                     except OSError:
                         tb = "(unreadable .err)"
                     self._absorb_worker_spans(att)
+                    ff = self._read_fetchfail(att.path)
+                    if ff is not None and ff.get("map_task"):
+                        # classified shuffle-read failure with a known
+                        # producer: escalate to lineage recovery
+                        # instead of retrying the reader against the
+                        # same bad bytes — and blame neither the
+                        # reading task nor its worker
+                        att.state = "err"
+                        running.remove(att)
+                        kind = ff.get("kind", "io")
+                        reason = (f"[{kind}] shuffle "
+                                  f"{ff.get('shuffle_id', -1)} map "
+                                  f"{ff['map_task']} "
+                                  f"({os.path.basename(ff.get('path') or '')})")
+                        self._close_attempt_span(att, "fetchfail", reason)
+                        self._event("fetch_failed", att.spec.task_id,
+                                    att.number, att.worker, att.runtime,
+                                    reason)
+                        raise FetchFailedError(
+                            ff.get("shuffle_id", -1), ff["map_task"],
+                            kind, ff.get("path", ""), att.spec.task_id,
+                            att.number, att.worker, completed=set(done))
                     fail_attempt(att, tb, worker_fault=True)
                 elif att.claim_ts is not None \
                         and att.spec.task_id in done:
@@ -510,14 +586,15 @@ class TaskScheduler:
                     if sum(1 for a in running
                            if a.spec.task_id == tid) > 1:
                         continue  # already speculating
-                    n = attempts_used.get(tid, 0)
-                    if n >= self._max_attempts:
+                    if attempts_used.get(tid, 0) >= self._max_attempts:
                         continue
                     w = self._pick_worker(running, {att.worker}
                                           | failed_on[tid])
                     if w is None or w == att.worker:
                         continue
-                    attempts_used[tid] = n + 1
+                    n = self._attempt_seq.get(tid, 0)
+                    self._attempt_seq[tid] = n + 1
+                    attempts_used[tid] = attempts_used.get(tid, 0) + 1
                     self._launch(att.spec, n, w, running)
                     self._event("speculative_attempt", tid, n, w,
                                 att.runtime,

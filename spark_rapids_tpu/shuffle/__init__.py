@@ -1,4 +1,4 @@
 from .partitioner import (HashPartitioning, RangePartitioning,
                           RoundRobinPartitioning, SinglePartitioning)
-from .transport import (LocalShuffleTransport, ShuffleTransport,
-                        ShuffleWriteHandle)
+from .transport import (FetchFailure, LocalShuffleTransport,
+                        ShuffleTransport, ShuffleWriteHandle)

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import errno
+import logging
 import os
 import shutil
 import tempfile
@@ -33,15 +34,25 @@ from typing import Dict, List, Optional
 import numpy as np
 import pyarrow as pa
 
-from ..config import (RapidsConf, SHUFFLE_COMPRESSION, SHUFFLE_THREADS)
+from ..config import (RapidsConf, SHUFFLE_COMPRESSION,
+                      SHUFFLE_FETCH_MAX_RETRIES,
+                      SHUFFLE_FETCH_RETRY_WAIT_MS, SHUFFLE_THREADS)
 from ..columnar.batch import TpuBatch
 from ..obs.metrics import REGISTRY as _METRICS
 from ..obs.recorder import RECORDER as _FLIGHT
-from .transport import ShuffleTransport, ShuffleWriteHandle
+from . import integrity
+from .transport import FetchFailure, ShuffleTransport, ShuffleWriteHandle
 
 __all__ = ["HostShuffleTransport", "SHUF_PARTS_WRITTEN",
            "SHUF_BYTES_WRITTEN", "SHUF_PARTS_FETCHED",
-           "SHUF_BYTES_FETCHED", "SHUF_FETCH_WAIT"]
+           "SHUF_BYTES_FETCHED", "SHUF_FETCH_WAIT",
+           "SHUF_FETCH_FAILURES"]
+
+_LOG = logging.getLogger(__name__)
+
+#: Bounded wait for outstanding writer futures in close(): a wedged
+#: codec thread must not hang process teardown forever.
+_CLOSE_JOIN_S = 10.0
 
 _IPC_CODECS = ("none", "lz4", "zstd")
 
@@ -69,6 +80,12 @@ SHUF_FETCH_WAIT = _METRICS.histogram(
     "rapids_shuffle_fetch_wait_seconds",
     "Time the read side blocked waiting for shuffle data (file reads "
     "or collective realization).", ("transport",))
+SHUF_FETCH_FAILURES = _METRICS.counter(
+    "rapids_shuffle_fetch_failures_total",
+    "Classified shuffle fetch failures by kind: missing (block or "
+    "committed map output gone), corrupt (CRC mismatch), torn "
+    "(malformed integrity footer/manifest), io (transient OSError "
+    "that survived the in-place retries).", ("kind",))
 
 
 class _HostWriter(ShuffleWriteHandle):
@@ -119,6 +136,14 @@ class HostShuffleTransport(ShuffleTransport):
         self._own_root = root is None
         self._futures: Dict[int, List] = {}
         self._schemas: Dict[int, object] = {}
+        # sticky per-shuffle writer error: a failed async write must
+        # surface on EVERY subsequent read of that shuffle, not just the
+        # one that happened to drain the failed future
+        self._failed: Dict[int, BaseException] = {}
+        # per-staging-dir (size, crc) entries for the commit manifest
+        self._manifests: Dict[str, Dict[str, Dict]] = {}
+        self._fetch_retries = conf.get(SHUFFLE_FETCH_MAX_RETRIES)
+        self._fetch_wait_s = conf.get(SHUFFLE_FETCH_RETRY_WAIT_MS) / 1e3
         self._lock = threading.Lock()
 
     # --- write side -------------------------------------------------------
@@ -153,10 +178,15 @@ class HostShuffleTransport(ShuffleTransport):
                   rb: pa.RecordBatch,
                   subdir: Optional[str] = None) -> None:
         path = self._path(sid, mid, pid, subdir)
-        with pa.OSFile(path, "wb") as f, \
-                pa.ipc.new_file(f, rb.schema,
-                                options=self._ipc_options()) as w:
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_file(sink, rb.schema,
+                             options=self._ipc_options()) as w:
             w.write_batch(rb)
+        size, crc = integrity.write_block(path,
+                                          sink.getvalue().to_pybytes())
+        with self._lock:
+            self._manifests.setdefault(os.path.dirname(path), {})[
+                os.path.basename(path)] = {"size": size, "crc": crc}
         SHUF_PARTS_WRITTEN.labels("host").inc()
         SHUF_BYTES_WRITTEN.labels("host").inc(rb.nbytes)
 
@@ -232,6 +262,15 @@ class HostShuffleTransport(ShuffleTransport):
         staging = os.path.join(self._sdir(shuffle_id),
                                f"{task_key}.a{attempt}.staging")
         final = os.path.join(self._sdir(shuffle_id), f"{task_key}.mapout")
+        # the manifest (expected files + sizes + crcs) commits with the
+        # SAME rename that publishes the files: readers can then prove a
+        # block is missing, not just corrupt
+        with self._lock:
+            entries = self._manifests.pop(staging, {})
+        try:
+            integrity.write_manifest(staging, task_key, attempt, entries)
+        except OSError:
+            pass  # staging already gone: the rename below settles it
         try:
             os.rename(staging, final)
             return True
@@ -249,27 +288,19 @@ class HostShuffleTransport(ShuffleTransport):
                            attempt: int) -> None:
         staging = os.path.join(self._sdir(shuffle_id),
                                f"{task_key}.a{attempt}.staging")
+        with self._lock:
+            self._manifests.pop(staging, None)
         shutil.rmtree(staging, ignore_errors=True)
 
     @staticmethod
     def committed_partition_files(sdir: str, partition_id: int):
-        """All of a shuffle dir's files for one partition: legacy flat
-        files plus every committed attempt dir — staging dirs are
-        invisible by construction."""
-        suffix = f"_p{partition_id}.arrow"
-        out = []
-        try:
-            names = sorted(os.listdir(sdir))
-        except FileNotFoundError:
-            return out
-        for n in names:
-            p = os.path.join(sdir, n)
-            if n.endswith(suffix):
-                out.append(p)
-            elif n.endswith(".mapout") and os.path.isdir(p):
-                out.extend(os.path.join(p, m) for m in sorted(os.listdir(p))
-                           if m.endswith(suffix))
-        return out
+        """Paths of one partition's blocks: legacy flat files plus
+        every committed attempt dir's manifest-listed files — staging
+        dirs are invisible by construction. Thin path-only view over
+        ``integrity.expected_partition_files`` so there is exactly ONE
+        definition of "a committed block"."""
+        return [p for p, _ in integrity.expected_partition_files(
+            sdir, partition_id)]
 
     # --- transport interface ----------------------------------------------
 
@@ -281,10 +312,44 @@ class HostShuffleTransport(ShuffleTransport):
         return _HostWriter(self, shuffle_id, map_id, subdir)
 
     def _drain(self, sid: int):
+        """Settle outstanding pool writes for one shuffle. A writer
+        error is STICKY: every future is drained (not just up to the
+        first failure), the first error is remembered per shuffle, and
+        every subsequent drain — each read_partition, every commit —
+        re-raises it. Popping the futures list used to deliver the
+        error to exactly one reader and let later partitions silently
+        read partial data."""
         with self._lock:
             futs = self._futures.pop(sid, [])
+        first: Optional[BaseException] = None
         for f in futs:
-            f.result()  # re-raise writer errors on the reader
+            try:
+                f.result()
+            except BaseException as e:  # noqa: BLE001 — writer errors
+                if first is None:      # of any type must reach readers
+                    first = e
+        if first is not None:
+            with self._lock:
+                self._failed.setdefault(sid, first)
+        with self._lock:
+            err = self._failed.get(sid)
+        if err is not None:
+            raise RuntimeError(
+                f"shuffle {sid} had a failed async write; its output "
+                f"is incomplete") from err
+
+    @staticmethod
+    def _record_fetch_failure(ff: FetchFailure, partition_id: int,
+                              transport: str = "host") -> None:
+        """Classified-failure tap shared by the shuffle readers: the
+        kind-labeled counter plus a flight-recorder event, so a fetch
+        failure is visible in /metrics and in the incident bundle."""
+        SHUF_FETCH_FAILURES.labels(ff.kind).inc()
+        _FLIGHT.record("shuffle", ev="fetch_failure", sid=ff.shuffle_id,
+                       part=int(partition_id), fail_kind=ff.kind,
+                       map=str(ff.map_task or ""),
+                       path=os.path.basename(ff.path or ""),
+                       transport=transport)
 
     def read_partition(self, shuffle_id: int, partition_id: int):
         import time as _time
@@ -293,8 +358,13 @@ class HostShuffleTransport(ShuffleTransport):
         t0 = _time.perf_counter()
         self._drain(shuffle_id)  # the multithreaded-writer wait
         schema = self._schemas.get(shuffle_id)
-        paths = self.committed_partition_files(self._sdir(shuffle_id),
-                                               partition_id)
+        try:
+            blocks = integrity.expected_partition_files(
+                self._sdir(shuffle_id), partition_id,
+                shuffle_id=shuffle_id)
+        except FetchFailure as ff:
+            self._record_fetch_failure(ff, partition_id)
+            raise
         drain_s = _time.perf_counter() - t0
         SHUF_FETCH_WAIT.labels("host").observe(drain_s)
         SHUF_PARTS_FETCHED.labels("host").inc()
@@ -309,9 +379,20 @@ class HostShuffleTransport(ShuffleTransport):
         ilock = threading.Lock()
         closed = [False]
 
-        def load(path):
-            with pa.OSFile(path, "rb") as f:
-                table = pa.ipc.open_file(f).read_all()
+        def load(block):
+            path, meta = block
+            try:
+                payload = integrity.read_block(
+                    path, meta, shuffle_id=shuffle_id,
+                    max_retries=self._fetch_retries,
+                    retry_wait_s=self._fetch_wait_s,
+                    on_retry=lambda n, e: _FLIGHT.record(
+                        "shuffle", ev="fetch_retry", sid=int(shuffle_id),
+                        part=int(partition_id), n=n, error=str(e)[:120]))
+            except FetchFailure as ff:
+                self._record_fetch_failure(ff, partition_id)
+                raise
+            table = pa.ipc.open_file(pa.BufferReader(payload)).read_all()
             batches = [arrow_to_device(rb, schema)
                        for rb in table.combine_chunks().to_batches()
                        if rb.num_rows]
@@ -331,7 +412,7 @@ class HostShuffleTransport(ShuffleTransport):
         # thread while the consumer computes on N's batches; the window
         # bounds in-flight (uploaded, unconsumed) partition files — one
         # RecordBatch per file by the writer's construction.
-        gen = pipelined_map(load, paths, threads=1, window=2)
+        gen = pipelined_map(load, blocks, threads=1, window=2)
         try:
             while True:
                 t1 = _time.perf_counter()
@@ -357,13 +438,51 @@ class HostShuffleTransport(ShuffleTransport):
                 sb.release()
 
     def unregister_shuffle(self, shuffle_id: int):
-        self._drain(shuffle_id)
+        """Cleanup-safe: the shuffle dir and bookkeeping are released
+        even when a writer failed — THEN the sticky error is re-raised
+        so a caller tearing down after a silent async failure still
+        hears about it (and cannot leak the dir by raising early)."""
+        err: Optional[BaseException] = None
+        try:
+            self._drain(shuffle_id)
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            err = e
+        sdir = self._sdir(shuffle_id)
         with self._lock:
             self._schemas.pop(shuffle_id, None)
-        shutil.rmtree(self._sdir(shuffle_id), ignore_errors=True)
+            self._failed.pop(shuffle_id, None)
+            for d in [d for d in self._manifests
+                      if d == sdir or d.startswith(sdir + os.sep)]:
+                del self._manifests[d]
+        shutil.rmtree(sdir, ignore_errors=True)
+        if err is not None:
+            raise err
 
     def close(self):
+        """Bounded teardown: a wedged writer thread (stuck codec /
+        filesystem call) must not hang close() forever behind
+        ``shutdown(wait=True)`` — wait up to ``_CLOSE_JOIN_S`` for
+        outstanding writes, then abandon them with a log line."""
         if self._pool is not None:
-            self._pool.shutdown(wait=True)
+            with self._lock:
+                futs = [f for fs in self._futures.values() for f in fs]
+                self._futures.clear()
+            self._pool.shutdown(wait=False)
+            if futs:
+                _, pending = concurrent.futures.wait(
+                    futs, timeout=_CLOSE_JOIN_S)
+                if pending:
+                    _LOG.warning(
+                        "HostShuffleTransport.close: abandoning %d "
+                        "outstanding shuffle write(s) still running "
+                        "after %.0fs", len(pending), _CLOSE_JOIN_S)
+                    # keep interpreter exit from joining the wedged
+                    # threads too (the atexit hook would re-hang there)
+                    try:
+                        from concurrent.futures import thread as _cft
+                        for t in getattr(self._pool, "_threads", ()):
+                            _cft._threads_queues.pop(t, None)
+                    except Exception:  # noqa: BLE001 — best effort
+                        pass
         if self._own_root:
             shutil.rmtree(self.root, ignore_errors=True)

@@ -1,0 +1,275 @@
+"""Shuffle block integrity: CRC32C footers, per-attempt manifests, and
+classified verified reads.
+
+The reference's RapidsShuffleManager survives executor loss because a
+bad shuffle read surfaces as a *classified* FetchFailedException and
+Spark re-executes the parent map stage from lineage (Zaharia et al.,
+NSDI'12); before that can work here, the reader has to be able to TELL
+that a block is bad. Three mechanisms, all inside the existing
+attempt-dir atomic-commit protocol (shuffle/host.py):
+
+- **footer** — every partition file is ``<arrow-ipc payload>`` followed
+  by a 16-byte trailer ``<u64 payload_len><u32 crc32c><4s magic>``.
+  A truncated/overwritten trailer is ``torn``; a payload whose CRC
+  disagrees is ``corrupt``. (The trailer rides OUTSIDE the Arrow IPC
+  framing, so readers strip it before handing bytes to pyarrow.)
+- **manifest** — ``MANIFEST.json`` written into the attempt's staging
+  dir at commit time records every file the attempt produced with its
+  size and CRC, so a *missing* block is detected, not just a corrupt
+  one (a committed dir with no manifest at all is read legacy-style:
+  footers still verify, absence cannot be proven).
+- **classified reads** — ``read_block`` turns every failure into a
+  typed :class:`~.transport.FetchFailure` with
+  ``kind in (missing, corrupt, torn, io)``; transient ``io`` errors get
+  a bounded in-place retry with exponential backoff
+  (``spark.rapids.shuffle.fetch.maxRetries`` / ``.retryWaitMs``) before
+  escalating, because a flaky NFS read should not cost a stage rerun.
+
+Fault injection: a ``<file>.eio`` sidecar (written by chaos ``eio``
+rules, scheduler/chaos.py) holds a countdown of reads that must fail
+with EIO — consumed one per read attempt, which is exactly the
+transient-then-fine shape the in-place retry exists for.
+"""
+from __future__ import annotations
+
+import errno
+import json
+import os
+import re
+import struct
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .transport import FetchFailure
+
+__all__ = ["FOOTER_LEN", "MANIFEST_NAME", "crc32c", "write_block",
+           "write_manifest", "read_manifest", "verify_payload",
+           "read_block", "expected_partition_files",
+           "expected_partition_index"]
+
+_FOOTER_MAGIC = b"RSF1"
+FOOTER_LEN = 16  # <Q payload_len> <I crc32c> <4s magic>
+MANIFEST_NAME = "MANIFEST.json"
+
+try:  # the container may carry the C implementation; never a hard dep
+    from google_crc32c import value as _gcrc32c
+
+    def crc32c(data) -> int:
+        if not isinstance(data, (bytes, bytearray)):
+            data = bytes(data)  # the C impl rejects memoryview
+        return _gcrc32c(data)
+except ImportError:  # pragma: no cover - environment-dependent
+    import zlib
+
+    def crc32c(data) -> int:  # type: ignore[misc]
+        # CRC32 fallback: same width and detection class; writers and
+        # readers share one process image so the choice is consistent
+        return zlib.crc32(data) & 0xFFFFFFFF
+
+
+# --- write side --------------------------------------------------------------
+
+def write_block(path: str, payload: bytes) -> Tuple[int, int]:
+    """Write ``payload`` plus the integrity footer; returns the file's
+    total size and the payload CRC (the manifest entry)."""
+    crc = crc32c(payload)
+    with open(path, "wb") as f:
+        f.write(payload)
+        f.write(struct.pack("<QI4s", len(payload), crc, _FOOTER_MAGIC))
+    return len(payload) + FOOTER_LEN, crc
+
+
+def write_manifest(staging_dir: str, task_key: str, attempt: int,
+                   files: Dict[str, Dict]) -> str:
+    """Commit the attempt's expected-output record into its staging dir
+    (so the ONE os.rename that publishes the attempt publishes the
+    manifest with it — a reader can never see files without their
+    manifest or vice versa)."""
+    path = os.path.join(staging_dir, MANIFEST_NAME)
+    doc = {"task": task_key, "attempt": attempt, "files": files}
+    with open(path + ".tmp", "w") as f:
+        json.dump(doc, f)
+    os.replace(path + ".tmp", path)
+    return path
+
+
+def read_manifest(mapout_dir: str, shuffle_id: int = -1) -> Optional[Dict]:
+    """The committed dir's manifest, or None when it has none (legacy /
+    hand-built dirs). A manifest that EXISTS but does not parse is a
+    torn commit and raises — that dir's contents cannot be trusted."""
+    path = os.path.join(mapout_dir, MANIFEST_NAME)
+    task_key = os.path.basename(mapout_dir).rsplit(".mapout", 1)[0]
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise FetchFailure(shuffle_id, task_key, path, "torn",
+                           f"unreadable manifest: {e}")
+    if not isinstance(doc, dict) or not isinstance(doc.get("files"), dict):
+        raise FetchFailure(shuffle_id, task_key, path, "torn",
+                           "malformed manifest")
+    return doc
+
+
+# --- read side ---------------------------------------------------------------
+
+def verify_payload(data: bytes, path: str, shuffle_id: int = -1,
+                   map_task=None, expected_crc: Optional[int] = None):
+    """Strip + check the footer; the Arrow IPC payload (a zero-copy
+    memoryview over ``data``) on success. ``expected_crc`` is the
+    manifest's record — compared against the footer field BEFORE the
+    (single) payload scan, so a healthy block pays exactly one CRC
+    pass."""
+    if len(data) < FOOTER_LEN or data[-4:] != _FOOTER_MAGIC:
+        raise FetchFailure(shuffle_id, map_task, path, "torn",
+                           f"bad footer (file is {len(data)} bytes)")
+    plen, crc = struct.unpack("<QI", data[-FOOTER_LEN:-4])
+    if plen != len(data) - FOOTER_LEN:
+        raise FetchFailure(
+            shuffle_id, map_task, path, "torn",
+            f"footer claims {plen} payload bytes, file holds "
+            f"{len(data) - FOOTER_LEN}")
+    if expected_crc is not None and expected_crc != crc:
+        raise FetchFailure(shuffle_id, map_task, path, "corrupt",
+                           f"footer crc {crc:#010x} != manifest "
+                           f"{expected_crc:#010x}")
+    payload = memoryview(data)[:-FOOTER_LEN]
+    got = crc32c(payload)
+    if got != crc:
+        raise FetchFailure(shuffle_id, map_task, path, "corrupt",
+                           f"crc {got:#010x} != footer {crc:#010x}")
+    return payload
+
+
+def _maybe_inject_eio(path: str) -> None:
+    """Chaos seam: an ``<file>.eio`` sidecar is a countdown of reads
+    that must fail transiently. One stat per read when absent — noise
+    next to the read itself."""
+    sidecar = path + ".eio"
+    try:
+        with open(sidecar) as f:
+            left = int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return
+    if left <= 0:
+        return
+    with open(sidecar + ".tmp", "w") as f:
+        f.write(str(left - 1))
+    os.replace(sidecar + ".tmp", sidecar)
+    raise OSError(errno.EIO, f"injected EIO ({left - 1} left)", path)
+
+
+def read_block(path: str, meta: Optional[Dict] = None, *,
+               shuffle_id: int = -1, map_task=None,
+               max_retries: int = 3, retry_wait_s: float = 0.05,
+               on_retry=None):
+    """Read + verify one shuffle block (returns the Arrow IPC payload
+    as a zero-copy memoryview), classifying every failure:
+
+    - the file is gone                      -> ``missing`` (no retry:
+      commit made it durable once; absence is loss, not latency)
+    - footer truncated/malformed            -> ``torn``
+    - CRC mismatch (vs footer, or vs the manifest's expectation)
+      -> ``corrupt``
+    - any other OSError -> bounded in-place retry with exponential
+      backoff, then ``io``.
+    """
+    meta = meta or {}
+    map_task = meta.get("task", map_task)
+    last: Optional[OSError] = None
+    for attempt in range(max(0, max_retries) + 1):
+        if attempt and on_retry is not None:
+            on_retry(attempt, last)
+        try:
+            _maybe_inject_eio(path)
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            raise FetchFailure(shuffle_id, map_task, path, "missing",
+                               "block listed in the manifest is gone")
+        except OSError as e:
+            last = e
+            if attempt < max_retries:  # no sleep before the escalation
+                time.sleep(retry_wait_s * (2 ** attempt))
+            continue
+        size = meta.get("size")
+        if size is not None and size != len(data):
+            raise FetchFailure(
+                shuffle_id, map_task, path, "torn",
+                f"manifest expects {size} bytes, file holds {len(data)}")
+        return verify_payload(data, path, shuffle_id, map_task,
+                              expected_crc=meta.get("crc"))
+    raise FetchFailure(
+        shuffle_id, map_task, path, "io",
+        f"still failing after {max_retries} in-place retries: {last}")
+
+
+_PID_RE = re.compile(r"_p(\d+)\.arrow$")
+
+
+def expected_partition_index(
+        sdir: str, expected_mapouts: Optional[List[str]] = None,
+        shuffle_id: int = -1) -> Dict[int, List[Tuple[str,
+                                                      Optional[Dict]]]]:
+    """ONE pass over a shuffle dir — every committed dir's manifest
+    parsed once — indexed ``{partition_id: [(path, manifest_meta)]}``.
+    Listed blocks are the ones a reader MUST consume, whether or not
+    the file is still on disk (``read_block`` turns absence into a
+    ``missing`` FetchFailure). ``expected_mapouts`` is the driver's
+    lineage knowledge (one task key per committed map task): a whole
+    attempt dir that vanished after commit raises ``missing`` here,
+    because no manifest survives to prove what it held."""
+    try:
+        names = sorted(os.listdir(sdir))
+    except FileNotFoundError:
+        names = []
+    seen_dirs = {n[:-len(".mapout")] for n in names
+                 if n.endswith(".mapout")
+                 and os.path.isdir(os.path.join(sdir, n))}
+    for key in sorted(expected_mapouts or []):
+        if key not in seen_dirs:
+            raise FetchFailure(
+                shuffle_id, key, os.path.join(sdir, f"{key}.mapout"),
+                "missing", "committed map output dir is gone")
+    out: Dict[int, List[Tuple[str, Optional[Dict]]]] = {}
+
+    def add(pid, path, meta):
+        out.setdefault(pid, []).append((path, meta))
+
+    for n in names:
+        p = os.path.join(sdir, n)
+        m = _PID_RE.search(n)
+        if m is not None:
+            add(int(m.group(1)), p, None)
+        elif n.endswith(".mapout") and os.path.isdir(p):
+            task_key = n[:-len(".mapout")]
+            manifest = read_manifest(p, shuffle_id)
+            if manifest is None:
+                # legacy dir: enumerate what's visible; footers still
+                # verify but absence is unprovable
+                for f in sorted(os.listdir(p)):
+                    fm = _PID_RE.search(f)
+                    if fm is not None:
+                        add(int(fm.group(1)), os.path.join(p, f), None)
+                continue
+            for f in sorted(manifest["files"]):
+                fm = _PID_RE.search(f)
+                if fm is None:
+                    continue
+                meta = dict(manifest["files"][f] or {})
+                meta.setdefault("task", manifest.get("task", task_key))
+                add(int(fm.group(1)), os.path.join(p, f), meta)
+    return out
+
+
+def expected_partition_files(
+        sdir: str, partition_id: int,
+        expected_mapouts: Optional[List[str]] = None,
+        shuffle_id: int = -1) -> List[Tuple[str, Optional[Dict]]]:
+    """One partition's slice of :func:`expected_partition_index` —
+    the convenience shape for per-partition transports; multi-partition
+    readers should build the index once instead."""
+    return expected_partition_index(sdir, expected_mapouts,
+                                    shuffle_id).get(partition_id, [])

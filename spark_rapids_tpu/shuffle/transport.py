@@ -22,7 +22,39 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from ..columnar.batch import TpuBatch
 
 __all__ = ["ShuffleTransport", "ShuffleWriteHandle",
-           "LocalShuffleTransport"]
+           "LocalShuffleTransport", "FetchFailure", "FETCH_FAILURE_KINDS"]
+
+#: Classification a reader attaches to a failed shuffle fetch:
+#: ``missing`` — a block (or whole committed map output) is gone,
+#: ``corrupt`` — bytes read back but the CRC disagrees,
+#: ``torn``    — the integrity footer itself is malformed/truncated
+#:               (a crash mid-write, or trailing garbage),
+#: ``io``      — a transient OSError that survived the reader's
+#:               bounded in-place retries.
+FETCH_FAILURE_KINDS = ("missing", "corrupt", "torn", "io")
+
+
+class FetchFailure(RuntimeError):
+    """A shuffle block failed to fetch or verify (the reader-side
+    FetchFailedException analog). Distinct from deterministic task
+    errors: the scheduler recovers by re-executing the parent map
+    stage from lineage instead of retrying the reduce task against
+    the same bad bytes. ``map_task`` is the committed map task's key
+    when known (manifest-backed reads), else None — without it the
+    driver has no lineage handle and the failure is fatal."""
+
+    def __init__(self, shuffle_id: int, map_task, path: str, kind: str,
+                 detail: str = ""):
+        assert kind in FETCH_FAILURE_KINDS, kind
+        self.shuffle_id = int(shuffle_id)
+        self.map_task = map_task
+        self.path = path
+        self.kind = kind
+        self.detail = detail
+        super().__init__(
+            f"shuffle {shuffle_id} fetch failure [{kind}] "
+            f"map={map_task or '?'} {path}"
+            + (f": {detail}" if detail else ""))
 
 
 class ShuffleWriteHandle:

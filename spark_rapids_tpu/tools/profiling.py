@@ -177,7 +177,8 @@ def profile_event_logs(path: str) -> str:
             s = ev.get("summary", {})
             for k in ("tasks_ok", "failures", "speculative_launched",
                       "speculative_lost", "workers_respawned",
-                      "workers_blacklisted"):
+                      "workers_blacklisted", "fetch_failures",
+                      "stage_reruns"):
                 tot[k] += int(s.get(k, 0))
             retry_overhead += float(s.get("retry_overhead_s", 0.0))
             cluster_wall += float(ev.get("wall_s", 0.0))
@@ -188,6 +189,10 @@ def profile_event_logs(path: str) -> str:
                      f"(lost {tot['speculative_lost']})")
         lines.append(f"  workers respawned {tot['workers_respawned']}, "
                      f"blacklisted {tot['workers_blacklisted']}")
+        if tot["fetch_failures"] or tot["stage_reruns"]:
+            lines.append(
+                f"  shuffle fetch failures {tot['fetch_failures']}, "
+                f"map-stage reruns {tot['stage_reruns']}")
         lines.append(f"  retry overhead {retry_overhead * 1e3:.1f}ms "
                      f"of {cluster_wall * 1e3:.1f}ms cluster wall")
         if cluster_wall > 0 and retry_overhead > 0.1 * cluster_wall:
@@ -195,6 +200,12 @@ def profile_event_logs(path: str) -> str:
                 f"{retry_overhead / max(cluster_wall, 1e-9):.0%} of "
                 "cluster wall went to failed/duplicate attempts — "
                 "check worker stability before tuning kernels")
+        if tot["stage_reruns"]:
+            recs.append(
+                f"{tot['stage_reruns']} map-stage rerun(s) recovered "
+                "lost/corrupt shuffle output — check the shuffle "
+                "storage (disk, NFS) feeding the cluster root; "
+                "`profiling triage <incident>` names the bad blocks")
     # trace rollups from embedded span summaries (queries that ran with
     # spark.rapids.trace.dir set; the full timeline is in the trace
     # JSON — `profiling <trace.json>` mines its critical path)
@@ -351,7 +362,16 @@ def _fmt_ring_event(e: dict) -> str:
         return (f"task {e.get('ev', '?')} {e.get('task', '')} "
                 f"a{e.get('attempt', '?')} {extra}").rstrip()
     if kind == "shuffle":
-        return (f"shuffle {e.get('ev', '?')} s{e.get('sid', '?')} "
+        ev = e.get("ev", "?")
+        if ev == "fetch_failure":
+            return (f"shuffle FETCH-FAILURE [{e.get('fail_kind', '?')}] "
+                    f"s{e.get('sid', '?')} p{e.get('part', '?')} "
+                    f"map {e.get('map', '?')} {e.get('path', '')}")
+        if ev == "fetch_retry":
+            return (f"shuffle fetch-retry #{e.get('n', '?')} "
+                    f"s{e.get('sid', '?')} p{e.get('part', '?')} "
+                    f"{e.get('error', '')}")
+        return (f"shuffle {ev} s{e.get('sid', '?')} "
                 f"p{e.get('part', '?')} wait "
                 f"{e.get('wait_s', 0) * 1e3:.1f}ms")
     if kind == "span":

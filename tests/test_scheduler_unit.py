@@ -66,8 +66,10 @@ def test_commit_first_attempt_wins(tmp_path):
     assert not os.path.exists(d1)
     files = t.committed_partition_files(t._sdir(1), 0)
     assert len(files) == 1 and "t0.mapout" in files[0]
-    with pa.OSFile(files[0], "rb") as f:
-        got = pa.ipc.open_file(f).read_all()
+    # blocks now carry an integrity trailer: read through the verifier
+    from spark_rapids_tpu.shuffle import integrity
+    got = pa.ipc.open_file(
+        pa.BufferReader(integrity.read_block(files[0]))).read_all()
     assert got.column("x").to_pylist() == [1, 2, 3]
     # the loser's partition-1 file must not exist anywhere
     assert t.committed_partition_files(t._sdir(1), 1) == []

@@ -1,0 +1,355 @@
+"""Shuffle integrity unit tests (no worker processes): CRC footers,
+commit manifests, fetch-failure classification with in-place retry,
+the attempt-commit edge cases, the multithreaded writer's sticky
+error, and cleanup-safe teardown. The process-cluster recovery paths
+these feed live in test_shuffle_recovery.py."""
+import json
+import os
+import time
+
+import pyarrow as pa
+import pytest
+
+from data_gen import IntegerGen, LongGen, gen_table
+
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.scheduler import chaos
+from spark_rapids_tpu.shuffle import integrity
+from spark_rapids_tpu.shuffle.host import HostShuffleTransport
+from spark_rapids_tpu.shuffle.transport import FetchFailure
+
+
+def _rb(n=50, seed=1):
+    return gen_table([IntegerGen(nullable=False), LongGen(nullable=False)],
+                     n, seed=seed, names=["k", "v"])
+
+
+def _transport(tmp_path, threads=0):
+    return HostShuffleTransport(RapidsConf(), threads=threads,
+                                root=str(tmp_path / "shuffle"))
+
+
+def _commit_mapout(t, sid=1, key="t0", attempt=0, parts=(0, 1), mid=0,
+                   seed=7):
+    """Write one partition file per pid into a staging dir and commit."""
+    t.register_shuffle(sid, max(parts) + 1 if parts else 1)
+    staging = t.begin_task_attempt(sid, key, attempt)
+    for pid in parts:
+        t._write_rb(sid, mid, pid, _rb(seed=seed + pid), subdir=staging)
+    won = t.commit_task_attempt(sid, key, attempt)
+    return won, os.path.join(t._sdir(sid), f"{key}.mapout")
+
+
+# --- footer + classification ------------------------------------------------
+
+def test_footer_roundtrip_and_crc(tmp_path):
+    path = str(tmp_path / "b.arrow")
+    payload = b"x" * 1000
+    size, crc = integrity.write_block(path, payload)
+    assert size == 1000 + integrity.FOOTER_LEN
+    assert os.path.getsize(path) == size
+    got = integrity.read_block(path)
+    assert got == payload
+    meta_ok = {"size": size, "crc": crc}
+    assert integrity.read_block(path, meta_ok) == payload
+
+
+def test_missing_block_classified(tmp_path):
+    with pytest.raises(FetchFailure) as ei:
+        integrity.read_block(str(tmp_path / "gone.arrow"),
+                             {"task": "t9"}, shuffle_id=3)
+    assert ei.value.kind == "missing"
+    assert ei.value.map_task == "t9"
+    assert ei.value.shuffle_id == 3
+
+
+def test_torn_footer_classified(tmp_path):
+    path = str(tmp_path / "b.arrow")
+    integrity.write_block(path, b"y" * 500)
+    # crash between write and (dir) rename can leave a short file:
+    # truncate through the trailer
+    with open(path, "r+b") as f:
+        f.truncate(500 + integrity.FOOTER_LEN - 7)
+    with pytest.raises(FetchFailure) as ei:
+        integrity.read_block(path)
+    assert ei.value.kind == "torn"
+    # trailing garbage after the trailer is torn too, not corrupt
+    path2 = str(tmp_path / "b2.arrow")
+    integrity.write_block(path2, b"z" * 100)
+    with open(path2, "ab") as f:
+        f.write(b"junk")
+    with pytest.raises(FetchFailure) as ei:
+        integrity.read_block(path2)
+    assert ei.value.kind == "torn"
+
+
+def test_corrupt_payload_classified(tmp_path):
+    path = str(tmp_path / "b.arrow")
+    integrity.write_block(path, bytes(range(256)) * 10)
+    with open(path, "r+b") as f:
+        f.seek(100)
+        f.write(b"\xde\xad")
+    with pytest.raises(FetchFailure) as ei:
+        integrity.read_block(path)
+    assert ei.value.kind == "corrupt"
+
+
+def test_manifest_size_mismatch_is_torn(tmp_path):
+    path = str(tmp_path / "b.arrow")
+    size, crc = integrity.write_block(path, b"p" * 64)
+    with pytest.raises(FetchFailure) as ei:
+        integrity.read_block(path, {"size": size + 5, "crc": crc})
+    assert ei.value.kind == "torn"
+
+
+# --- transient io: eio sidecar + bounded in-place retry ---------------------
+
+def test_eio_retries_in_place_then_succeeds(tmp_path):
+    path = str(tmp_path / "b.arrow")
+    integrity.write_block(path, b"q" * 128)
+    with open(path + ".eio", "w") as f:
+        f.write("2")
+    retries = []
+    got = integrity.read_block(path, max_retries=3, retry_wait_s=0.001,
+                               on_retry=lambda n, e: retries.append(n))
+    assert got == b"q" * 128
+    assert retries == [1, 2]  # two injected failures burned two retries
+    with open(path + ".eio") as f:
+        assert f.read().strip() == "0"
+
+
+def test_eio_beyond_budget_escalates_as_io(tmp_path):
+    path = str(tmp_path / "b.arrow")
+    integrity.write_block(path, b"q" * 128)
+    with open(path + ".eio", "w") as f:
+        f.write("50")
+    t0 = time.monotonic()
+    with pytest.raises(FetchFailure) as ei:
+        integrity.read_block(path, max_retries=2, retry_wait_s=0.001)
+    assert ei.value.kind == "io"
+    assert time.monotonic() - t0 < 5.0
+
+
+# --- commit protocol + manifest ---------------------------------------------
+
+def test_commit_writes_manifest_and_reads_verify(tmp_path):
+    t = _transport(tmp_path)
+    won, mapout = _commit_mapout(t, parts=(0, 1, 2))
+    assert won
+    manifest = integrity.read_manifest(mapout)
+    assert manifest["task"] == "t0" and manifest["attempt"] == 0
+    assert len(manifest["files"]) == 3
+    for name, meta in manifest["files"].items():
+        p = os.path.join(mapout, name)
+        assert os.path.getsize(p) == meta["size"]
+        integrity.read_block(p, meta)  # verifies crc + footer
+    blocks = integrity.expected_partition_files(os.path.dirname(mapout),
+                                                1, ["t0"])
+    assert [os.path.basename(p) for p, _ in blocks] == ["m00000_p1.arrow"]
+    assert blocks[0][1]["task"] == "t0"
+
+
+def test_manifest_detects_missing_block(tmp_path):
+    t = _transport(tmp_path)
+    _, mapout = _commit_mapout(t, parts=(0, 1))
+    victim = os.path.join(mapout, "m00000_p1.arrow")
+    os.unlink(victim)
+    # enumeration still names the lost block; reading it classifies
+    blocks = integrity.expected_partition_files(os.path.dirname(mapout),
+                                                1, ["t0"])
+    assert len(blocks) == 1
+    with pytest.raises(FetchFailure) as ei:
+        integrity.read_block(*blocks[0])
+    assert ei.value.kind == "missing" and ei.value.map_task == "t0"
+
+
+def test_expected_mapout_dir_gone_is_missing(tmp_path):
+    t = _transport(tmp_path)
+    _, mapout = _commit_mapout(t)
+    import shutil
+    shutil.rmtree(mapout)  # committed-then-lost (executor-loss analog)
+    with pytest.raises(FetchFailure) as ei:
+        integrity.expected_partition_files(os.path.dirname(mapout), 0,
+                                           ["t0"], shuffle_id=1)
+    assert ei.value.kind == "missing" and ei.value.map_task == "t0"
+
+
+def test_torn_manifest_classified(tmp_path):
+    t = _transport(tmp_path)
+    _, mapout = _commit_mapout(t)
+    with open(os.path.join(mapout, integrity.MANIFEST_NAME), "w") as f:
+        f.write('{"task": "t0", "files": {')  # torn commit
+    with pytest.raises(FetchFailure) as ei:
+        integrity.expected_partition_files(os.path.dirname(mapout), 0)
+    assert ei.value.kind == "torn"
+
+
+# --- committed_partition_files edge cases (satellite) ------------------------
+
+def test_staging_dir_invisible_mid_commit(tmp_path):
+    t = _transport(tmp_path)
+    t.register_shuffle(1, 2)
+    staging = t.begin_task_attempt(1, "t0", 0)
+    t._write_rb(1, 0, 0, _rb(), subdir=staging)
+    sdir = t._sdir(1)
+    # before commit: a reader sees NOTHING from the in-flight attempt
+    assert HostShuffleTransport.committed_partition_files(sdir, 0) == []
+    assert integrity.expected_partition_files(sdir, 0) == []
+    t.commit_task_attempt(1, "t0", 0)
+    assert len(HostShuffleTransport.committed_partition_files(sdir, 0)) == 1
+
+
+def test_zombie_commit_after_winner_stays_invisible(tmp_path):
+    t = _transport(tmp_path)
+    t.register_shuffle(1, 2)
+    # the retry (attempt 1) commits first; the zombie attempt 0
+    # finishes later and must atomically lose
+    s1 = t.begin_task_attempt(1, "t0", 1)
+    t._write_rb(1, 0, 0, _rb(seed=10), subdir=s1)
+    assert t.commit_task_attempt(1, "t0", 1)
+    s0 = t.begin_task_attempt(1, "t0", 0)
+    t._write_rb(1, 0, 0, _rb(seed=99), subdir=s0)
+    t._write_rb(1, 0, 1, _rb(seed=98), subdir=s0)
+    assert not t.commit_task_attempt(1, "t0", 0)  # lost the race
+    sdir = t._sdir(1)
+    assert not os.path.exists(s0)  # loser's staging discarded
+    mapouts = [n for n in os.listdir(sdir) if n.endswith(".mapout")]
+    assert mapouts == ["t0.mapout"]
+    # the visible output is the WINNER's (attempt 1 wrote only p0)
+    manifest = integrity.read_manifest(os.path.join(sdir, "t0.mapout"))
+    assert manifest["attempt"] == 1
+    assert integrity.expected_partition_files(sdir, 1, ["t0"]) == []
+
+
+def test_zero_row_map_output_commits_empty_manifest(tmp_path):
+    t = _transport(tmp_path)
+    t.register_shuffle(1, 4)
+    t.begin_task_attempt(1, "t0", 0)
+    assert t.commit_task_attempt(1, "t0", 0)  # no partition had rows
+    sdir = t._sdir(1)
+    manifest = integrity.read_manifest(os.path.join(sdir, "t0.mapout"))
+    assert manifest["files"] == {}
+    for pid in range(4):
+        assert integrity.expected_partition_files(sdir, pid, ["t0"]) == []
+        assert HostShuffleTransport.committed_partition_files(sdir,
+                                                              pid) == []
+
+
+def test_torn_block_inside_committed_dir(tmp_path):
+    t = _transport(tmp_path)
+    _, mapout = _commit_mapout(t, parts=(0,))
+    victim = os.path.join(mapout, "m00000_p0.arrow")
+    with open(victim, "r+b") as f:
+        f.truncate(os.path.getsize(victim) - 3)
+    blocks = integrity.expected_partition_files(os.path.dirname(mapout),
+                                                0, ["t0"])
+    with pytest.raises(FetchFailure) as ei:
+        integrity.read_block(*blocks[0])
+    assert ei.value.kind == "torn"
+
+
+# --- end-to-end read path verifies -------------------------------------------
+
+def test_read_partition_raises_classified_on_corruption(tmp_path):
+    t = _transport(tmp_path)
+    _, mapout = _commit_mapout(t, parts=(0,))
+    victim = os.path.join(mapout, "m00000_p0.arrow")
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as f:
+        f.seek(size // 2)
+        f.write(b"\x00\x01\x02\x03")
+    with pytest.raises(FetchFailure) as ei:
+        list(t.read_partition(1, 0))
+    assert ei.value.kind == "corrupt" and ei.value.map_task == "t0"
+
+
+def test_read_partition_roundtrip_with_footers(tmp_path):
+    from spark_rapids_tpu.columnar.arrow_bridge import device_to_arrow
+    t = _transport(tmp_path)
+    rb = _rb(80, seed=3)
+    _, _ = _commit_mapout(t, parts=(0,), seed=3)
+    got = pa.Table.from_batches(
+        [device_to_arrow(b) for b in t.read_partition(1, 0)])
+    want = pa.Table.from_batches([_rb(seed=3 + 0)])
+    assert got.to_pydict() == want.to_pydict()
+    del rb
+
+
+# --- sticky writer error + cleanup-safe teardown (satellites) ---------------
+
+def _boom():
+    raise OSError(28, "No space left on device")
+
+
+def test_drain_error_sticky_across_reads(tmp_path):
+    t = _transport(tmp_path, threads=2)
+    t.register_shuffle(5, 3)
+    t._submit(5, _boom)
+    t._submit(5, lambda: None)  # later healthy write still drains
+    with pytest.raises(RuntimeError, match="failed async write"):
+        list(t.read_partition(5, 0))
+    # the error is NOT consumed by the first reader: every subsequent
+    # partition read re-raises instead of silently yielding partial data
+    with pytest.raises(RuntimeError, match="failed async write"):
+        list(t.read_partition(5, 1))
+    with pytest.raises(RuntimeError, match="failed async write"):
+        t.commit_task_attempt(5, "t0", 0)
+    # cleanup still happens, and the error surfaces one last time
+    sdir = t._sdir(5)
+    with pytest.raises(RuntimeError, match="failed async write"):
+        t.unregister_shuffle(5)
+    assert not os.path.exists(sdir)
+    # after unregister the shuffle is gone for good: fresh state
+    t.register_shuffle(5, 3)
+    assert list(t.read_partition(5, 0)) == []
+    t.close()
+
+
+def test_close_bounded_behind_wedged_writer(tmp_path, monkeypatch):
+    from spark_rapids_tpu.shuffle import host as host_mod
+    monkeypatch.setattr(host_mod, "_CLOSE_JOIN_S", 0.2)
+    t = _transport(tmp_path, threads=1)
+    t.register_shuffle(1, 1)
+    release = []
+    t._submit(1, lambda: [time.sleep(0.05)
+                          for _ in iter(lambda: not release, False)])
+    t0 = time.monotonic()
+    t.close()  # must not hang behind the wedged writer
+    assert time.monotonic() - t0 < 5.0
+    release.append(True)
+
+
+# --- chaos grammar for the new shuffle-durability modes ----------------------
+
+def test_chaos_parses_durability_modes():
+    rules = chaos.parse_fault_spec(
+        "corrupt:q1s1m0:0; drop:q1s1m1:*; eio:q1s*:0:5@w1")
+    assert [r.mode for r in rules] == ["corrupt", "drop", "eio"]
+    assert rules[1].attempt is None
+    assert rules[2].seconds == 5.0 and rules[2].worker == 1
+    # the pre-run hook must ignore post-commit modes and vice versa
+    assert chaos.find_rule("corrupt:q1s1m0:0", 0, "q1s1m0", 0,
+                           chaos._PRE_MODES) is None
+    assert chaos.find_rule("corrupt:q1s1m0:0", 0, "q1s1m0", 0,
+                           chaos._POST_MODES).mode == "corrupt"
+
+
+def test_chaos_inject_output_modes(tmp_path):
+    t = _transport(tmp_path)
+    _, mapout = _commit_mapout(t, parts=(0, 1))
+    files = sorted(n for n in os.listdir(mapout) if n.endswith(".arrow"))
+    chaos.maybe_inject_output("eio:t0:0:4", 0, "t0", 0, mapout)
+    for n in files:
+        with open(os.path.join(mapout, n + ".eio")) as f:
+            assert f.read() == "4"
+    chaos.maybe_inject_output("corrupt:t0:0", 0, "t0", 0, mapout)
+    with pytest.raises(FetchFailure) as ei:
+        integrity.read_block(os.path.join(mapout, files[0]),
+                             max_retries=10, retry_wait_s=0.001)
+    assert ei.value.kind == "corrupt"  # corrupt, NOT torn: footer intact
+    chaos.maybe_inject_output("drop:t0:0", 0, "t0", 0, mapout)
+    assert not os.path.exists(mapout)
+    # attempt-pinned rules don't fire on other attempts
+    _, mapout = _commit_mapout(t, key="t1")
+    chaos.maybe_inject_output("drop:t1:3", 0, "t1", 0, mapout)
+    assert os.path.exists(mapout)

@@ -18,6 +18,11 @@ Two validators and one driver:
   injected worker crash and tracing DISABLED, assert exactly one valid
   incident bundle is produced, schema-check it, and render the triage
   report — the always-on-forensics CI gate.
+- ``--shuffle-smoke DIR``  run a 2-worker shuffle query whose committed
+  map output is corrupted post-commit (chaos ``corrupt``), assert the
+  query still returns oracle-correct rows via exactly one classified
+  fetch failure + map-stage rerun, validated through the event log and
+  the incident bundle — the shuffle-durability CI gate.
 
 Exit status 0 = all checks passed; failures are listed on stderr.
 """
@@ -254,6 +259,71 @@ def run_flight_smoke(out_dir):
     return bundle
 
 
+def run_shuffle_smoke(out_dir):
+    """Injected post-commit corruption of a map output: the query must
+    return oracle-correct rows through exactly one classified fetch
+    failure and one lineage stage rerun, with the recovery visible in
+    the persisted event log AND the incident bundle. Returns the bundle
+    path (validated by check_flight like any other bundle)."""
+    import pyarrow as pa
+
+    from spark_rapids_tpu.cluster import TpuProcessCluster
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.exec.base import HostBatchSourceExec
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.expr import Alias, UnresolvedColumn as col
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.shuffle.partitioner import HashPartitioning
+    from spark_rapids_tpu.tools.event_log import read_event_logs
+    flight_dir = os.path.join(out_dir, "incidents")
+    log_dir = os.path.join(out_dir, "events")
+    n = 600
+    rbs = [pa.record_batch({"k": [i % 7 for i in range(n)],
+                            "v": list(range(n))}),
+           pa.record_batch({"k": [i % 7 for i in range(n, 2 * n)],
+                            "v": list(range(n, 2 * n))})]
+    src = HostBatchSourceExec(rbs)
+    plan = TpuHashAggregateExec(
+        [col("k")], [Alias(Sum(col("v")), "s")],
+        TpuShuffleExchangeExec(HashPartitioning([col("k")], 4), src))
+    conf = RapidsConf({
+        "spark.rapids.tpu.test.injectFaults": "corrupt:q1s1m0:0",
+        "spark.rapids.flight.dir": flight_dir,
+        "spark.rapids.eventLog.dir": log_dir,
+    })
+    with TpuProcessCluster(n_workers=2, conf=conf) as c:
+        out = c.run_query(plan)
+        sched = c.last_scheduler
+        bundle = c.last_incident_path
+    # oracle: sum(v) per k over both batches
+    want = {}
+    for rb in rbs:
+        for k, v in zip(rb.column(0).to_pylist(),
+                        rb.column(1).to_pylist()):
+            want[k] = want.get(k, 0) + v
+    got = {r["k"]: r["s"] for r in out.to_pylist()}
+    assert got == want, f"rows wrong across corruption: {got} != {want}"
+    ffs = [e for e in sched.events if e["event"] == "fetch_failed"]
+    reruns = [e for e in sched.events if e["event"] == "stage_rerun"]
+    assert len(ffs) == 1 and "[corrupt]" in ffs[0]["reason"], ffs
+    assert len(reruns) == 1, f"expected exactly one stage rerun: {reruns}"
+    # the persisted event log carries the recovery timeline
+    sched_evs = [e for e in read_event_logs(log_dir)
+                 if e.get("type") == "scheduler"]
+    assert sched_evs and sched_evs[-1]["summary"]["stage_reruns"] == 1, \
+        "stage rerun missing from the event log"
+    assert any(a["event"] == "fetch_failed"
+               for e in sched_evs for a in e["attempts"]), \
+        "fetch_failed missing from the event log"
+    # ... and the incident bundle names both
+    assert bundle and os.path.exists(bundle), "no incident bundle"
+    with open(bundle) as f:
+        kinds = {a["kind"] for a in json.load(f)["anomalies"]}
+    assert {"fetch_failed", "stage_rerun"} <= kinds, kinds
+    return bundle
+
+
 def run_smoke(out_dir):
     """One tiny query with tracing + metrics on; returns (trace_path,
     prom_path)."""
@@ -346,9 +416,17 @@ def main(argv=None):
                     help="run an injected-crash cluster query with "
                          "tracing disabled, assert exactly one valid "
                          "incident bundle")
+    ap.add_argument("--shuffle-smoke", metavar="DIR",
+                    dest="shuffle_smoke",
+                    help="run a cluster shuffle query with injected "
+                         "post-commit corruption, assert oracle rows "
+                         "via exactly one map-stage rerun")
     args = ap.parse_args(argv)
     errors = []
-    trace, prom, flight = args.trace, args.prom, args.flight
+    trace, prom = args.trace, args.prom
+    # every bundle produced or named gets schema-checked — a smoke
+    # must not shadow another smoke's (or the user's) bundle
+    flights = [args.flight] if args.flight else []
     if args.smoke:
         os.makedirs(args.smoke, exist_ok=True)
         trace, prom = run_smoke(args.smoke)
@@ -359,15 +437,21 @@ def main(argv=None):
         print(f"scan smoke output: {prom}")
     if args.flight_smoke:
         os.makedirs(args.flight_smoke, exist_ok=True)
-        flight = run_flight_smoke(args.flight_smoke)
-        print(f"flight smoke output: {flight}")
-    if not trace and not prom and not flight:
+        bundle = run_flight_smoke(args.flight_smoke)
+        flights.append(bundle)
+        print(f"flight smoke output: {bundle}")
+    if args.shuffle_smoke:
+        os.makedirs(args.shuffle_smoke, exist_ok=True)
+        bundle = run_shuffle_smoke(args.shuffle_smoke)
+        flights.append(bundle)
+        print(f"shuffle smoke output: {bundle}")
+    if not trace and not prom and not flights:
         ap.error("nothing to do: pass --trace/--prom/--smoke/"
-                 "--scan-smoke/--flight/--flight-smoke")
+                 "--scan-smoke/--flight/--flight-smoke/--shuffle-smoke")
     if trace:
         errors += [f"[trace] {e}" for e in check_trace(trace)]
-    if flight:
-        errors += [f"[flight] {e}" for e in check_flight(flight)]
+    for fl in flights:
+        errors += [f"[flight] {e}" for e in check_flight(fl)]
     if prom:
         try:
             with open(prom) as f:

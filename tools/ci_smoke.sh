@@ -23,26 +23,29 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/6 compileall =="
+echo "== 1/7 compileall =="
 python -m compileall -q spark_rapids_tpu tests
 
-echo "== 2/6 package import =="
+echo "== 2/7 package import =="
 JAX_PLATFORMS=cpu python -c "import spark_rapids_tpu; print('import ok:', spark_rapids_tpu.__name__)"
 
-echo "== 3/6 pytest collection =="
+echo "== 3/7 pytest collection =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q --collect-only -m 'not slow' \
     -p no:cacheprovider 2>&1 | tail -3
 
-echo "== 4/6 observability smoke =="
+echo "== 4/7 observability smoke =="
 OBS_TMP="$(mktemp -d)"
 trap 'rm -rf "$OBS_TMP"' EXIT
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --smoke "$OBS_TMP"
 
-echo "== 5/6 device-decode scan smoke =="
+echo "== 5/7 device-decode scan smoke =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --scan-smoke "$OBS_TMP/scan"
 
-echo "== 6/6 flight-recorder smoke =="
+echo "== 6/7 flight-recorder smoke =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --flight-smoke "$OBS_TMP/flight"
+
+echo "== 7/7 shuffle-durability smoke =="
+JAX_PLATFORMS=cpu python tools/check_obs_output.py --shuffle-smoke "$OBS_TMP/shuffle"
 
 if [[ "${1:-}" == "--full" ]]; then
     echo "== tier-1 (full) =="
