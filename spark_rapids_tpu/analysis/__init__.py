@@ -1,0 +1,22 @@
+"""Static analysis for the runtime: pre-execution plan verification
+(plan_verifier.py) and the tpu-lint AST rule engine over the package
+itself (lint.py). See also tools/tpu_lint.py for the CLI.
+
+Re-exports are lazy so ``python -m
+spark_rapids_tpu.analysis.plan_verifier`` does not import the
+submodule twice (runpy warns when the package eagerly imports what -m
+is about to execute)."""
+
+__all__ = ["PlanVerificationError", "PlanVerifier", "VerifyReport",
+           "verify_plan", "lint_package", "lint_paths"]
+
+
+def __getattr__(name):
+    if name in ("PlanVerificationError", "PlanVerifier", "VerifyReport",
+                "verify_plan"):
+        from . import plan_verifier
+        return getattr(plan_verifier, name)
+    if name in ("lint_package", "lint_paths"):
+        from . import lint
+        return getattr(lint, name)
+    raise AttributeError(name)

@@ -487,7 +487,7 @@ def worker_main(root: str, worker_id: int, poll_s: float = 0.02,
                 os.replace(err + ".tmp", err)
             ran = True
         if not ran:
-            time.sleep(poll_s)
+            time.sleep(poll_s)  # tpu-lint: allow[blocking-call-in-thread] rendezvous poll on the worker main loop; the driver kills wedged workers
 
 
 class _WorkerPool:
@@ -709,7 +709,11 @@ class TpuProcessCluster:
         sched = TaskScheduler(self.pool, os.path.join(self.root, "tasks"),
                               conf, query_id=f"q{qid}", tracer=tracer)
         self.last_scheduler = sched
+        self._verify_plan(plan, conf, qid, sched)
+        # wall stamp filters ring events (their ts is wall clock); the
+        # duration below runs on monotonic so a clock step can't skew it
         t0 = time.time()
+        t0_mono = time.monotonic()
         try:
             args = None
             if tracer.enabled:  # tree-walk + sha1 only when traced
@@ -731,7 +735,7 @@ class TpuProcessCluster:
                     pass  # observability must never fail the query
             from .tools.event_log import log_scheduler_events
             log_scheduler_events(conf, f"q{qid}", sched,
-                                 time.time() - t0)
+                                 time.monotonic() - t0_mono)
             # flight recorder: when anything anomalous happened this
             # query (failed attempts, worker deaths, stragglers, or a
             # worker committed a flight dump), harvest every process's
@@ -741,6 +745,35 @@ class TpuProcessCluster:
                 self._maybe_write_incident(conf, qid, sched, tracer, t0)
             except Exception:  # noqa: BLE001 — forensics must never
                 pass           # fail (or mask) the query itself
+
+    def _verify_plan(self, plan: TpuExec, conf: RapidsConf, qid: int,
+                     sched: TaskScheduler) -> None:
+        """Static contract pass before any task is scheduled
+        (spark.rapids.sql.verifyPlan, analysis/plan_verifier.py). A
+        rejection emits a ``plan_rejected`` scheduler event (an anomaly
+        kind, so the incident-bundle harvest fires and `profiling
+        triage` shows why the query never ran) plus the event-log and
+        flight-recorder entries, then raises."""
+        from .config import VERIFY_PLAN
+        if not conf.get(VERIFY_PLAN):
+            return
+        from .analysis.plan_verifier import (PlanVerificationError,
+                                             report_rejection,
+                                             verify_plan)
+        report = verify_plan(plan, conf)
+        if report.ok:
+            return
+        sched._event("plan_rejected", reason=report.summary()[:500])
+        report_rejection(conf, report, plan, query_id=f"q{qid}")
+        try:
+            # wall window bound for ring-event filtering (events carry
+            # wall ts), not a duration
+            since = time.time() - 1.0  # tpu-lint: allow[wallclock-duration] wall-ts window bound, not a duration
+            self._maybe_write_incident(conf, qid, sched, NULL_TRACER,
+                                       since)
+        except Exception:  # noqa: BLE001 — forensics must never mask
+            pass           # the rejection itself
+        raise PlanVerificationError(report)
 
     def _maybe_write_incident(self, conf: RapidsConf, qid: int,
                               sched: TaskScheduler, tracer,

@@ -99,6 +99,16 @@ SESSION_TZ = register(
     "Session time zone; the TPU path supports UTC only (like early "
     "spark-rapids), other zones fall back per-expression.")
 
+VERIFY_PLAN = register(
+    "spark.rapids.sql.verifyPlan", True,
+    "Static plan verification before execution: every physical plan is "
+    "checked bottom-up against the operators' declared contracts "
+    "(child/output schema and dtype agreement, nullability "
+    "propagation, exchange co-partitioning, AQE-wrapper "
+    "well-formedness, a static HBM footprint estimate vs the memory "
+    "ledger budget) and rejected with a named reason instead of "
+    "failing mid-query. See spark_rapids_tpu/analysis/plan_verifier.py.")
+
 STAGE_FUSION = register(
     "spark.rapids.sql.stageFusion.enabled", True,
     "Compose chains of per-batch operators (project/filter/aggregate "

@@ -96,6 +96,11 @@ class TpuHashAggregateExec(UnaryExec):
     def output_schema(self):
         return self._schema
 
+    def resident_footprint(self):
+        # collect_* / exact-percentile aggregates concatenate the whole
+        # input on device before the single-pass group sort
+        return any(getattr(a, "single_pass", False) for a in self.aggs)
+
     def describe(self):
         g = ", ".join(map(repr, self.group_exprs))
         a = ", ".join(f"{type(x).__name__.lower()}({', '.join(map(repr, x.children))})"

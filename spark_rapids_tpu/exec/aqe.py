@@ -27,7 +27,7 @@ from typing import List, Optional
 from ..config import (ADAPTIVE_ADVISORY_BYTES, ADAPTIVE_COALESCE,
                       ADAPTIVE_FREE_STATS, ADAPTIVE_SKEW_FACTOR,
                       ADAPTIVE_SKEW_THRESHOLD, AUTO_BROADCAST_THRESHOLD)
-from .base import ExecCtx, LeafExec, TpuExec, UnaryExec
+from .base import ExecCtx, LeafExec, OpContract, TpuExec, UnaryExec
 from .exchange import TpuShuffleExchangeExec
 
 __all__ = ["TpuAQEShuffleReadExec", "TpuAQEJoinExec",
@@ -73,6 +73,12 @@ class TpuAQEShuffleReadExec(UnaryExec):
     Inserted by the planner when spark.sql.adaptive.enabled; transparent
     to the CPU oracle (partition boundaries carry no row semantics for
     the single downstream consumer)."""
+
+    CONTRACT = OpContract(
+        schema_preserving=True,
+        wrapper_over="TpuShuffleExchangeExec",
+        notes="planner-inserted adaptive reader; only valid directly "
+              "over a shuffle exchange")
 
     def __init__(self, child: TpuShuffleExchangeExec):
         super().__init__(child)
@@ -187,6 +193,12 @@ class TpuAQEJoinExec(UnaryExec):
     key binding is schema-based and both strategies share the join
     core, mirroring how GpuShuffledHashJoinExec/GpuBroadcastHashJoinExec
     share GpuHashJoin."""
+
+    CONTRACT = OpContract(
+        schema_preserving=True,
+        wrapper_over="TpuShuffledHashJoinExec",
+        notes="planner-inserted runtime join-strategy switch; only "
+              "valid directly over a shuffled hash join")
 
     def __init__(self, join):
         super().__init__(join)

@@ -17,6 +17,7 @@ reference's GpuMetric surface (SURVEY.md §5.1).
 """
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -29,8 +30,51 @@ from ..config import RapidsConf
 from ..expr.base import EvalCtx
 
 __all__ = ["ExecCtx", "TpuMetric", "TpuExec", "LeafExec", "UnaryExec",
-           "HostBatchSourceExec", "collect_arrow", "collect_arrow_cpu",
-           "fused_batches"]
+           "HostBatchSourceExec", "OpContract", "collect_arrow",
+           "collect_arrow_cpu", "fused_batches"]
+
+
+@dataclasses.dataclass(frozen=True)
+class OpContract:
+    """Static operator contract — the single source of truth the plan
+    verifier (analysis/plan_verifier.py) checks before execution and the
+    SUPPORTED_OPS.md generator renders. Every `TpuExec` subclass either
+    inherits the permissive default or declares its invariants here;
+    checks that need per-instance data (derived output schemas, bound
+    expression inputs) live on the instance hooks below
+    (`expected_output_schema`, `expr_bindings`, `resident_footprint`).
+    """
+
+    #: output schema must equal the (first) child's, field for field —
+    #: names, dtypes, and nullability may only widen, never narrow.
+    schema_preserving: bool = False
+    #: the operator materializes its whole input device-resident at
+    #: once with no out-of-core path (broadcast gather, single-pass
+    #: aggregates) — the verifier checks its static byte estimate
+    #: against the memory-ledger budget.
+    resident_footprint: bool = False
+    #: children that are both shuffle exchanges must agree on
+    #: partitioning scheme and partition count (hash-join
+    #: co-partitioning).
+    requires_copartition: bool = False
+    #: planner-inserted wrapper: the child must be an instance of the
+    #: named class (checked by class name to avoid import cycles).
+    wrapper_over: Optional[str] = None
+    #: one-line contract note rendered into SUPPORTED_OPS.md.
+    notes: str = ""
+
+    def doc_flags(self) -> str:
+        """Compact rendering for the generated supported-ops doc."""
+        flags = []
+        if self.schema_preserving:
+            flags.append("schema-preserving")
+        if self.resident_footprint:
+            flags.append("resident-footprint")
+        if self.requires_copartition:
+            flags.append("co-partitioned children")
+        if self.wrapper_over:
+            flags.append(f"wraps {self.wrapper_over}")
+        return ", ".join(flags)
 
 
 class TpuMetric:
@@ -171,6 +215,52 @@ class TpuExec:
     # --- planner hooks ----------------------------------------------------
     def tpu_supported(self) -> Optional[str]:
         """None if runnable on TPU, else the willNotWorkOnTpu reason."""
+        return None
+
+    # --- static contract (plan verifier + SUPPORTED_OPS.md) ---------------
+    #: class-level operator contract; subclasses override with their
+    #: invariants. The plan verifier and the doc generator both read
+    #: this, so the doc can never drift from what is enforced.
+    CONTRACT: "OpContract" = OpContract()
+
+    @classmethod
+    def contract(cls) -> "OpContract":
+        return cls.CONTRACT
+
+    def expected_output_schema(self) -> Optional[dt.Schema]:
+        """Re-derive the output schema from the CURRENT children, for
+        operators whose cached schema depends on child state (join,
+        union, window override this). The verifier compares it against
+        the declared `output_schema` — a mismatch means the tree was
+        rebuilt over children the cached schema no longer describes.
+        None = not re-derivable; operators whose schema is a pure
+        function of their own bound expressions (project, aggregate)
+        stay None — their stale-rebuild class is caught by the
+        `expr_bindings` ordinal/dtype checks instead."""
+        return None
+
+    def expr_bindings(self) -> Sequence[Tuple[object, dt.Schema]]:
+        """(expression tree, input schema) pairs: which schema each of
+        this operator's bound expressions must resolve against. The
+        verifier checks every BoundReference's ordinal/dtype/nullability
+        against that schema. Default: all `expressions()` bind against
+        the first child (joins and other multi-input ops override)."""
+        if not self.children:
+            return ()
+        schema = self.children[0].output_schema
+        return [(e, schema) for e in self.expressions()]
+
+    def resident_footprint(self) -> bool:
+        """Instance-level override of CONTRACT.resident_footprint for
+        operators whose residency depends on configuration (e.g. an
+        aggregate is resident only when a single-pass aggregate
+        function is present)."""
+        return self.contract().resident_footprint
+
+    def static_bytes_estimate(self) -> Optional[int]:
+        """Leaf-source byte estimate for the verifier's HBM footprint
+        pass (host batches: exact; file scans: file sizes; None =
+        unknown)."""
         return None
 
     def device_fn(self):
@@ -322,6 +412,9 @@ class HostBatchSourceExec(LeafExec):
     def output_schema(self):
         return self._schema
 
+    def static_bytes_estimate(self):
+        return sum(rb.nbytes for rb in self.batches)
+
     def _normalized(self):
         """Input batches cast (checked) to the declared schema, so the
         device and CPU paths see identical values."""
@@ -362,6 +455,12 @@ class DeviceBatchSourceExec(LeafExec):
     @property
     def output_schema(self):
         return self._schema
+
+    def static_bytes_estimate(self):
+        try:
+            return sum(b.device_size_bytes() for b in self.batches)
+        except Exception:  # noqa: BLE001 — estimate only, never fail
+            return None
 
     def execute(self, ctx):
         yield from self.batches

@@ -20,7 +20,8 @@ from .. import datatypes as dt
 from ..columnar.batch import TpuBatch, bucket_rows
 from ..columnar.column import TpuColumnVector
 from ..expr.base import Alias, Expression, bind_expr
-from .base import ExecCtx, LeafExec, TpuExec, UnaryExec, fused_batches
+from .base import (ExecCtx, LeafExec, OpContract, TpuExec, UnaryExec,
+                   fused_batches)
 
 __all__ = ["TpuProjectExec", "TpuFilterExec", "TpuRangeExec",
            "output_schema_for", "bind_all"]
@@ -83,6 +84,11 @@ class TpuProjectExec(UnaryExec):
 class TpuFilterExec(UnaryExec):
     """Boolean-mask filter + stream compaction (GpuFilterExec analog)."""
 
+    CONTRACT = OpContract(
+        schema_preserving=True,
+        notes="output rows are a subset of the input; schema passes "
+              "through unchanged")
+
     def __init__(self, condition: Expression, child: TpuExec):
         super().__init__(child)
         self.condition = bind_expr(condition, child.output_schema)
@@ -142,6 +148,9 @@ class TpuRangeExec(LeafExec):
     @property
     def output_schema(self):
         return self._schema
+
+    def static_bytes_estimate(self):
+        return self.num_rows * 8
 
     @property
     def num_rows(self) -> int:

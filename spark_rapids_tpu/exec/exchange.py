@@ -23,7 +23,7 @@ from ..columnar.batch import TpuBatch
 from ..ops.concat import concat_batches
 from ..shuffle.partitioner import Partitioning, SinglePartitioning
 from ..shuffle.transport import LocalShuffleTransport, ShuffleTransport
-from .base import ExecCtx, TpuExec, UnaryExec
+from .base import ExecCtx, OpContract, TpuExec, UnaryExec
 
 __all__ = ["TpuShuffleExchangeExec", "TpuBroadcastExchangeExec",
            "TpuCoalesceBatchesExec", "ShuffleStageHandle"]
@@ -83,6 +83,10 @@ class TpuShuffleExchangeExec(UnaryExec):
     """Repartition child output by a Partitioning strategy. Output batches
     arrive partition-major (partition 0's batches first), map-order within
     a partition — deterministic for the dual-run harness."""
+
+    CONTRACT = OpContract(
+        schema_preserving=True,
+        notes="repartitions rows; partition keys must be primitive")
 
     def __init__(self, partitioning: Partitioning, child: TpuExec,
                  transport: Optional[ShuffleTransport] = None):
@@ -304,6 +308,11 @@ class TpuBroadcastExchangeExec(UnaryExec):
     payload is registered in the spill catalog so an idle broadcast
     yields its HBM under pressure and re-uploads on next use."""
 
+    CONTRACT = OpContract(
+        schema_preserving=True, resident_footprint=True,
+        notes="materializes the whole child device-resident as the "
+              "build-side table")
+
     def __init__(self, child: TpuExec, mesh=None, axis: str = "x"):
         super().__init__(child)
         self.mesh = mesh
@@ -362,6 +371,10 @@ class TpuCoalesceBatchesExec(UnaryExec):
     """Concatenate small batches up to a target row count
     (GpuCoalesceBatches analog; target bytes logic arrives with the
     memory manager)."""
+
+    CONTRACT = OpContract(
+        schema_preserving=True,
+        notes="concatenates small batches; row values unchanged")
 
     def __init__(self, child: TpuExec, target_rows: int = 1 << 17):
         super().__init__(child)

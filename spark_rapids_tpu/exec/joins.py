@@ -33,7 +33,7 @@ from ..ops.join import (JOIN_TYPES, join_counts, join_gather, join_indices,
                         join_output_bytes, join_total, probe_unique,
                         unique_build_analysis, unique_build_probe,
                         unique_union_lookup)
-from .base import ExecCtx, TpuExec
+from .base import ExecCtx, OpContract, TpuExec
 from .basic import bind_all
 
 # join types the unique-build fast path serves (each live stream row
@@ -138,6 +138,24 @@ class _BaseJoinExec(TpuExec):
         out = list(self.left_keys) + list(self.right_keys)
         if self.condition is not None:
             out.append(self.condition)
+        return out
+
+    def expected_output_schema(self):
+        return _join_output_schema(self.left.output_schema,
+                                   self.right.output_schema,
+                                   self.join_type)
+
+    def expr_bindings(self):
+        # left keys bind against the left child, right keys against the
+        # right child, the extra condition against both sides' columns
+        out = [(k, self.left.output_schema) for k in self.left_keys]
+        out += [(k, self.right.output_schema) for k in self.right_keys]
+        if self.condition is not None:
+            # rebuilt from the CURRENT children (not the cached
+            # _cond_schema): the check must see what the tree is now
+            cond = dt.Schema(list(self.left.output_schema.fields)
+                             + list(self.right.output_schema.fields))
+            out.append((self.condition, cond))
         return out
 
     def describe(self):
@@ -634,6 +652,12 @@ class _BaseJoinExec(TpuExec):
 
 class TpuShuffledHashJoinExec(_BaseJoinExec):
     """Local equi-join core (both sides materialized on this chip)."""
+
+    CONTRACT = OpContract(
+        requires_copartition=True,
+        notes="children that are both shuffle exchanges must agree on "
+              "partitioning scheme and partition count; join keys must "
+              "be primitive")
 
 
 class TpuBroadcastHashJoinExec(_BaseJoinExec):

@@ -50,6 +50,25 @@ class TpuUnionExec(TpuExec):
     def output_schema(self):
         return self._schema
 
+    def expected_output_schema(self):
+        # width/type agreement FIRST: the nullability any() below would
+        # otherwise short-circuit on a nullable first-child field and
+        # never index (i.e. never notice) a narrower rebuilt child. A
+        # raise here surfaces as a named schema_mismatch rejection (the
+        # verifier guards derivation hooks).
+        first = self.children[0].output_schema
+        for c in self.children[1:]:
+            if c.output_schema.types != first.types:
+                raise TypeError(
+                    f"union children schemas differ: {first.types} vs "
+                    f"{c.output_schema.types}")
+        return dt.Schema([
+            dt.StructField(
+                f.name, f.dtype,
+                any(c.output_schema.fields[i].nullable
+                    for c in self.children))
+            for i, f in enumerate(first.fields)])
+
     def execute(self, ctx: ExecCtx):
         for c in self.children:
             yield from c.execute(ctx)

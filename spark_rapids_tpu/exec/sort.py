@@ -23,7 +23,7 @@ from ..expr.base import Expression, bind_expr
 from ..ops.concat import concat_batches
 from ..ops.gather import gather_batch
 from ..ops.sort_keys import SortSpec, sort_permutation
-from .base import ExecCtx, TpuExec, UnaryExec
+from .base import ExecCtx, OpContract, TpuExec, UnaryExec
 
 __all__ = ["SortOrder", "TpuSortExec", "TpuLocalLimitExec",
            "TpuGlobalLimitExec", "TpuTopNExec", "sort_batch_by",
@@ -113,6 +113,10 @@ def cpu_sort_table(table: pa.Table, key_arrays: List[pa.Array],
 
 class TpuSortExec(UnaryExec):
     """Total or per-batch sort (GpuSortExec analog)."""
+
+    CONTRACT = OpContract(
+        schema_preserving=True,
+        notes="reorders rows only; sort keys must be primitive")
 
     def __init__(self, orders: Sequence[SortOrder], child: TpuExec,
                  global_sort: bool = True):
@@ -311,6 +315,9 @@ class TpuSortExec(UnaryExec):
 class TpuLocalLimitExec(UnaryExec):
     """Per-stream limit (GpuLocalLimitExec analog): truncates row_count;
     contents past the limit become padding."""
+
+    CONTRACT = OpContract(schema_preserving=True,
+                          notes="truncates the stream; schema unchanged")
 
     _SYNC_EVERY = 8
 

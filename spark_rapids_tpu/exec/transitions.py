@@ -14,7 +14,7 @@ from __future__ import annotations
 import time
 
 from ..columnar.arrow_bridge import arrow_to_device, device_to_arrow
-from .base import ExecCtx, TpuExec, UnaryExec
+from .base import ExecCtx, OpContract, TpuExec, UnaryExec
 
 __all__ = ["DeviceToHostExec", "HostToDeviceExec"]
 
@@ -22,6 +22,9 @@ __all__ = ["DeviceToHostExec", "HostToDeviceExec"]
 class DeviceToHostExec(UnaryExec):
     """Bridge a device child into a CPU island: ``execute_cpu`` downloads
     the child's device batches as Arrow (GpuColumnarToRowExec analog)."""
+
+    CONTRACT = OpContract(schema_preserving=True,
+                          notes="device->host transition; values unchanged")
 
     def execute(self, ctx: ExecCtx):
         # the planner places this node under CPU parents only; a device
@@ -44,6 +47,9 @@ class DeviceToHostExec(UnaryExec):
 class HostToDeviceExec(UnaryExec):
     """Bridge a CPU-island child back onto the device: ``execute`` uploads
     the child's Arrow batches (GpuRowToColumnarExec analog)."""
+
+    CONTRACT = OpContract(schema_preserving=True,
+                          notes="host->device transition; values unchanged")
 
     def execute(self, ctx: ExecCtx):
         t = ctx.metric(self, "uploadTime")

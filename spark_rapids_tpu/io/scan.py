@@ -490,6 +490,13 @@ class TpuFileScanExec(LeafExec):
     def output_schema(self):
         return self._schema
 
+    def static_bytes_estimate(self):
+        import os
+        try:
+            return sum(os.path.getsize(p) for p in self.paths)
+        except OSError:
+            return None
+
     def describe(self):
         return (f"FileScanExec [{self.fmt} x{len(self.paths)}"
                 + (f" pushdown={self._conjuncts}" if self._conjuncts else "")

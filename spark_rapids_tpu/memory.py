@@ -33,7 +33,7 @@ from .obs.metrics import REGISTRY as _METRICS
 from .obs.recorder import RECORDER as _FLIGHT
 
 __all__ = ["DeviceMemoryManager", "SpillableBatch", "TpuRetryOOM",
-           "split_batch"]
+           "resolve_device_budget", "split_batch"]
 
 DEVICE_BUDGET = register(
     "spark.rapids.memory.device.budgetBytes", 0,
@@ -69,6 +69,20 @@ _MEM_OOM_RETRIES = _METRICS.counter(
 
 class TpuRetryOOM(RuntimeError):
     """Device OOM surfaced to the retry framework (GpuRetryOOM analog)."""
+
+
+def resolve_device_budget(conf: Optional[RapidsConf] = None) -> int:
+    """The HBM byte budget the spillable-batch ledger enforces —
+    spark.rapids.memory.device.budgetBytes, or allocFraction x the
+    device's reported memory (6GiB fallback) when unset. Factored out
+    so the static plan verifier checks footprint estimates against the
+    SAME number the runtime ledger evicts against."""
+    conf = conf or RapidsConf()
+    budget = conf.get(DEVICE_BUDGET)
+    if not budget:
+        budget = int(DeviceMemoryManager._device_memory()
+                     * conf.get(ALLOC_FRACTION))
+    return budget
 
 
 def _is_oom_error(e: BaseException) -> bool:
@@ -337,11 +351,7 @@ class DeviceMemoryManager:
 
     def __init__(self, conf: Optional[RapidsConf] = None):
         self.conf = conf or RapidsConf()
-        budget = self.conf.get(DEVICE_BUDGET)
-        if not budget:
-            budget = int(self._device_memory()
-                         * self.conf.get(ALLOC_FRACTION))
-        self.budget = budget
+        self.budget = resolve_device_budget(self.conf)
         self._lock = threading.RLock()
         self._catalog: "OrderedDict[int, SpillableBatch]" = OrderedDict()
         self._pin_counts: dict = {}  # id -> refcount (shared consumers)

@@ -42,8 +42,11 @@ __all__ = ["AnomalyDetector", "anomalies_from_scheduler",
 # fetch_failed / stage_rerun: a committed-then-lost or corrupt shuffle
 # block and its lineage recovery — the query may still succeed, but
 # durability loss is exactly what a flight recorder exists to explain.
+# plan_rejected: the static verifier refused to run the plan — the
+# bundle is how triage answers "why did this query never start".
 _SCHED_ANOMALIES = ("task_failed", "worker_respawn", "worker_blacklisted",
-                    "straggler_detected", "fetch_failed", "stage_rerun")
+                    "straggler_detected", "fetch_failed", "stage_rerun",
+                    "plan_rejected")
 
 
 class AnomalyDetector:

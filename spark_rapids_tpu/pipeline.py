@@ -88,6 +88,7 @@ def pipelined_map(fn: Callable[[T], R], items: Iterable[T],
             if kind == _ERR:
                 raise val
             try:
+                # tpu-lint: allow[blocking-call-in-thread] consumer side: must re-raise worker exceptions; bounded by the in-flight window + pool shutdown in finally
                 result = val.result()  # re-raises worker exceptions
             finally:
                 slots.release()

@@ -308,6 +308,22 @@ class TpuOverrides:
             return TpuAQEShuffleReadExec(built)
         return built
 
+    def _verify(self, root: TpuExec) -> None:
+        """Static contract pass over the REBUILT tree (transitions and
+        AQE wrappers included) — on by default, fail-fast: a plan that
+        violates an operator contract is rejected with a named reason
+        before any kernel runs (analysis/plan_verifier.py)."""
+        from .config import VERIFY_PLAN
+        if not self.conf.get(VERIFY_PLAN):
+            return
+        from .analysis.plan_verifier import (PlanVerificationError,
+                                             report_rejection,
+                                             verify_plan)
+        report = verify_plan(root, self.conf)
+        if not report.ok:
+            report_rejection(self.conf, report, root)
+            raise PlanVerificationError(report)
+
     def _maybe_aqe_join(self, meta: NodeMeta, built: TpuExec) -> TpuExec:
         """With AQE: wrap device-side shuffled hash joins over exchange
         children in the runtime strategy switch (shuffled -> broadcast
@@ -328,6 +344,7 @@ class TpuOverrides:
         meta = self._wrap(plan)
         self._tag(meta)
         root = self._convert(meta)
+        self._verify(root)
         pp = PhysicalPlan(root, meta.on_device, meta, self.conf)
         # flight-recorder tap: an incident bundle wants to know what
         # fell back to CPU and why without re-planning — one bounded

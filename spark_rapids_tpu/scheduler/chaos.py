@@ -137,6 +137,7 @@ def maybe_inject(spec: str, worker_id: int, task_id: str, attempt: int,
     if rule is None:
         return
     if rule.mode == "crash":
+        # tpu-lint: allow[exit-without-flush] crash chaos SIMULATES a flushless death; the worker loop flushed the ring at task claim
         os._exit(13)
     if rule.mode == "hang":
         # a real wedge (native call holding the GIL) starves the
@@ -145,7 +146,8 @@ def maybe_inject(spec: str, worker_id: int, task_id: str, attempt: int,
             heartbeat.suspend()
         time.sleep(hang_bound_s if hang_bound_s is not None
                    else _DEFAULT_HANG_BOUND_S)
-        os._exit(14)  # the driver should have killed us long ago
+        # tpu-lint: allow[exit-without-flush] hang self-destruct: ring was flushed at task claim; the driver should have killed us long ago
+        os._exit(14)
     if rule.mode == "delay":
         time.sleep(rule.seconds)
 

@@ -17,7 +17,8 @@ import pyarrow as pa
 
 from . import datatypes as dt
 from .config import (CASE_SENSITIVE, RapidsConf, SHUFFLE_PARTITIONS)
-from .exec.base import ExecCtx, HostBatchSourceExec, TpuExec, UnaryExec
+from .exec.base import (ExecCtx, HostBatchSourceExec, OpContract,
+                        TpuExec, UnaryExec)
 from .expr.base import Expression, bind_expr
 from .expr import UnresolvedColumn
 
@@ -30,6 +31,10 @@ class TpuCacheExec(UnaryExec):
     GpuDataFrame cache / InMemoryTableScan analog, SURVEY.md §2.2-B
     "DataFrame cache"). Spill pressure tiers cached batches device ->
     host -> disk like any catalog entry."""
+
+    CONTRACT = OpContract(
+        schema_preserving=True,
+        notes="materializes once into the spill catalog and replays")
 
     def __init__(self, child: TpuExec):
         super().__init__(child)

@@ -324,6 +324,7 @@ class HostShuffleTransport(ShuffleTransport):
         first: Optional[BaseException] = None
         for f in futs:
             try:
+                # tpu-lint: allow[blocking-call-in-thread] drain must settle EVERY outstanding write; close() bounds a wedged writer separately
                 f.result()
             except BaseException as e:  # noqa: BLE001 — writer errors
                 if first is None:      # of any type must reach readers

@@ -10,8 +10,7 @@ package (the dead-conf check VERDICT r1/r2 asked for).
 from __future__ import annotations
 
 import inspect
-import os
-from typing import Dict, List, Tuple
+from typing import Dict, List
 
 __all__ = ["generate_supported_ops", "validate_configs"]
 
@@ -51,11 +50,26 @@ def _first_line(doc) -> str:
     return doc.strip().splitlines()[0]
 
 
+def _contract_cell(cls) -> str:
+    """Render the class's OpContract (the same object the static plan
+    verifier enforces) for the doc table."""
+    try:
+        c = cls.contract()
+    except Exception:  # noqa: BLE001 — doc generation must not fail
+        return ""
+    flags = c.doc_flags()
+    if c.notes:
+        flags = f"{flags}; {c.notes}" if flags else c.notes
+    return flags
+
+
 def generate_supported_ops() -> str:
     """Markdown tables of every physical operator and expression the
     engine registers, with their device-support caveats (the classes'
     own tpu_supported hooks are the runtime truth; the static notes here
-    come from their docs)."""
+    come from their docs) and their declared operator contracts (the
+    same `OpContract` objects the pre-execution plan verifier
+    enforces)."""
     lines = ["# Supported operators and expressions",
              "",
              "Generated from the live registry by "
@@ -63,12 +77,18 @@ def generate_supported_ops() -> str:
              "per-instance eligibility is decided at plan time by each "
              "node's `tpu_supported()` and the "
              "`spark.rapids.sql.exec.<Name>` / `.expression.<Name>` "
-             "kill switches.",
+             "kill switches. The Contract column is rendered from each "
+             "operator's declared `OpContract` — the SAME source of "
+             "truth the static plan verifier "
+             "(`spark_rapids_tpu/analysis/plan_verifier.py`, "
+             "`spark.rapids.sql.verifyPlan`) checks before execution, "
+             "so this doc and the verifier cannot drift apart.",
              "", "## Physical operators", "",
-             "| Operator | Notes |", "|---|---|"]
+             "| Operator | Notes | Contract |", "|---|---|---|"]
     for cls in sorted(_exec_classes(), key=lambda c: c.__name__):
         note = _first_line(cls.__doc__)
-        lines.append(f"| {cls.__name__} | {note} |")
+        lines.append(f"| {cls.__name__} | {note} | "
+                     f"{_contract_cell(cls)} |")
     lines += ["", "## Expressions", "", "| Expression | Notes |",
               "|---|---|"]
     for cls in sorted(_expr_classes(), key=lambda c: c.__name__):
@@ -92,40 +112,11 @@ def generate_supported_ops() -> str:
 
 
 def validate_configs() -> Dict[str, List[str]]:
-    """{'unused': [conf keys registered but never read outside
-    config.py], 'count': ...} — the honesty check for dead config
-    surface (VERDICT r2 weak #6)."""
-    from .. import config as C
-    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    sources = []
-    config_src = ""
-    for root, _, files in os.walk(pkg_dir):
-        for f in files:
-            if f.endswith(".py") and f != "config.py":
-                with open(os.path.join(root, f)) as fh:
-                    sources.append(fh.read())
-            elif f == "config.py":
-                with open(os.path.join(root, f)) as fh:
-                    config_src = fh.read()
-    blob = "\n".join(sources)
-    # confs consumed via derived properties INSIDE config.py (e.g.
-    # RapidsConf.ansi reads ANSI_ENABLED) count as consumed
-    for line in config_src.splitlines():
-        if ".get(" in line or "self._settings" in line:
-            blob += "\n" + line
-    unused: List[str] = []
-    names: List[Tuple[str, str]] = []
-    for attr in dir(C):
-        entry = getattr(C, attr)
-        key = getattr(entry, "key", None)
-        if key is None and isinstance(entry, str) \
-                and entry.startswith("spark."):
-            key, entry = entry, None
-        if isinstance(key, str) and key.startswith("spark."):
-            names.append((attr, key))
-    for attr, key in names:
-        # consumed if the ConfEntry attribute or the literal key appears
-        # anywhere outside config.py
-        if attr not in blob and key not in blob:
-            unused.append(key)
-    return {"checked": [k for _, k in names], "unused": unused}
+    """Dead/unregistered conf audit — delegates to the AST-exact rule
+    in `analysis/lint.py::conf_key_report` (the old substring scan
+    counted a key mentioned in a docstring as consumed; the AST form
+    counts only real name references and call-argument literals).
+    Returns {'checked': [keys], 'unused': [keys],
+    'unregistered_reads': [{key,path,line}]}."""
+    from ..analysis.lint import conf_key_report
+    return conf_key_report()

@@ -23,7 +23,7 @@ import time
 from typing import Dict, Iterator, List, Optional
 
 __all__ = ["EVENT_LOG_DIR", "log_query_event", "log_scheduler_events",
-           "read_event_logs", "plan_fingerprint"]
+           "log_plan_rejected", "read_event_logs", "plan_fingerprint"]
 
 from ..config import register
 
@@ -104,6 +104,27 @@ def log_query_event(pp, ctx, wall_s: float) -> None:
     with open(_app_path(base), "a") as f:
         f.write(json.dumps(event) + "\n")
     _prune_event_logs(pp.conf, base)
+
+
+def log_plan_rejected(conf, report, root, query_id: str = "") -> None:
+    """Append one plan_rejected event: the static verifier refused to
+    run this plan — the record `profiling` mines to answer "why did my
+    query never start". No-op unless spark.rapids.eventLog.dir is
+    set."""
+    base = conf.get(EVENT_LOG_DIR)
+    if not base:
+        return
+    event = {
+        "type": "plan_rejected",
+        "ts": time.time(),
+        "query": query_id,
+        "fingerprint": plan_fingerprint(root),
+        "report": report.to_dict(),
+        "plan": root.tree_string(),
+    }
+    with open(_app_path(base), "a") as f:
+        f.write(json.dumps(event) + "\n")
+    _prune_event_logs(conf, base)
 
 
 def log_scheduler_events(conf, query_id: str, sched, wall_s: float) -> None:

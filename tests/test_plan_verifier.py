@@ -1,0 +1,258 @@
+"""Static plan verifier: the NDS corpus must verify clean, and each
+seeded defect class must be rejected with its specific named reason
+(analysis/plan_verifier.py; ISSUE 6 tentpole)."""
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import datatypes as dt
+from spark_rapids_tpu.analysis.plan_verifier import (PlanVerificationError,
+                                                     verify_plan)
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.exec.base import HostBatchSourceExec
+from spark_rapids_tpu.expr import UnresolvedColumn
+from spark_rapids_tpu.planner import overrides
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.tools import nds
+
+
+def _source(n=64, seed=0, extra_string=False):
+    rng = np.random.default_rng(seed)
+    cols = {"a": pa.array(rng.integers(0, 100, n), pa.int64()),
+            "b": pa.array(rng.uniform(0, 1, n), pa.float64()),
+            "c": pa.array(rng.integers(0, 10, n), pa.int32())}
+    if extra_string:
+        cols["s"] = pa.array([f"v{i}" for i in range(n)])
+    rb = pa.record_batch(cols)
+    return HostBatchSourceExec([rb])
+
+
+def _col(name):
+    return UnresolvedColumn(name)
+
+
+# --- positive: the whole NDS corpus verifies clean --------------------------
+
+@pytest.mark.parametrize("name", sorted(nds.QUERIES))
+def test_nds_corpus_verifies_clean(name):
+    session = TpuSession(RapidsConf())
+    tables = nds.gen_tables(1 << 10)
+    plan = nds.build_query(name, session, tables)._node
+    report = verify_plan(plan, session.conf)
+    assert report.ok, report.summary()
+    assert report.nodes_checked > 1
+    # and through the planner (transitions + AQE wrappers included),
+    # with verification enabled by default
+    pp = overrides(plan, session.conf)
+    assert pp is not None
+
+
+def test_report_is_machine_readable():
+    session = TpuSession(RapidsConf())
+    tables = nds.gen_tables(1 << 9)
+    report = verify_plan(nds.build_query("q3", session, tables)._node)
+    d = report.to_dict()
+    assert d["ok"] is True
+    assert d["violations"] == []
+    assert d["nodes_checked"] == report.nodes_checked
+    assert d["hbm_budget_bytes"] > 0
+
+
+# --- negative: seeded defects, each with its named reason -------------------
+
+def test_rejects_schema_mismatch_out_of_range():
+    """A project rebuilt over a narrower child references ordinals the
+    new child does not have (the stale with_new_children class)."""
+    from spark_rapids_tpu.exec.basic import TpuProjectExec
+    proj = TpuProjectExec([_col("a"), _col("b"), _col("c")], _source())
+    narrow = HostBatchSourceExec(
+        [pa.record_batch({"a": pa.array([1, 2], pa.int64())})])
+    broken = proj.with_new_children([narrow])
+    report = verify_plan(broken)
+    assert not report.ok
+    assert "schema_mismatch" in report.reasons(), report.summary()
+
+
+def test_rejects_schema_mismatch_dtype():
+    """Same shape, same arity, different column dtype under a bound
+    reference."""
+    from spark_rapids_tpu.exec.basic import TpuProjectExec
+    proj = TpuProjectExec([_col("a")], _source())
+    other = HostBatchSourceExec(
+        [pa.record_batch({"a": pa.array(["x", "y"]),
+                          "b": pa.array([0.1, 0.2], pa.float64()),
+                          "c": pa.array([1, 2], pa.int32())})])
+    broken = proj.with_new_children([other])
+    report = verify_plan(broken)
+    assert not report.ok
+    assert "schema_mismatch" in report.reasons(), report.summary()
+
+
+def test_rejects_union_width_mismatch_as_named_reason():
+    """A union rebuilt over children of different widths must come back
+    as a schema_mismatch rejection, not a raw IndexError/TypeError from
+    the derivation hook."""
+    from spark_rapids_tpu.exec.misc import TpuUnionExec
+    union = TpuUnionExec([_source(seed=1), _source(seed=2)])
+    narrow = HostBatchSourceExec(
+        [pa.record_batch({"a": pa.array([1], pa.int64())})])
+    broken = union.with_new_children([_source(seed=1), narrow])
+    report = verify_plan(broken)
+    assert not report.ok
+    assert "schema_mismatch" in report.reasons(), report.summary()
+
+
+def test_rejects_nullability_lie():
+    """A bound reference claiming non-nullable over a nullable input
+    column: downstream kernels would elide null handling."""
+    from spark_rapids_tpu.exec.basic import TpuProjectExec
+    from spark_rapids_tpu.expr.base import BoundReference
+    src = _source()
+    assert src.output_schema.fields[0].nullable
+    lie = BoundReference(0, dt.INT64, nullable_=False, name="a")
+    proj = TpuProjectExec([lie], src)
+    report = verify_plan(proj)
+    assert not report.ok
+    assert "nullability_lie" in report.reasons(), report.summary()
+
+
+def test_rejects_missing_exchange_copartition():
+    """A shuffled hash join whose children are hash exchanges with
+    different partition counts: equal keys land in different
+    partitions."""
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.exec.joins import TpuShuffledHashJoinExec
+    from spark_rapids_tpu.shuffle.partitioner import HashPartitioning
+    left = _source(seed=1)
+    right = _source(seed=2)
+    lex = TpuShuffleExchangeExec(
+        HashPartitioning([_col("a")], 4), left)
+    rex = TpuShuffleExchangeExec(
+        HashPartitioning([_col("a")], 8), right)
+    join = TpuShuffledHashJoinExec([_col("a")], [_col("a")], "inner",
+                                   lex, rex)
+    report = verify_plan(join)
+    assert not report.ok
+    assert "missing_exchange" in report.reasons(), report.summary()
+    # co-partitioned children (same scheme, same n) are fine
+    ok = TpuShuffledHashJoinExec(
+        [_col("a")], [_col("a")], "inner",
+        TpuShuffleExchangeExec(HashPartitioning([_col("a")], 4), left),
+        TpuShuffleExchangeExec(HashPartitioning([_col("a")], 4), right))
+    assert verify_plan(ok).ok
+
+
+def test_rejects_hbm_over_budget():
+    """A broadcast build whose static estimate exceeds the ledger
+    budget must be rejected up front instead of OOMing mid-query."""
+    from spark_rapids_tpu.exec.exchange import TpuBroadcastExchangeExec
+    src = _source(n=4096)
+    bytes_est = src.static_bytes_estimate()
+    assert bytes_est > 2048
+    plan = TpuBroadcastExchangeExec(src)
+    conf = RapidsConf({"spark.rapids.memory.device.budgetBytes": 2048})
+    report = verify_plan(plan, conf)
+    assert not report.ok
+    assert "hbm_over_budget" in report.reasons(), report.summary()
+    assert report.hbm_budget_bytes == 2048
+    # with a real budget the same plan verifies clean
+    assert verify_plan(plan, RapidsConf()).ok
+
+
+def test_rejects_malformed_aqe_wrapper():
+    from spark_rapids_tpu.exec.aqe import (TpuAQEJoinExec,
+                                           TpuAQEShuffleReadExec)
+    report = verify_plan(TpuAQEShuffleReadExec(_source()))
+    assert not report.ok
+    assert "malformed_aqe_wrapper" in report.reasons(), report.summary()
+    report = verify_plan(TpuAQEJoinExec(_source()))
+    assert "malformed_aqe_wrapper" in report.reasons(), report.summary()
+
+
+def test_rejects_unsupported_dtype_map_key():
+    """Sorting by a map column: no engine path can compare maps."""
+    from spark_rapids_tpu.exec.sort import SortOrder, TpuSortExec
+    rb = pa.record_batch({
+        "m": pa.array([[("k", 1)], [("j", 2)]],
+                      pa.map_(pa.string(), pa.int64())),
+        "v": pa.array([1, 2], pa.int64())})
+    src = HostBatchSourceExec([rb])
+    plan = TpuSortExec([SortOrder(_col("m"))], src)
+    report = verify_plan(plan)
+    assert not report.ok
+    assert "unsupported_dtype" in report.reasons(), report.summary()
+    # TopN wires its sort internally (not via children) — same defect,
+    # same named rejection
+    from spark_rapids_tpu.exec.sort import TpuTopNExec
+    topn = TpuTopNExec(3, [SortOrder(_col("m"))],
+                       HostBatchSourceExec([rb]))
+    report = verify_plan(topn)
+    assert not report.ok
+    assert "unsupported_dtype" in report.reasons(), report.summary()
+
+
+# --- fail-fast wiring -------------------------------------------------------
+
+def test_planner_raises_and_kill_switch_disables():
+    from spark_rapids_tpu.exec.aqe import TpuAQEShuffleReadExec
+    broken = TpuAQEShuffleReadExec(_source())
+    with pytest.raises(PlanVerificationError) as ei:
+        overrides(broken, RapidsConf())
+    assert "malformed_aqe_wrapper" in str(ei.value)
+    assert ei.value.report.violations
+    # the kill switch turns verification off (plan still mis-executes
+    # later, but that is the operator's problem again)
+    pp = overrides(broken, RapidsConf(
+        {"spark.rapids.sql.verifyPlan": "false"}))
+    assert pp is not None
+
+
+def test_rejection_is_observable(tmp_path):
+    """Satellite 6: a rejected plan leaves a plan_rejected event-log
+    line and a flight-recorder ring entry — the evidence `profiling
+    triage` renders for a query that never ran."""
+    from spark_rapids_tpu.exec.aqe import TpuAQEShuffleReadExec
+    from spark_rapids_tpu.obs.recorder import RECORDER
+    from spark_rapids_tpu.tools.event_log import read_event_logs
+    conf = RapidsConf({"spark.rapids.eventLog.dir": str(tmp_path)})
+    RECORDER.configure(conf)
+    RECORDER.clear()
+    broken = TpuAQEShuffleReadExec(_source())
+    with pytest.raises(PlanVerificationError):
+        overrides(broken, conf)
+    events = list(read_event_logs(str(tmp_path)))
+    rejected = [e for e in events if e.get("type") == "plan_rejected"]
+    assert len(rejected) == 1
+    rep = rejected[0]["report"]
+    assert rep["ok"] is False
+    assert any(v["reason"] == "malformed_aqe_wrapper"
+               for v in rep["violations"])
+    assert "AQEShuffleReadExec" in rejected[0]["plan"]
+    ring = [e for e in RECORDER.snapshot()
+            if e.get("kind") == "plan" and e.get("ev") == "plan_rejected"]
+    assert ring, "flight-recorder ring has no plan_rejected entry"
+    assert "malformed_aqe_wrapper" in ring[-1]["reasons"]
+
+
+def test_cluster_rejection_emits_incident(tmp_path):
+    """Process-cluster path: run_query must reject before scheduling a
+    single task, emit a plan_rejected scheduler event, and harvest an
+    incident bundle that `profiling triage` renders with the reason."""
+    from spark_rapids_tpu.cluster import TpuProcessCluster
+    from spark_rapids_tpu.exec.aqe import TpuAQEJoinExec
+    from spark_rapids_tpu.tools.profiling import triage_report
+    conf = RapidsConf({
+        "spark.rapids.flight.dir": str(tmp_path / "flight"),
+        "spark.rapids.eventLog.dir": str(tmp_path / "events")})
+    broken = TpuAQEJoinExec(_source())
+    with TpuProcessCluster(n_workers=1, conf=conf) as c:
+        with pytest.raises(PlanVerificationError):
+            c.run_query(broken, conf)
+        events = [e["event"] for e in c.last_scheduler.events]
+        assert "plan_rejected" in events
+        assert c.last_incident_path is not None
+        text = triage_report(c.last_incident_path)
+    assert "plan_rejected" in text
+    assert os.path.exists(str(tmp_path / "flight"))

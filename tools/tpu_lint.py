@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""tpu-lint CLI: the package's AST rule engine + doc drift check.
+
+Usage:
+    python tools/tpu_lint.py [paths...]   lint (default: the package)
+    python tools/tpu_lint.py --json       machine-readable report
+    python tools/tpu_lint.py --check-docs fail if SUPPORTED_OPS.md is
+                                          stale vs the live registry
+    python tools/tpu_lint.py --confs      AST-exact conf-key audit
+                                          (dead keys + unregistered
+                                          reads), JSON
+
+Exit codes: 0 clean, 1 unallowlisted violations / drift, 2 usage.
+Rules and the inline-allowlist syntax are documented in
+spark_rapids_tpu/analysis/lint.py and README.md ("Static analysis").
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def _check_docs() -> int:
+    from spark_rapids_tpu.tools import generate_supported_ops
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "SUPPORTED_OPS.md")
+    with open(path) as f:
+        committed = f.read().rstrip("\n")
+    generated = generate_supported_ops().rstrip("\n")
+    if committed != generated:
+        print("SUPPORTED_OPS.md is STALE vs the live registry; "
+              "regenerate with:\n  python -c \"from spark_rapids_tpu."
+              "tools import generate_supported_ops; "
+              "print(generate_supported_ops())\" > SUPPORTED_OPS.md",
+              file=sys.stderr)
+        return 1
+    print("SUPPORTED_OPS.md in sync with the live registry")
+    return 0
+
+
+def main(argv) -> int:
+    from spark_rapids_tpu.analysis.lint import conf_key_report, lint_paths
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    if "--check-docs" in argv:
+        return _check_docs()
+    if "--confs" in argv:
+        rep = conf_key_report()
+        print(json.dumps(rep, indent=2))
+        return 1 if rep["unused"] or rep["unregistered_reads"] else 0
+    paths = [a for a in argv if not a.startswith("-")] or None
+    out = lint_paths(paths)
+    if as_json:
+        print(json.dumps(out, indent=2))
+    else:
+        for f in out["findings"]:
+            mark = "ALLOW" if f["allowlisted"] else "FAIL "
+            print(f"{mark} [{f['rule']}] {f['path']}:{f['line']} "
+                  f"{f['message']}"
+                  + (f"  ({f['allow_reason']})" if f["allowlisted"]
+                     else ""))
+        print(f"tpu-lint: {out['files']} files, "
+              f"{out['violations']} violations, "
+              f"{out['allowlisted']} allowlisted")
+    return 1 if out["violations"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
