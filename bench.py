@@ -274,6 +274,10 @@ def bench_nds_from_files(tmp_dir, n_sales=1 << 20):
     results = {}
     ratios = []
     outs = {}
+    # decode-coverage across the whole corpus: every planned column
+    # chunk counts as device-decoded or host-fallback (the envelope-
+    # regression tripwire — acceptance wants ZERO fallbacks here)
+    chunks = {"device": 0, "fallback": 0}
     for name in order:
         df = build_query(name, s, tables)
         pp = TpuOverrides(s.conf).apply(df._node)
@@ -284,6 +288,15 @@ def bench_nds_from_files(tmp_dir, n_sales=1 << 20):
             jax.block_until_ready(bs)
             return bs
         run_dev()  # warm-up/compile
+        # tally coverage from the ONE warm-up execution (the metrics
+        # accumulate per run; counting after the timed loop would
+        # triple every chunk)
+        for node_metrics in ctx.metrics.values():
+            if "deviceChunks" in node_metrics:
+                chunks["device"] += node_metrics["deviceChunks"].value
+            if "fallbackChunks" in node_metrics:
+                chunks["fallback"] += \
+                    node_metrics["fallbackChunks"].value
         times = []
         for _ in range(2):
             t0 = time.perf_counter()
@@ -332,7 +345,7 @@ def bench_nds_from_files(tmp_dir, n_sales=1 << 20):
                                        atol=1e-5), (name, c)
                 else:
                     assert (g == w).all(), (name, c)
-    return round(geomean, 3), results, verify
+    return round(geomean, 3), results, verify, chunks
 
 
 def bench_nds_subset(n_sales=1 << 21):
@@ -467,12 +480,14 @@ def main():
     # --- timed phase 0b: NDS from FILES (scan in the timed region) -------
     nds_files_dir = os.path.join(os.path.dirname(
         os.path.abspath(__file__)), ".bench_cache", "nds_parquet")
-    nds_files_geo, nds_files_detail, nds_files_verify = \
+    nds_files_geo, nds_files_detail, nds_files_verify, nds_chunks = \
         bench_nds_from_files(nds_files_dir)
     print(f"nds from-files: geomean {nds_files_geo}x host "
           "(pandas read_parquet + compute); "
           + "; ".join(f"{k} {v['vs_host']}x" for k, v in
-                      nds_files_detail.items()), file=sys.stderr)
+                      nds_files_detail.items())
+          + f"; chunks device={nds_chunks['device']} "
+          f"fallback={nds_chunks['fallback']}", file=sys.stderr)
 
     n = SF_ROWS
     cols = gen_lineitem(n)
@@ -587,6 +602,44 @@ def main():
                          "pallas_ms": round(tg_pal * 1e3, 3),
                          "pallas_over_xla": round(tg_xla / tg_pal, 3)}
 
+    # sort A/B (ROADMAP item 4: the sort shape is NOT Mosaic-blocked —
+    # only the gather was): a Pallas bitonic network vs jax.lax.sort on
+    # the same keys, VMEM-bounded size so the whole array is resident.
+    # Same falsifiability contract as the gather A/B: only a compile/
+    # lowering failure may claim "mosaic-rejected".
+    from spark_rapids_tpu.ops.pallas_kernels import sort_pallas, sort_xla
+    s_keys = jax.device_put(
+        g_rng.uniform(-1e6, 1e6, 1 << 16).astype(np.float32))
+    s_xla = jax.jit(sort_xla)
+    s_xla(s_keys).block_until_ready()
+
+    def _ts(fn):
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            fn(s_keys).block_until_ready()
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[2]
+    ts_xla = _ts(s_xla)
+    try:
+        r_sp = sort_pallas(s_keys, False)
+        r_sp.block_until_ready()
+        s_compiled = True
+    except Exception as e:
+        s_compiled = False
+        sort_ab = {"xla_ms": round(ts_xla * 1e3, 3),
+                   "status": "mosaic-rejected",
+                   "error": f"{type(e).__name__}: {str(e)[:120]}"}
+    if s_compiled:
+        if not bool(jnp.array_equal(s_xla(s_keys), r_sp)):
+            sort_ab = {"xla_ms": round(ts_xla * 1e3, 3),
+                       "status": "WRONG-RESULT"}
+        else:
+            ts_pal = _ts(lambda k_: sort_pallas(k_, False))
+            sort_ab = {"xla_ms": round(ts_xla * 1e3, 3),
+                       "pallas_ms": round(ts_pal * 1e3, 3),
+                       "pallas_over_xla": round(ts_xla / ts_pal, 3)}
+
     # --- timed phase 2: FROM FILES (scan -> filter -> proj -> agg) -------
     # one scan exec per timed run would re-plan splits; splits are cheap
     # (footers cached by OS); build the plan once and re-execute.
@@ -638,6 +691,11 @@ def main():
     enc_b = sm["encodedBytes"].value if "encodedBytes" in sm else 0
     dec_b = sm["decodedBytes"].value if "decodedBytes" in sm else 0
     enc_ratio = round(enc_b / dec_b, 3) if dec_b else None
+    # decode coverage over the q6 files (one breakdown run's counts)
+    q6_dev_chunks = int(sm["deviceChunks"].value) \
+        if "deviceChunks" in sm else 0
+    q6_fb_chunks = int(sm["fallbackChunks"].value) \
+        if "fallbackChunks" in sm else 0
 
     # --- timed phase 2b: observability overhead A/B (same pipeline) ------
     # The "cheap enough to leave always-on" claim of the flight
@@ -792,6 +850,16 @@ def main():
         "scan_encoded_mb": round(enc_b / 1e6, 1),
         "scan_decoded_mb": round(dec_b / 1e6, 1),
         "scan_encoded_over_decoded": enc_ratio,
+        # decode coverage (ROADMAP item 4 tripwire): planned column
+        # chunks device-decoded vs host-fallback — q6 files here, the
+        # NDS corpus under nds_scan_*; regressions of the widened
+        # envelope (PLAIN strings, V2 pages, DELTA_*) show up as
+        # nonzero fallbacks, with per-reason counts in
+        # rapids_scan_fallback_chunks_total
+        "scan_device_chunks": q6_dev_chunks,
+        "scan_fallback_chunks": q6_fb_chunks,
+        "nds_scan_device_chunks": nds_chunks["device"],
+        "nds_scan_fallback_chunks": nds_chunks["fallback"],
         "tunnel_upload_gbs": tunnel_gbs,
         "tunnel_upload_latency_ms": tunnel_latency_ms,
         # observability overhead audit (flight recorder + tracing fully
@@ -822,6 +890,9 @@ def main():
             "pallas_over_xla": round(t_xla / t_pal, 3),
         },
         "pallas_gather_ab": gather_ab,
+        # sort A/B (ROADMAP item 4): bitonic Pallas network vs
+        # jax.lax.sort — the sort shape was never Mosaic-blocked
+        "pallas_sort_ab": sort_ab,
         "device_kind": kind,
     }))
 

@@ -18,7 +18,7 @@ from ..datatypes import Schema
 from .column import TpuColumnVector
 
 __all__ = ["TpuBatch", "bucket_rows", "bucket_bytes", "bucket_fine",
-           "row_mask"]
+           "bucket_fine_even", "row_mask"]
 
 _MIN_CAPACITY = 128
 
@@ -58,6 +58,16 @@ def bucket_fine(n: int) -> int:
         if cand >= n:
             return cand
     return p
+
+
+def bucket_fine_even(n: int) -> int:
+    """``bucket_fine`` rounded up to an even count — the shape the
+    fused-decode arena quantizes its uint32 segment slots to (even
+    words = 8-byte alignment, so PLAIN 64-bit regions and the widened
+    envelope's string-store/delta-stream segments land word-pair
+    aligned for the funnel-shift gather)."""
+    b = max(8, bucket_fine(n))
+    return b + (b & 1)
 
 
 def row_mask(capacity: int, row_count) -> jax.Array:

@@ -210,12 +210,16 @@ PARQUET_MULTITHREADED_THREADS = register(
 PARQUET_DEVICE_DECODE = register(
     "spark.rapids.sql.format.parquet.deviceDecode.enabled", True,
     "Decode Parquet pages on the device: encoded column chunks "
-    "(dictionary indices, RLE runs, PLAIN bytes) cross the host->device "
-    "link instead of fully-decoded columns, and PLAIN/DICTIONARY/"
-    "RLE-bitpacked expansion runs as an XLA program in HBM (the "
-    "GpuParquetScan-decodes-into-HBM analog). Column chunks outside "
-    "the supported envelope (nested, strings, v2 pages, DELTA_*, LZ4) "
-    "decode on host per chunk.")
+    "(dictionary indices, RLE runs, PLAIN bytes, string stores, delta "
+    "miniblocks) cross the host->device link instead of fully-decoded "
+    "columns, and the expansion runs as an XLA program in HBM (the "
+    "GpuParquetScan-decodes-into-HBM analog). The envelope covers v1 "
+    "AND v2 data pages of flat columns in PLAIN (including BYTE_ARRAY "
+    "strings), PLAIN_/RLE_DICTIONARY, DELTA_BINARY_PACKED and "
+    "DELTA_LENGTH_BYTE_ARRAY encodings under snappy/zstd/gzip/brotli. "
+    "Chunks still outside it (nested, FIXED_LEN_BYTE_ARRAY, "
+    "DELTA_BYTE_ARRAY, BYTE_STREAM_SPLIT, LZ4) decode on host per "
+    "chunk, counted by the scan's fallback-reason histogram.")
 CSV_ENABLED = register(
     "spark.rapids.sql.format.csv.enabled", True,
     "Enable accelerated CSV reads.")
@@ -241,12 +245,18 @@ ADAPTIVE_ENABLED = register(
     "spark.rapids.sql.adaptive.freeStatsOnly).")
 ADAPTIVE_FREE_STATS = register(
     "spark.rapids.sql.adaptive.freeStatsOnly", True,
-    "With AQE: only use per-partition statistics a transport already "
-    "has (the ICI exchange folds them into its existing epoch "
-    "readback); transports that would need a dedicated device->host "
-    "sync (which permanently degrades tunneled devices to synchronous "
-    "dispatch) report none and the reader passes through. Set false on "
-    "co-located hosts to let every transport sync for stats.")
+    "With AQE: only use per-partition statistics gathered as part of "
+    "work a transport already did — the host transport's writer-side "
+    "byte counts (recorded while splitting each downloaded map batch; "
+    "zero device access to serve), the local transport's writer-side "
+    "count kernels (dispatched async with each map batch's split, "
+    "folded in by one deferred few-int32 readback at the stage "
+    "boundary), the ICI exchange's epoch readback. No payload "
+    "downloads, no read-time stats kernels, no spill re-uploads — "
+    "adaptive coalesce/skew engages on the default paths for at most "
+    "one tiny transfer per exchange. Transports/shuffles without "
+    "recorded stats report none and the reader passes through; set "
+    "false on co-located hosts to let them sync for stats anyway.")
 AUTO_BROADCAST_THRESHOLD = register(
     "spark.sql.autoBroadcastJoinThreshold", 10 << 20,
     "AQE demotes a shuffled hash join to broadcast when the "

@@ -16,9 +16,18 @@ phase, and
   operator's memory cliff (the skew-join split analog; the sub-batches
   stream through the same consumer).
 
-The stats readback is ONE small device->host transfer per exchange —
-the price of adaptivity; `spark.sql.adaptive.enabled` defaults false
-because that sync also flips tunneled devices out of pipelined dispatch.
+Statistics are (nearly) free on the default paths: the host transport
+records per-partition byte counts at WRITE time (the writer downloads
+and splits every map batch anyway — serving them touches no device
+state at all), and the local in-process transport dispatches a
+writer-side count kernel alongside each map batch's split (async, so
+the map phase stays pipelined) whose few-int32 results fold in with
+ONE deferred readback at the stage boundary. No payload downloads, no
+read-time stats kernels, no re-upload of spilled entries — coalesce/
+skew engages on the default path for the cost of, at most, one tiny
+transfer per exchange. Transports/shuffles without recorded stats
+report None under `spark.rapids.sql.adaptive.freeStatsOnly` (the
+default) and the reader passes through.
 """
 from __future__ import annotations
 

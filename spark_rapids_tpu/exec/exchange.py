@@ -168,6 +168,13 @@ class TpuShuffleExchangeExec(UnaryExec):
             # shuffle store bytes count against the HBM ledger and spill
             # under pressure (RapidsBufferCatalog-backed store analog)
             transport.set_memory_manager(ctx.mm)
+        if hasattr(transport, "set_stats_recording"):
+            # writer-side partition stats: when AQE is on, the map phase
+            # records per-partition byte counts as it writes, so the
+            # adaptive reader gets stats with zero read-side device
+            # syncs (spark.rapids.sql.adaptive.freeStatsOnly stays safe)
+            from ..config import ADAPTIVE_ENABLED
+            transport.set_stats_recording(ctx.conf.get(ADAPTIVE_ENABLED))
         if self._jit_split is None:
             fn = self._pids if unsplit else self._split
             self._jit_split = jax.jit(fn, static_argnums=1)

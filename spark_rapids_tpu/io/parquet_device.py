@@ -23,10 +23,34 @@ encoded representation instead:
       machinery at width 1) + dense→row scatter via a cumsum gather.
 
 PLAIN-only non-null chunks skip the kernel entirely (the bytes ARE the
-column). Anything outside the supported envelope (nested, BYTE_ARRAY,
-v2 data pages, DELTA_* encodings, LZ4, repetition levels) falls back to
+column). The envelope covers v1 AND v2 data pages of flat columns in
+PLAIN / PLAIN_DICTIONARY / RLE_DICTIONARY / DELTA_BINARY_PACKED /
+DELTA_LENGTH_BYTE_ARRAY encodings, including BYTE_ARRAY strings:
+
+- PLAIN strings: the host walks the 4-byte length prefixes once into
+  int32 offsets; the page's character bytes ride the fused-decode
+  arena and the device gathers them exactly like a dictionary whose
+  index stream is the identity (so dictionary-then-PLAIN mixed chunks
+  share one mechanism and one JIT cache key shape);
+- DATA_PAGE_V2: split rep/def/data regions, levels RLE-decoded into
+  the existing null-mask run tables (levels are uncompressed and
+  carry no length prefix in v2);
+- DELTA_BINARY_PACKED: the host unpacks miniblock headers into
+  bit-packed delta runs (min_delta rides the run table), the device
+  reconstructs values with a prefix sum that restarts at each page's
+  first-value run;
+- DELTA_LENGTH_BYTE_ARRAY: lengths host-decoded (they gate where the
+  character bytes start), characters gathered on device through the
+  same identity-index string path.
+
+Anything still outside the envelope (nested, FIXED_LEN_BYTE_ARRAY,
+DELTA_BYTE_ARRAY prefix compression, BYTE_STREAM_SPLIT, LZ4,
+repetition levels, delta miniblocks wider than 32 bits) falls back to
 the host pyarrow decode per column chunk — the same per-format
-kill-switch philosophy as the reference's readers.
+kill-switch philosophy as the reference's readers. Every
+``HostFallback`` carries a bounded ``reason`` slug so the scan can
+export a per-reason fallback histogram (envelope regressions show up
+in BENCH rounds, not in silence).
 """
 from __future__ import annotations
 
@@ -38,7 +62,8 @@ import numpy as np
 import pyarrow as pa
 
 from .. import datatypes as dt
-from ..columnar.batch import bucket_bytes, bucket_fine, bucket_rows
+from ..columnar.batch import (bucket_bytes, bucket_fine,
+                              bucket_fine_even, bucket_rows)
 from ..columnar.column import TpuColumnVector
 
 __all__ = ["plan_chunk", "decode_chunk_device",
@@ -50,9 +75,22 @@ __all__ = ["plan_chunk", "decode_chunk_device",
 STR_EXPANSION_CAP = 1 << 26
 
 
+#: Bounded label set for the per-reason fallback histogram (obs metric
+#: labels must not explode; free-form messages stay on the exception).
+FALLBACK_REASONS = ("phys-type", "nested", "def-depth", "codec",
+                    "encoding", "dict-width", "delta-width", "page",
+                    "truncated", "size-guard", "string-cap", "other")
+
+
 class HostFallback(Exception):
     """This column chunk is outside the device-decode envelope; the scan
-    decodes it with pyarrow instead (per-chunk granularity)."""
+    decodes it with pyarrow instead (per-chunk granularity). ``reason``
+    is one of :data:`FALLBACK_REASONS` — the bounded slug the scan's
+    fallback histogram is labeled with."""
+
+    def __init__(self, msg: str, reason: str = "other"):
+        super().__init__(msg)
+        self.reason = reason if reason in FALLBACK_REASONS else "other"
 
 
 # --- Thrift compact protocol (just enough for PageHeader) ------------------
@@ -123,7 +161,7 @@ def _skip(buf: bytes, pos: int, ctype: int) -> int:
             else:
                 fid += delta
             pos = _skip(buf, pos, head & 0x0F)
-    raise HostFallback(f"unknown thrift type {ctype}")
+    raise HostFallback(f"unknown thrift type {ctype}", "page")
 
 
 def _read_struct(buf: bytes, pos: int) -> Tuple[Dict[int, object], int]:
@@ -155,6 +193,17 @@ def _read_struct(buf: bytes, pos: int) -> Tuple[Dict[int, object], int]:
 # PageType / Encoding enum values from parquet.thrift (public format spec)
 _PAGE_DATA, _PAGE_INDEX, _PAGE_DICT, _PAGE_DATA_V2 = 0, 1, 2, 3
 _ENC_PLAIN, _ENC_PLAIN_DICT, _ENC_RLE, _ENC_RLE_DICT = 0, 2, 3, 8
+_ENC_DELTA_BINARY_PACKED, _ENC_DELTA_LENGTH_BA, _ENC_DELTA_BA = 5, 6, 7
+
+# Run-table meta bits (column 1 of the int64[n_runs, 4] run table).
+# Bits 0-7 hold the bit-packed width; bits 16+ hold the merged-group
+# index base merge_chunk_plans adds for dictionary/string runs.
+_META_RLE = 1 << 8      # constant run: value rides in column 2
+_META_DICT = 1 << 9     # expanded value is a dictionary index
+_META_IDENT = 1 << 10   # value_i = col2 + (i - row_start): the identity
+                        # index stream PLAIN / DELTA_LENGTH strings use
+_META_DELTA = 1 << 11   # bit-packed DELTA miniblock: col2 = min_delta,
+                        # the device prefix-sums the expanded deltas
 
 
 def parse_page_header(buf: bytes, pos: int):
@@ -188,7 +237,7 @@ def _parse_runs(data: bytes, start: int, end: int, width: int,
     byte_w = (width + 7) // 8
     while count < total:
         if pos >= end:
-            raise HostFallback("RLE stream truncated")
+            raise HostFallback("RLE stream truncated", "truncated")
         header, pos = _varint(data, pos)
         if header & 1:  # bit-packed: groups of 8 values
             groups = header >> 1
@@ -199,7 +248,7 @@ def _parse_runs(data: bytes, start: int, end: int, width: int,
         else:
             repeat = header >> 1
             if repeat == 0:
-                raise HostFallback("zero-length RLE run")
+                raise HostFallback("zero-length RLE run", "truncated")
             value = int.from_bytes(data[pos:pos + byte_w], "little")
             pos += byte_w
             runs.append((count, True, value, 0))
@@ -242,19 +291,26 @@ _MAX_DICT_WIDTH = 24  # funnel-shift window bound: shift(<=31) + width <= 55
 class ChunkPlan:
     """Host-side product of planning one column chunk for device decode:
     numpy arrays ready for upload + the static facts the kernel needs.
-    For STRING chunks (dictionary-encoded BYTE_ARRAY), `lane` is int32
-    (the index stream), `dictionary` is None and `str_dict` holds the
-    host-decoded (offsets int32[nd+1], chars uint8[...]) dictionary —
-    the device expands indices then gathers the strings in HBM."""
+    For STRING chunks (BYTE_ARRAY), `lane` is int32 (the index stream),
+    `dictionary` is None and `str_dict` holds the host-side string
+    store (offsets int32[n+1], chars uint8[...]) — dictionary-page
+    entries first, then any PLAIN / DELTA_LENGTH page values in page
+    order; dictionary runs index the dict slice, identity runs index
+    their page's slice, and the device gathers the characters in HBM
+    either way. `is_delta` marks DELTA_BINARY_PACKED numeric chunks
+    whose values the device reconstructs by prefix sum; `str_bound` is
+    the chunk's worst-case decoded character count (the string output
+    buffer currency — merge sums it)."""
 
     __slots__ = ("n_rows", "lane", "dictionary", "packed", "runs",
                  "def_packed", "def_runs", "n_valid", "has_nulls",
                  "encoded_bytes", "str_dict", "str_char_cap",
-                 "str_max_len")
+                 "str_max_len", "is_delta", "str_bound")
 
     def __init__(self, n_rows, lane, dictionary, packed, runs, def_packed,
                  def_runs, n_valid, encoded_bytes, str_dict=None,
-                 str_char_cap=0, str_max_len=0):
+                 str_char_cap=0, str_max_len=0, is_delta=False,
+                 str_bound=0):
         self.n_rows = n_rows
         self.lane = lane
         self.dictionary = dictionary
@@ -267,7 +323,9 @@ class ChunkPlan:
         self.encoded_bytes = encoded_bytes
         self.str_dict = str_dict      # (offsets, chars) or None
         self.str_char_cap = str_char_cap
-        self.str_max_len = str_max_len  # longest dictionary string
+        self.str_max_len = str_max_len  # longest store string
+        self.is_delta = is_delta
+        self.str_bound = str_bound
 
 
 def _decompress(codec: str, payload: bytes, uncompressed: int) -> bytes:
@@ -288,6 +346,147 @@ def _align8(parts: List[bytes]) -> int:
     return total + pad
 
 
+# --- host-side helpers for the widened envelope ----------------------------
+
+def _walk_plain_byte_array(data: bytes, off: int, count: int):
+    """PLAIN BYTE_ARRAY page body -> (lengths int64[count], contiguous
+    character bytes). The 4-byte little-endian length prefixes chain
+    sequentially, so the host walks them once — ONE int read + list
+    append per value, the only inherently serial work; everything else
+    (start positions, the ragged character gather) derives vectorized."""
+    lens_list = []
+    pos = off
+    end = len(data)
+    for _ in range(count):
+        if pos + 4 > end:
+            raise HostFallback("PLAIN byte-array page truncated",
+                               "truncated")
+        ln = int.from_bytes(data[pos:pos + 4], "little")
+        lens_list.append(ln)
+        pos += 4 + ln
+    if pos > end:
+        raise HostFallback("PLAIN byte-array page truncated", "truncated")
+    lens = np.asarray(lens_list, np.int64) if lens_list \
+        else np.zeros(0, np.int64)
+    total = int(lens.sum())
+    if total == 0:
+        return lens, b""
+    # value i's data starts after i+1 length prefixes and the i
+    # preceding values' characters
+    arr = np.frombuffer(data, np.uint8)
+    starts = off + 4 * np.arange(1, count + 1, dtype=np.int64)
+    starts[1:] += np.cumsum(lens[:-1])
+    out_off = np.concatenate([np.zeros(1, np.int64), np.cumsum(lens)])
+    idx = np.repeat(starts - out_off[:-1], lens) \
+        + np.arange(total, dtype=np.int64)
+    return lens, arr[idx].tobytes()
+
+
+def _delta_header(data: bytes, pos: int):
+    """<block_size><miniblocks/block><total_count><first_value> — the
+    DELTA_BINARY_PACKED stream preamble."""
+    block_size, pos = _varint(data, pos)
+    mb_per_block, pos = _varint(data, pos)
+    total, pos = _varint(data, pos)
+    first, pos = _zigzag(data, pos)
+    if block_size <= 0 or mb_per_block <= 0 \
+            or block_size % mb_per_block \
+            or (block_size // mb_per_block) % 32:
+        # the spec fixes values-per-miniblock at a multiple of 32; a
+        # header violating it would make `cpm * w // 8` floor and
+        # desynchronize every subsequent miniblock read into silently
+        # wrong values
+        raise HostFallback(
+            f"malformed delta header ({block_size}/{mb_per_block})",
+            "truncated")
+    return block_size, mb_per_block, total, first, pos
+
+
+def _delta_miniblocks(data: bytes, pos: int, mb: int, cpm: int,
+                      total: int):
+    """The ONE miniblock walk both delta consumers share: yields
+    (min_delta, width, payload_byte_pos, take) per USED miniblock of a
+    DELTA_BINARY_PACKED stream and returns them with the end position.
+    All truncation / width-bound classification lives here so the
+    numeric-chunk planner and the DELTA_LENGTH lengths decoder can
+    never drift apart."""
+    out = []
+    remaining = total - 1
+    while remaining > 0:
+        if pos >= len(data):
+            raise HostFallback("delta stream truncated", "truncated")
+        min_d, pos = _zigzag(data, pos)
+        if pos + mb > len(data):
+            raise HostFallback("delta stream truncated", "truncated")
+        widths = data[pos:pos + mb]
+        pos += mb
+        for w in widths:
+            if remaining <= 0:
+                break
+            if w > 32:
+                # funnel-shift window bound: shift(<=31) + width <= 63
+                raise HostFallback(f"delta miniblock width {w}",
+                                   "delta-width")
+            nbytes = cpm * w // 8
+            if pos + nbytes > len(data):
+                raise HostFallback("delta stream truncated", "truncated")
+            take = min(cpm, remaining)
+            out.append((min_d, w, pos, take))
+            pos += nbytes
+            remaining -= take
+    return out, pos
+
+
+def _plan_delta_page(data: bytes, off: int, total_expected: int):
+    """Walk one DELTA_BINARY_PACKED page's miniblock headers WITHOUT
+    touching the packed delta payload: returns (first_value,
+    [(value_start, width, min_delta, bit_off)], end_pos) where bit_off
+    is relative to ``off`` — the caller appends data[off:end] to the
+    packed accumulator and shifts. The device expands each miniblock
+    like any bit-packed run, adds its min_delta, and prefix-sums."""
+    bs, mb, total, first, pos = _delta_header(data, off)
+    if total != total_expected:
+        raise HostFallback(
+            f"delta page count {total} != page values {total_expected}",
+            "truncated")
+    cpm = bs // mb  # values per miniblock (spec: multiple of 32)
+    blocks, pos = _delta_miniblocks(data, pos, mb, cpm, total)
+    mbs = []
+    vstart = 1
+    for min_d, w, bpos, take in blocks:
+        mbs.append((vstart, w, min_d, (bpos - off) * 8))
+        vstart += take
+    return first, mbs, pos
+
+
+def _decode_delta_ints(data: bytes, off: int):
+    """Fully host-decode a DELTA_BINARY_PACKED int stream (the lengths
+    preamble of DELTA_LENGTH_BYTE_ARRAY — the lengths gate where the
+    character bytes start, so the host needs the actual values):
+    returns (int64 values, end_pos). numpy unpackbits per miniblock —
+    no per-value python loop."""
+    bs, mb, total, first, pos = _delta_header(data, off)
+    out = np.zeros(max(total, 1), np.int64)
+    out[0] = first
+    cpm = bs // mb
+    blocks, pos = _delta_miniblocks(data, pos, mb, cpm, total)
+    filled = 1
+    for min_d, w, bpos, take in blocks:
+        if w:
+            bits = np.unpackbits(
+                np.frombuffer(data, np.uint8, count=cpm * w // 8,
+                              offset=bpos),
+                bitorder="little")
+            vals = bits.reshape(cpm, w).astype(np.int64)
+            vals = (vals << np.arange(w, dtype=np.int64)).sum(1)
+        else:
+            vals = np.zeros(cpm, np.int64)
+        out[filled:filled + take] = vals[:take] + min_d
+        filled += take
+    np.cumsum(out[:total], out=out[:total])
+    return out[:total], pos
+
+
 def plan_chunk(f, col_md, descriptor, engine_dtype: dt.DataType,
                arrow_field_type) -> ChunkPlan:
     """Plan one column chunk (one row group × one column) for device
@@ -298,15 +497,15 @@ def plan_chunk(f, col_md, descriptor, engine_dtype: dt.DataType,
         and isinstance(engine_dtype, (dt.StringType, dt.BinaryType))
     lane = np.dtype(np.int32) if is_string else _PHYS_LANE.get(phys)
     if lane is None:
-        raise HostFallback(f"physical type {phys}")
+        raise HostFallback(f"physical type {phys}", "phys-type")
     if descriptor.max_repetition_level != 0:
-        raise HostFallback("repetition levels (nested)")
+        raise HostFallback("repetition levels (nested)", "nested")
     max_def = descriptor.max_definition_level
     if max_def > 1:
-        raise HostFallback("definition depth > 1")
+        raise HostFallback("definition depth > 1", "def-depth")
     codec = col_md.compression
     if codec not in _SUPPORTED_CODECS:
-        raise HostFallback(f"codec {codec}")
+        raise HostFallback(f"codec {codec}", "codec")
     # bit-identity gate: the file's arrow type must equal the engine
     # dtype's arrow type, be an integer widening the device can astype
     # exactly (int8/int16 ride INT32 physically), or be the same bits
@@ -329,7 +528,8 @@ def plan_chunk(f, col_md, descriptor, engine_dtype: dt.DataType,
             and pa.types.is_integer(eng_arrow)
         if not both_int:
             raise HostFallback(
-                f"file type {arrow_field_type} vs engine {eng_arrow}")
+                f"file type {arrow_field_type} vs engine {eng_arrow}",
+                "phys-type")
 
     n_rows = col_md.num_values
     start = col_md.data_page_offset
@@ -339,17 +539,27 @@ def plan_chunk(f, col_md, descriptor, engine_dtype: dt.DataType,
     buf = f.read(col_md.total_compressed_size)
 
     dictionary: Optional[np.ndarray] = None
-    str_dict = None                 # (offsets, chars) for BYTE_ARRAY
+    # string store: dictionary-page values first, then PLAIN /
+    # DELTA_LENGTH page values in page order (identity runs index the
+    # page's own slice)
+    sd_lens: List[np.ndarray] = []
+    sd_chars: List[bytes] = []
+    sd_count = 0
+    n_dict = 0                      # store entries from the dict page
+    dict_rows = 0                   # rows decoded via dictionary runs
+    ident_chars = 0                 # chars reachable via identity runs
     packed_parts: List[bytes] = []
-    runs: List[tuple] = []          # (value_row, is_rle, value, bit, is_dict, width)
+    runs: List[tuple] = []          # (value_row, meta, value, bit)
     def_packed_parts: List[bytes] = []
     def_runs: List[tuple] = []
     values_seen = 0                 # dense (non-null) value-stream rows
     rows_seen = 0
+    has_delta = has_nondelta = False
     pos = 0
     while rows_seen < n_rows:
         if pos >= len(buf):
-            raise HostFallback("page walk ran past chunk bytes")
+            raise HostFallback("page walk ran past chunk bytes",
+                               "truncated")
         hdr = parse_page_header(buf, pos)
         payload_start = pos + hdr["header_len"]
         payload = buf[payload_start: payload_start + hdr["compressed"]]
@@ -357,140 +567,265 @@ def plan_chunk(f, col_md, descriptor, engine_dtype: dt.DataType,
         if hdr["type"] == _PAGE_DICT:
             dh = hdr["dict_hdr"] or {}
             if dh.get(2, _ENC_PLAIN) not in (_ENC_PLAIN, _ENC_PLAIN_DICT):
-                raise HostFallback("non-PLAIN dictionary page")
+                raise HostFallback("non-PLAIN dictionary page",
+                                   "encoding")
             data = _decompress(codec, payload, hdr["uncompressed"])
             if phys == "BOOLEAN":
-                raise HostFallback("boolean dictionary")
+                raise HostFallback("boolean dictionary", "encoding")
             if is_string:
-                str_dict = _parse_byte_array_dict(data, dh.get(1, 0))
+                if sd_count:
+                    raise HostFallback("dictionary page after values",
+                                       "page")
+                d_lens, d_chars = _parse_byte_array_dict(data,
+                                                         dh.get(1, 0))
+                sd_lens.append(d_lens)
+                sd_chars.append(d_chars)
+                sd_count = n_dict = d_lens.shape[0]
             else:
                 dictionary = np.frombuffer(data, lane, count=dh.get(1, 0))
             continue
         if hdr["type"] == _PAGE_INDEX:
             continue
-        if hdr["type"] != _PAGE_DATA:
-            raise HostFallback("v2/unknown data page")
-        dph = hdr["data_hdr"] or {}
-        num_values = dph.get(1, 0)
-        enc = dph.get(2)
-        data = _decompress(codec, payload, hdr["uncompressed"])
-        off = 0
-        page_valid = num_values
-        if max_def > 0:
-            if dph.get(3) != _ENC_RLE:
-                raise HostFallback("non-RLE definition levels")
-            (dl,) = struct.unpack_from("<i", data, 0)
-            base_bits = _align8(def_packed_parts) * 8
-            page_def, _ = _parse_runs(data, 4, 4 + dl, 1, num_values,
-                                      base_bits)
-            page_def = [(r + rows_seen, k, v, b) for r, k, v, b in page_def]
-            def_packed_parts.append(data[4:4 + dl])
-            page_valid = _popcount_valid(
-                [(r - rows_seen, k, v, b - base_bits)
-                 for r, k, v, b in page_def],
-                data[4:4 + dl], 0, num_values)
-            def_runs.extend(page_def)
-            off = 4 + dl
+        if hdr["type"] == _PAGE_DATA:
+            dph = hdr["data_hdr"] or {}
+            num_values = dph.get(1, 0)
+            enc = dph.get(2)
+            data = _decompress(codec, payload, hdr["uncompressed"])
+            off = 0
+            page_valid = num_values
+            if max_def > 0:
+                if dph.get(3) != _ENC_RLE:
+                    raise HostFallback("non-RLE definition levels",
+                                       "encoding")
+                (dl,) = struct.unpack_from("<i", data, 0)
+                base_bits = _align8(def_packed_parts) * 8
+                page_def, _ = _parse_runs(data, 4, 4 + dl, 1, num_values,
+                                          base_bits)
+                page_def = [(r + rows_seen, k, v, b)
+                            for r, k, v, b in page_def]
+                def_packed_parts.append(data[4:4 + dl])
+                page_valid = _popcount_valid(
+                    [(r - rows_seen, k, v, b - base_bits)
+                     for r, k, v, b in page_def],
+                    data[4:4 + dl], 0, num_values)
+                def_runs.extend(page_def)
+                off = 4 + dl
+        elif hdr["type"] == _PAGE_DATA_V2:
+            # v2 pages: rep/def level regions ride UNCOMPRESSED before
+            # the (optionally compressed) data region, levels carry no
+            # 4-byte length prefix, and the null count is in the header
+            h2 = hdr["v2_hdr"] or {}
+            num_values = h2.get(1, 0)
+            num_nulls = h2.get(2, 0)
+            enc = h2.get(4)
+            def_len = h2.get(5, 0)
+            rep_len = h2.get(6, 0)
+            if rep_len:
+                raise HostFallback("v2 repetition levels (nested)",
+                                   "nested")
+            body = payload[def_len:]
+            if h2.get(7, True) and codec != "UNCOMPRESSED":
+                body = _decompress(codec, body,
+                                   hdr["uncompressed"] - def_len)
+            page_valid = num_values - num_nulls
+            if max_def > 0 and def_len:
+                def_bytes = bytes(payload[:def_len])
+                base_bits = _align8(def_packed_parts) * 8
+                page_def, _ = _parse_runs(def_bytes, 0, def_len, 1,
+                                          num_values, base_bits)
+                def_runs.extend((r + rows_seen, k, v, b)
+                                for r, k, v, b in page_def)
+                def_packed_parts.append(def_bytes)
+            elif num_nulls:
+                raise HostFallback("v2 nulls without definition levels",
+                                   "page")
+            elif max_def > 0:
+                # level region elided for an all-valid page: a previous
+                # page's trailing run must not govern these rows
+                def_runs.append((rows_seen, True, 1, 0))
+            data = bytes(body)
+            off = 0
+        else:
+            raise HostFallback("unknown page type", "page")
+
+        # --- shared per-encoding dispatch (v1 and v2 pages) ------------
         if enc in (_ENC_RLE_DICT, _ENC_PLAIN_DICT) \
-                and (dictionary is not None or str_dict is not None):
+                and (dictionary is not None or n_dict):
+            has_nondelta = True
             width = data[off]
             if width > _MAX_DICT_WIDTH:
-                raise HostFallback(f"dict index width {width}")
+                raise HostFallback(f"dict index width {width}",
+                                   "dict-width")
             # string chunks: the INDEX stream is the decoded value
-            # (is_dict False -> the kernel returns raw indices; the
-            # device gathers strings from the uploaded dictionary)
-            as_dict = not is_string
+            # (no _META_DICT -> the kernel returns raw indices; the
+            # device gathers strings from the uploaded store)
+            dmeta = 0 if is_string else _META_DICT
+            dict_rows += page_valid
             base_bits = _align8(packed_parts) * 8
             if width == 0:
                 # every value is dictionary[0]
-                runs.append((values_seen, True, 0, 0, as_dict, 1))
+                runs.append((values_seen, 1 | _META_RLE | dmeta, 0, 0))
             else:
                 pruns, stream_end = _parse_runs(data, off + 1, len(data),
                                                 width, page_valid,
                                                 base_bits)
                 packed_parts.append(data[off + 1: stream_end])
-                runs.extend((r + values_seen, k, v, b, as_dict, width)
-                            for r, k, v, b in pruns)
+                runs.extend(
+                    (r + values_seen,
+                     (width | _META_RLE | dmeta) if k
+                     else (width | dmeta), v, b)
+                    for r, k, v, b in pruns)
         elif enc == _ENC_PLAIN and is_string:
-            raise HostFallback("PLAIN string pages (host decode)")
+            # host walks the length prefixes once into the store; the
+            # device gathers the characters via an identity index run
+            has_nondelta = True
+            lens, chars = _walk_plain_byte_array(data, off, page_valid)
+            runs.append((values_seen, _META_IDENT, sd_count, 0))
+            sd_lens.append(lens)
+            sd_chars.append(chars)
+            sd_count += page_valid
+            ident_chars += len(chars)
         elif enc == _ENC_PLAIN:
+            has_nondelta = True
             base = _align8(packed_parts)
             if phys == "BOOLEAN":
                 nbytes = (page_valid + 7) // 8
                 packed_parts.append(data[off: off + nbytes])
-                runs.append((values_seen, False, 0, base * 8, False, 1))
+                runs.append((values_seen, 1, 0, base * 8))
             else:
                 w = lane.itemsize * 8
                 packed_parts.append(
                     data[off: off + page_valid * lane.itemsize])
-                runs.append((values_seen, False, 0, base * 8, False, w))
+                runs.append((values_seen, w, 0, base * 8))
+        elif enc == _ENC_RLE and phys == "BOOLEAN":
+            # v2 boolean values: RLE/bit-packed hybrid with an i32
+            # byte-length prefix (same stream shape as def levels)
+            has_nondelta = True
+            (bl,) = struct.unpack_from("<i", data, off)
+            base_bits = _align8(packed_parts) * 8
+            pruns, _ = _parse_runs(data, off + 4, off + 4 + bl, 1,
+                                   page_valid, base_bits)
+            packed_parts.append(data[off + 4: off + 4 + bl])
+            runs.extend((r + values_seen,
+                         (1 | _META_RLE) if k else 1, v, b)
+                        for r, k, v, b in pruns)
+        elif enc == _ENC_DELTA_BINARY_PACKED \
+                and phys in ("INT32", "INT64"):
+            # miniblock headers -> bit-packed delta runs; the device
+            # prefix-sums from each page's first-value run
+            has_delta = True
+            first, mbs, _ = _plan_delta_page(data, off, page_valid)
+            if page_valid:  # a 0-value page must not emit a phantom
+                base_bits = _align8(packed_parts) * 8  # first-value run
+                runs.append((values_seen, _META_RLE, first, 0))
+                runs.extend((values_seen + vs, w | _META_DELTA, md,
+                             base_bits + bo)
+                            for vs, w, md, bo in mbs)
+                packed_parts.append(data[off:])
+        elif enc == _ENC_DELTA_LENGTH_BA and is_string:
+            # lengths are a host-decoded delta stream (they gate where
+            # the character bytes start); characters ride the store
+            has_nondelta = True
+            lens, cpos = _decode_delta_ints(data, off)
+            if lens.shape[0] != page_valid:
+                raise HostFallback(
+                    f"delta-length count {lens.shape[0]} != "
+                    f"{page_valid}", "truncated")
+            total = int(lens.sum()) if lens.size else 0
+            if cpos + total > len(data):
+                # a short slice would silently gather padding as string
+                # content — classify, never truncate quietly
+                raise HostFallback("delta-length characters truncated",
+                                   "truncated")
+            runs.append((values_seen, _META_IDENT, sd_count, 0))
+            sd_lens.append(lens)
+            sd_chars.append(bytes(data[cpos:cpos + total]))
+            sd_count += page_valid
+            ident_chars += total
         else:
-            raise HostFallback(f"encoding {enc}")
+            raise HostFallback(f"encoding {enc}", "encoding")
         values_seen += page_valid
         rows_seen += num_values
+
+    if has_delta and has_nondelta:
+        # the prefix-sum reconstruction treats every RLE run as a page
+        # restart; a chunk mixing delta pages with other encodings
+        # cannot ride it
+        raise HostFallback("mixed DELTA/non-DELTA data pages",
+                           "encoding")
 
     packed = b"".join(packed_parts)
     def_packed = b"".join(def_packed_parts)
     run_tab = np.zeros((max(len(runs), 1), 4), np.int64)
-    for i, (row, is_rle, value, bit, is_dict, width) in enumerate(runs):
-        run_tab[i] = (row, width | (int(is_rle) << 8)
-                      | (int(is_dict) << 9), value, bit)
+    for i, r in enumerate(runs):
+        run_tab[i] = r
     if not runs:
-        run_tab[0] = (0, 1 | (1 << 8), 0, 0)
+        run_tab[0] = (0, 1 | _META_RLE, 0, 0)
     def_tab = np.zeros((max(len(def_runs), 1), 4), np.int64)
     for i, (row, is_rle, value, bit) in enumerate(def_runs):
         def_tab[i] = (row, 1 | (int(is_rle) << 8), value, bit)
     if not def_runs:
-        def_tab[0] = (0, 1 | (1 << 8), 1, 0)  # all-valid constant run
+        def_tab[0] = (0, 1 | _META_RLE, 1, 0)  # all-valid constant run
     encoded = (len(packed) + len(def_packed) + run_tab.nbytes
                + def_tab.nbytes
                + (dictionary.nbytes if dictionary is not None else 0))
+    str_dict = None
     str_char_cap = 0
     str_max_len = 0
+    str_bound = 0
     if is_string:
-        if str_dict is None:
-            raise HostFallback("string chunk without dictionary")
-        d_offs, d_chars = str_dict
-        encoded += d_offs.nbytes + d_chars.nbytes
-        d_lens = d_offs[1:] - d_offs[:-1]
-        str_max_len = int(d_lens.max()) if d_lens.size else 0
-        bound = n_rows * max(str_max_len, 1)
-        if bound > STR_EXPANSION_CAP:
+        if not sd_lens and values_seen:
+            raise HostFallback("string chunk without dictionary",
+                               "encoding")
+        lens = np.concatenate(sd_lens) if sd_lens \
+            else np.zeros(0, np.int64)
+        offs = np.zeros(lens.shape[0] + 1, np.int64)
+        np.cumsum(lens, out=offs[1:])
+        if offs[-1] > np.iinfo(np.int32).max:
+            raise HostFallback("string store over int32 offsets",
+                               "string-cap")
+        chars = np.frombuffer(b"".join(sd_chars) + b"\x00" * 8, np.uint8)
+        str_dict = (offs.astype(np.int32), chars)
+        str_max_len = int(lens.max()) if lens.size else 0
+        d_max = int(lens[:n_dict].max()) if n_dict else 0
+        # worst-case decoded characters: dictionary runs can repeat the
+        # longest dictionary entry per row; identity runs emit each
+        # page value at most once
+        str_bound = dict_rows * max(d_max, 1) + ident_chars
+        str_bound = max(str_bound, 16)
+        if str_bound > STR_EXPANSION_CAP:
             raise HostFallback(
-                f"string expansion bound {bound}B over the device cap")
-        str_char_cap = bucket_bytes(max(bound, 16))
+                f"string expansion bound {str_bound}B over the device "
+                "cap", "string-cap")
+        encoded += offs.nbytes // 2 + chars.nbytes  # int32 on device
+        str_char_cap = bucket_bytes(str_bound)
     else:
         # no-win guard: the host-decode path uploads bucket_rows(n)×lane
-        # data + a bool validity lane; if the encoded form (incl.
-        # tables) is not smaller, host decode is the better trade
+        # data + a bool validity lane — but it ALSO pays the pyarrow
+        # host decode and rides the per-column arrow upload instead of
+        # the fused blob, so parity-sized encoded forms still win on
+        # device; only a substantially bigger encoded form (pathological
+        # dictionaries: near-unique values dict-encoded) is a real loss
         host_upload = bucket_rows(n_rows) * (lane.itemsize + 1)
-        if encoded > host_upload:
+        if encoded * 2 > host_upload * 3:
             raise HostFallback(
-                f"encoded {encoded}B >= host upload {host_upload}B")
+                f"encoded {encoded}B > 1.5x host upload {host_upload}B",
+                "size-guard")
     return ChunkPlan(n_rows, lane,
                      dictionary if dictionary is not None
                      else np.zeros(1, lane),
                      _as_words(packed), run_tab,
                      _as_words(def_packed), def_tab, values_seen, encoded,
                      str_dict=str_dict, str_char_cap=str_char_cap,
-                     str_max_len=str_max_len)
+                     str_max_len=str_max_len, is_delta=has_delta,
+                     str_bound=str_bound)
 
 
 def _parse_byte_array_dict(data: bytes, count: int):
-    """PLAIN BYTE_ARRAY dictionary page -> (offsets int32[count+1],
-    chars uint8[...]). Dictionaries are small (that is why the column
-    dict-encoded), so the host loop is fine."""
-    offs = np.zeros(count + 1, np.int32)
-    parts = []
-    pos = 0
-    for i in range(count):
-        ln = int.from_bytes(data[pos:pos + 4], "little")
-        pos += 4
-        parts.append(data[pos:pos + ln])
-        pos += ln
-        offs[i + 1] = offs[i] + ln
-    chars = np.frombuffer(b"".join(parts) + b"\x00" * 8, np.uint8)
-    return offs, chars
+    """PLAIN BYTE_ARRAY dictionary page -> (lengths int64[count],
+    contiguous character bytes) — the string-store shape plan_chunk
+    accumulates page values into."""
+    return _walk_plain_byte_array(data, 0, count)
 
 
 def _as_words(b: bytes) -> np.ndarray:
@@ -522,6 +857,7 @@ def merge_chunk_plans(plans: Sequence[ChunkPlan]) -> ChunkPlan:
     p0 = plans[0]
     lane = p0.lane
     is_string = p0.str_dict is not None
+    is_delta = p0.is_delta
     words_parts: List[np.ndarray] = []
     def_parts: List[np.ndarray] = []
     run_tabs: List[np.ndarray] = []
@@ -533,8 +869,10 @@ def merge_chunk_plans(plans: Sequence[ChunkPlan]) -> ChunkPlan:
     dense_base = row_base = dict_base = char_base = 0
     n_rows = n_valid = encoded = 0
     str_max_len = 0
+    str_bound = 0
     for p in plans:
-        if p.lane != lane or (p.str_dict is None) != (not is_string):
+        if p.lane != lane or (p.str_dict is None) != (not is_string) \
+                or p.is_delta != is_delta:
             raise ValueError("merge_chunk_plans: incompatible plans")
         if w_words % 2:  # keep every stream 8-byte aligned (PLAIN w=64)
             words_parts.append(np.zeros(1, np.uint32))
@@ -578,18 +916,25 @@ def merge_chunk_plans(plans: Sequence[ChunkPlan]) -> ChunkPlan:
         n_valid += p.n_valid
         encoded += p.encoded_bytes
         str_max_len = max(str_max_len, p.str_max_len)
+        str_bound += p.str_bound
     str_dict = None
     str_char_cap = 0
     if is_string:
-        bound = n_rows * max(str_max_len, 1)
-        if bound > STR_EXPANSION_CAP:  # the coalescer prechecks this
+        # each group's rows only reach its own slice of the merged
+        # store, so the merged worst case is the SUM of per-group
+        # bounds — tight for identity (PLAIN/DELTA_LENGTH) groups too
+        if str_bound > STR_EXPANSION_CAP:  # the coalescer prechecks this
             raise HostFallback(
-                f"merged string expansion bound {bound}B over the cap")
+                f"merged string expansion bound {str_bound}B over the "
+                "cap", "string-cap")
+        if char_base > np.iinfo(np.int32).max:  # coalescer-prechecked
+            raise HostFallback(
+                "merged string store over int32 offsets", "string-cap")
         offs64 = np.concatenate(offs_parts)
         str_dict = (offs64.astype(np.int32),
                     np.frombuffer(b"".join(chars_parts) + b"\x00" * 8,
                                   np.uint8))
-        str_char_cap = bucket_bytes(max(bound, 16))
+        str_char_cap = bucket_bytes(max(str_bound, 16))
         dictionary = np.zeros(1, lane)
     else:
         dictionary = np.concatenate(dict_parts)
@@ -599,15 +944,22 @@ def merge_chunk_plans(plans: Sequence[ChunkPlan]) -> ChunkPlan:
                      np.concatenate(def_parts),
                      np.concatenate(def_tabs),
                      n_valid, encoded, str_dict=str_dict,
-                     str_char_cap=str_char_cap, str_max_len=str_max_len)
+                     str_char_cap=str_char_cap, str_max_len=str_max_len,
+                     is_delta=is_delta, str_bound=str_bound)
 
 
 # --- device kernel ---------------------------------------------------------
 
-def _expand(words, tab, idx):
+def _expand(words, tab, idx, delta: bool = False):
     """Expand the run table at dense positions `idx`: uint64 raw bits +
-    (is_rle, is_dict, width) lanes for the caller's interpretation."""
+    (is_rle, is_dict, width) lanes for the caller's interpretation.
+    With ``delta`` (static), the expanded lanes are per-value DELTA
+    contributions (bit-packed delta + the run's min_delta; a page's
+    first value rides an RLE run) and the return value is the
+    prefix-sum reconstruction, restarted at every RLE run — each page
+    is its own delta stream."""
     import jax.numpy as jnp
+    from jax import lax
     starts = tab[:, 0]
     rid = jnp.clip(jnp.searchsorted(starts, idx, side="right") - 1,
                    0, tab.shape[0] - 1)
@@ -615,6 +967,8 @@ def _expand(words, tab, idx):
     width = (meta & 0xFF).astype(jnp.uint64)
     is_rle = (meta >> 8) & 1
     is_dict = (meta >> 9) & 1
+    is_ident = (meta >> 10) & 1
+    is_delta = (meta >> 11) & 1
     bitpos = (tab[rid, 3] + (idx - starts[rid]) * (meta & 0xFF)) \
         .astype(jnp.int64)
     widx = jnp.clip(bitpos >> 5, 0, words.shape[0] - 2)
@@ -634,16 +988,32 @@ def _expand(words, tab, idx):
     bits = jnp.where(width >= 64, full64, bits)
     raw = tab[rid, 2].astype(jnp.uint64)
     bits = jnp.where(is_rle == 1, raw, bits)
-    # merged row groups: dictionary-index runs carry their group's index
-    # base in meta bits 16+ (0 for PLAIN runs and unmerged plans), so
-    # the index points into its own group's slice of the concatenated
-    # dictionary
+    # identity runs (PLAIN / DELTA_LENGTH strings): the value IS the
+    # dense position's index into the chunk's string store
+    bits = jnp.where(is_ident == 1,
+                     raw + (idx - starts[rid]).astype(jnp.uint64), bits)
+    # delta miniblock runs: packed value + the run's min_delta
+    # (uint64 wraparound == two's-complement int64 addition)
+    bits = jnp.where(is_delta == 1, bits + raw, bits)
+    # merged row groups: dictionary-index and string runs carry their
+    # group's index base in meta bits 16+ (0 for PLAIN runs and
+    # unmerged plans), so the index points into its own group's slice
+    # of the concatenated dictionary/store
     bits = bits + (meta >> 16).astype(jnp.uint64)
+    if delta:
+        # value_i = page_first + Σ deltas: inclusive prefix sum minus
+        # the sum just before the page's first-value (RLE) run
+        page_start = lax.cummax(
+            jnp.where(((tab[:, 1] >> 8) & 1) == 1, starts,
+                      jnp.int64(-1)))[rid]
+        csum = jnp.cumsum(bits)
+        before = csum[jnp.clip(page_start - 1, 0, idx.shape[0] - 1)]
+        bits = csum - jnp.where(page_start > 0, before, jnp.uint64(0))
     return bits, is_dict
 
 
 def _decode_device(words, tab, dict_arr, def_words, def_tab, n_rows,
-                   cap: int):
+                   cap: int, delta: bool = False):
     """The whole chunk decode as one jittable program: returns
     (values[cap] in the DICTIONARY/lane dtype, validity[cap])."""
     import jax.numpy as jnp
@@ -654,7 +1024,7 @@ def _decode_device(words, tab, dict_arr, def_words, def_tab, n_rows,
     valid = valid & (i < n_rows)
     # dense index of each valid row into the value stream
     didx = jnp.cumsum(valid.astype(jnp.int32)) - 1
-    bits, is_dict = _expand(words, tab, i)
+    bits, is_dict = _expand(words, tab, i, delta=delta)
     lane = dict_arr.dtype
     if lane == jnp.bool_:
         vals = (bits & jnp.uint64(1)) != 0
@@ -707,9 +1077,9 @@ def _seg_bucket(n: int) -> int:
     """Bucketed (and even, for 8-byte alignment) arena segment length:
     the quantization that makes blob offsets — and therefore the fused
     program's JIT cache key — collapse across heterogeneous row
-    groups."""
-    b = max(8, bucket_fine(n))
-    return b + (b & 1)
+    groups (columnar.batch.bucket_fine_even — shared so every arena
+    user quantizes identically)."""
+    return bucket_fine_even(n)
 
 
 def decode_chunk_device(plan: ChunkPlan, engine_dtype: dt.DataType,
@@ -726,8 +1096,8 @@ def _lane_of(name: str):
 
 def decode_row_group_device(plans: Dict[str, Tuple[ChunkPlan, dt.DataType]],
                             capacity: int,
-                            timers: Optional[Dict[str, float]] = None
-                            ) -> Dict[str, TpuColumnVector]:
+                            timers: Optional[Dict[str, float]] = None,
+                            mm=None) -> Dict[str, TpuColumnVector]:
     """Decode every device-eligible chunk of a row group with ONE
     host->device transfer and ONE program dispatch: all encoded segments
     (packed streams, run tables, dictionaries, def levels) concatenate
@@ -746,7 +1116,11 @@ def decode_row_group_device(plans: Dict[str, Tuple[ChunkPlan, dt.DataType]],
 
     ``timers`` (optional dict) accumulates ``assemble`` (host arena
     build) and ``upload`` (device_put + dispatch + arena-reuse wait)
-    seconds for the scan's metric split."""
+    seconds for the scan's metric split. ``mm`` (optional
+    DeviceMemoryManager) takes a transient ledger reservation for the
+    encoded blob while the upload + dispatch are in flight, so the
+    staging bytes the widened envelope ships (string stores, delta
+    streams) are visible to eviction pressure and the HBM timeline."""
     import time
 
     import jax
@@ -798,7 +1172,7 @@ def decode_row_group_device(plans: Dict[str, Tuple[ChunkPlan, dt.DataType]],
                      if eng_dtype.np_dtype is not None else "str",
                      w_off, w_len, t_off, t.shape[0],
                      dw_off, dw_len, dt_off, dtab.shape[0],
-                     dict_off, d.shape[0], str_info))
+                     dict_off, d.shape[0], str_info, plan.is_delta))
     total = _seg_bucket(off + 4)  # trailing slice-overrun guard
     buf, reuse_wait = _staging_arena(total)
     for arr, start in segs:
@@ -813,7 +1187,7 @@ def decode_row_group_device(plans: Dict[str, Tuple[ChunkPlan, dt.DataType]],
                 outs = []
                 for j, (lane_s, eng_s, w_off, w_len, t_off, t_n, dw_off,
                         dw_len, dt_off, dt_n, d_off, d_n,
-                        str_info) in enumerate(spec):
+                        str_info, is_delta) in enumerate(spec):
                     lane = np.dtype(lane_s)
                     words = b[w_off: w_off + w_len]
                     tab = lax.bitcast_convert_type(
@@ -834,7 +1208,7 @@ def decode_row_group_device(plans: Dict[str, Tuple[ChunkPlan, dt.DataType]],
                             b[d_off: d_off + d_n], jnp.dtype(lane))
                     vals, valid = _decode_device(
                         words, tab, dict_arr, def_words, def_tab,
-                        nr[j], cap)
+                        nr[j], cap, delta=is_delta)
                     if str_info is not None:
                         so_off, so_n, sc_off, char_cap = str_info
                         d_offs = lax.bitcast_convert_type(
@@ -866,8 +1240,12 @@ def decode_row_group_device(plans: Dict[str, Tuple[ChunkPlan, dt.DataType]],
             fn = jax.jit(build)
             _JIT_CACHE[key] = fn
     t_up0 = time.perf_counter()
-    blob = jax.device_put(view)
-    outs = fn(blob, jnp.asarray(np.asarray(nrs, np.int64)))
+    import contextlib
+    charge = mm.transient_reservation(view.nbytes) if mm is not None \
+        and hasattr(mm, "transient_reservation") else contextlib.nullcontext()
+    with charge:
+        blob = jax.device_put(view)
+        outs = fn(blob, jnp.asarray(np.asarray(nrs, np.int64)))
     _STAGING.pending = outs  # arena reusable once the decode ran
     t_up1 = time.perf_counter()
     if timers is not None:

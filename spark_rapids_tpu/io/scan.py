@@ -64,6 +64,18 @@ SCAN_UPLOAD_SECONDS = _METRICS.histogram(
     "rapids_scan_upload_seconds",
     "Host->device transfer + decode-dispatch time per scan output "
     "batch.", ("mode",), buckets=TRANSFER_BUCKETS)
+# Decode-coverage counters (the envelope-regression tripwire): every
+# column chunk the device-decode scan plans is either device-decoded or
+# host-fallback, and fallbacks carry the bounded reason slug
+# parquet_device.FALLBACK_REASONS defines — a BENCH round (or any
+# /metrics scrape) shows at a glance when files drop off the fast path.
+SCAN_DEVICE_CHUNKS = _METRICS.counter(
+    "rapids_scan_device_chunks_total",
+    "Column chunks decoded on device by the parquet scan.")
+SCAN_FALLBACK_CHUNKS = _METRICS.counter(
+    "rapids_scan_fallback_chunks_total",
+    "Column chunks that fell back to host pyarrow decode, by bounded "
+    "reason slug.", ("reason",))
 
 _FORMAT_CONF = {"parquet": PARQUET_ENABLED, "orc": ORC_ENABLED,
                 "csv": CSV_ENABLED, "json": JSON_ENABLED,
@@ -627,7 +639,10 @@ class TpuFileScanExec(LeafExec):
     def _plan_row_group(self, path: str, g: int):
         """Host side of the device-decode path for one row group: page
         walk + codec decompress + run-header parse per eligible column
-        chunk; pyarrow decode for the rest. Runs on the reader pool."""
+        chunk; pyarrow decode for the rest. Runs on the reader pool.
+        The trailing element is the tuple of bounded fallback-reason
+        slugs for the chunks that dropped to host decode — the scan's
+        decode-coverage counters ride it."""
         from .parquet_device import HostFallback, plan_chunk
         pf = self._thread_pf(path)
         md = pf.metadata
@@ -639,6 +654,7 @@ class TpuFileScanExec(LeafExec):
             if self._part_schema is not None else set()
         plans: Dict[str, object] = {}
         host_cols: List[str] = []
+        fb_reasons: List[str] = []
         with open(path, "rb") as f:
             for fld in self._schema.fields:
                 if fld.name in part_fields:
@@ -650,22 +666,25 @@ class TpuFileScanExec(LeafExec):
                     plans[fld.name] = plan_chunk(
                         f, rg.column(ci), pf.schema.column(ci), fld.dtype,
                         pf.schema_arrow.field(fld.name).type)
-                except HostFallback:
+                except HostFallback as hf:
                     host_cols.append(fld.name)
+                    fb_reasons.append(hf.reason)
         host_rb = None
         if host_cols:
             t = pf.read_row_group(g, columns=host_cols)
             host_rb = t.combine_chunks().to_batches()[0] if t.num_rows \
                 else None
-        return n_rows, plans, host_rb, self._part_values.get(path)
+        return (n_rows, plans, host_rb, self._part_values.get(path),
+                tuple(fb_reasons))
 
     def _assemble_device_batch(self, n_rows, plans, host_rb, part_vals,
-                               timers=None):
+                               timers=None, mm=None):
         """Feeder side: ONE fused decode dispatch for every planned
         column + uploads for host-fallback/partition columns, then the
         TpuBatch (all async — no host sync). ``timers`` accumulates the
         assemble/upload split (decode_row_group_device contributes its
-        own; the per-column uploads here add to "upload")."""
+        own; the per-column uploads here add to "upload"); ``mm`` lets
+        the decode take its transient staging-blob ledger charge."""
         from .parquet_device import decode_row_group_device
         from ..columnar.batch import bucket_rows
         from ..columnar.arrow_bridge import arrow_column_to_device
@@ -682,7 +701,8 @@ class TpuFileScanExec(LeafExec):
                 encoded += plan.encoded_bytes
                 lane = plan.lane
                 decoded += n_rows * (1 if lane == bool else lane.itemsize)
-        dev_cols = decode_row_group_device(typed, cap, timers) \
+                decoded += plan.str_char_cap
+        dev_cols = decode_row_group_device(typed, cap, timers, mm=mm) \
             if typed else {}
         up_s = 0.0
         cols = []
@@ -716,7 +736,7 @@ class TpuFileScanExec(LeafExec):
     def _decoded_estimate(item) -> int:
         """Decoded output bytes one planned row group will occupy on
         device — the coalesce-target currency."""
-        n_rows, plans, host_rb, _ = item
+        n_rows, plans, host_rb = item[0], item[1], item[2]
         est = host_rb.nbytes if host_rb is not None else 0
         for plan in plans.values():
             lane = plan.lane
@@ -727,11 +747,11 @@ class TpuFileScanExec(LeafExec):
     @staticmethod
     def _coalesce_compatible(a, b) -> bool:
         """May two consecutive planned row groups merge into one fused
-        dispatch? Same device-plan column set (and lane/string shape),
-        same host-fallback schema, same partition values — the merge
-        itself handles heterogeneous dictionaries and sizes."""
-        _, pa_, ha, va = a
-        _, pb_, hb, vb = b
+        dispatch? Same device-plan column set (and lane/string/delta
+        shape), same host-fallback schema, same partition values — the
+        merge itself handles heterogeneous dictionaries and sizes."""
+        _, pa_, ha, va = a[:4]
+        _, pb_, hb, vb = b[:4]
         if va != vb or set(pa_) != set(pb_):
             return False
         if (ha is None) != (hb is None) \
@@ -740,22 +760,30 @@ class TpuFileScanExec(LeafExec):
         for k, x in pa_.items():
             y = pb_[k]
             if x.lane != y.lane \
-                    or (x.str_dict is None) != (y.str_dict is None):
+                    or (x.str_dict is None) != (y.str_dict is None) \
+                    or x.is_delta != y.is_delta:
                 return False
         return True
 
     @staticmethod
     def _string_bound_ok(group, item) -> bool:
         """The merged plan's worst-case string expansion must stay under
-        the device cap plan_chunk enforces per chunk."""
+        the device cap plan_chunk enforces per chunk, AND the merged
+        store's character count must fit int32 offsets. Each group's
+        rows only index its own store slice, so the merged bound is the
+        SUM of per-plan bounds."""
+        import numpy as np
         from .parquet_device import STR_EXPANSION_CAP
-        rows = sum(g[0] for g in group) + item[0]
+        i32max = np.iinfo(np.int32).max
         for k, p in item[1].items():
             if p.str_dict is None:
                 continue
-            ml = max([g[1][k].str_max_len for g in group]
-                     + [p.str_max_len])
-            if rows * max(ml, 1) > STR_EXPANSION_CAP:
+            bound = sum(g[1][k].str_bound for g in group) + p.str_bound
+            if bound > STR_EXPANSION_CAP:
+                return False
+            chars = sum(int(g[1][k].str_dict[0][-1]) for g in group) \
+                + int(p.str_dict[0][-1])
+            if chars > i32max:
                 return False
         return True
 
@@ -784,7 +812,8 @@ class TpuFileScanExec(LeafExec):
 
     def _merge_planned(self, group):
         """Fuse a coalesced group into one assembly unit: per-column
-        plan merge + host-fallback batch concat."""
+        plan merge + host-fallback batch concat (fallback reasons
+        concatenate — every planned chunk is counted exactly once)."""
         if len(group) == 1:
             return group[0]
         from .parquet_device import merge_chunk_plans
@@ -797,7 +826,8 @@ class TpuFileScanExec(LeafExec):
             t = pa.Table.from_batches(host_rbs).combine_chunks()
             bs = t.to_batches()
             host_rb = bs[0] if bs else host_rbs[0]
-        return n_rows, plans, host_rb, group[0][3]
+        reasons = tuple(r for g in group for r in g[4])
+        return n_rows, plans, host_rb, group[0][3], reasons
 
     def _execute_device_decode(self, ctx: ExecCtx):
         """The overlapped upload tunnel: row-group planning runs on the
@@ -815,6 +845,8 @@ class TpuFileScanExec(LeafExec):
         wait_t = ctx.metric(self, "uploadWaitTime")
         enc_m = ctx.metric(self, "encodedBytes")
         dec_m = ctx.metric(self, "decodedBytes")
+        dev_chunks_m = ctx.metric(self, "deviceChunks")
+        fb_chunks_m = ctx.metric(self, "fallbackChunks")
         tasks = self._device_rg_tasks()
         if not tasks:
             return
@@ -856,9 +888,14 @@ class TpuFileScanExec(LeafExec):
         def assemble(group):
             timers = {"assemble": 0.0, "upload": 0.0}
             t0 = time.perf_counter()
-            n_rows, plans, host_rb, part_vals = self._merge_planned(group)
+            # coverage counts from the PRE-merge group: one count per
+            # planned column chunk, merge or no merge
+            dev_chunks = sum(len(g[1]) for g in group)
+            n_rows, plans, host_rb, part_vals, fb_reasons = \
+                self._merge_planned(group)
             batch, encoded, decoded = self._assemble_device_batch(
-                n_rows, plans, host_rb, part_vals, timers=timers)
+                n_rows, plans, host_rb, part_vals, timers=timers,
+                mm=mgr)
             # whatever the wall spent that was not attributed to the
             # transfer side is host assembly (merge, arena build, arrow
             # prep)
@@ -870,11 +907,20 @@ class TpuFileScanExec(LeafExec):
                     sb.release()
                     return None
                 inflight.add(sb)
-            return batch, sb, n_rows, encoded, decoded, timers
+            return (batch, sb, n_rows, encoded, decoded, timers,
+                    dev_chunks, fb_reasons)
 
         groups = self._coalesced_groups(planned(), target_bytes, max_rows)
+        # the in-flight window is bounded in decoded BYTES too: string
+        # groups (PLAIN/DELTA_LENGTH pages ride the widened envelope)
+        # can decode to far more than a numeric group, and a count-only
+        # window would pin `window` of them in HBM at once
+        max_weight = window * max(target_bytes, 64 << 20)
         gen = pipelined_map(assemble, groups, threads=up_threads,
-                            window=window)
+                            window=window,
+                            weigher=lambda g: sum(
+                                self._decoded_estimate(it) for it in g),
+                            max_weight=max_weight)
         try:
             while True:
                 t0 = time.perf_counter()
@@ -883,7 +929,8 @@ class TpuFileScanExec(LeafExec):
                 except StopIteration:
                     break
                 wait_t.value += time.perf_counter() - t0
-                batch, sb, n_rows, encoded, decoded, timers = item
+                (batch, sb, n_rows, encoded, decoded, timers,
+                 dev_chunks, fb_reasons) = item
                 asm_t.value += timers["assemble"]
                 up_t.value += timers["upload"]
                 SCAN_ASSEMBLE_SECONDS.labels("device").observe(
@@ -892,6 +939,12 @@ class TpuFileScanExec(LeafExec):
                     timers["upload"])
                 enc_m.value += encoded
                 dec_m.value += decoded
+                dev_chunks_m.value += dev_chunks
+                fb_chunks_m.value += len(fb_reasons)
+                if dev_chunks:
+                    SCAN_DEVICE_CHUNKS.inc(dev_chunks)
+                for r in fb_reasons:
+                    SCAN_FALLBACK_CHUNKS.labels(r).inc()
                 rows.value += n_rows
                 with ilock:
                     inflight.discard(sb)

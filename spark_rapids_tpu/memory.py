@@ -537,6 +537,37 @@ class DeviceMemoryManager:
         self._spill_victims(victims)
         self._evict_host_to_disk(exclude=exclude)
 
+    def transient_reservation(self, nbytes: int):
+        """Context manager: ledger charge for short-lived device staging
+        — the scan's encoded-blob upload while a fused decode dispatch
+        is in flight. The blob is NOT spillable (it is consumed by the
+        very next program), so it gets no catalog entry; but the bytes
+        are real HBM occupancy, and without the charge eviction pressure
+        and the flight-recorder HBM timeline under-count the scan by a
+        whole staging arena per feeder thread. Charged across the
+        device_put + dispatch; the XLA runtime owns the buffer after."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            n = int(nbytes)
+            with self._lock:
+                self.device_bytes += n
+            try:
+                # inside the try: an eviction/spill failure here must
+                # still release the charge below, or the ledger stays
+                # inflated by a phantom blob for the session's lifetime
+                self._evict_to_fit()
+                self._sync_gauges()
+                self._flight_mem("staging_reserve", n)
+                yield
+            finally:
+                with self._lock:
+                    self.device_bytes -= n
+                self._sync_gauges()
+                self._flight_mem("staging_release", n)
+        return _ctx()
+
     def pin(self, sb: SpillableBatch):
         """Refcounted: a batch shared by several consumers (a broadcast
         feeding two joins) stays pinned until the LAST unpin."""

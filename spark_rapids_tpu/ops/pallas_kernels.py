@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["masked_product_sum_pallas", "masked_product_sum_xla",
-           "gather_pallas", "gather_xla"]
+           "gather_pallas", "gather_xla", "sort_pallas", "sort_xla"]
 
 _TILE_ROWS = 2048
 _LANES = 128
@@ -108,6 +108,65 @@ def _gather_kernel(t_ref, i_ref, o_ref):
     # rejection verbatim so the A/B stays falsifiable, not silently
     # skipped (VERDICT r4 weak #10)
     o_ref[...] = jnp.take(table.reshape(-1), idx, axis=0)
+
+
+# --- sort A/B: the remaining open kernel question ---------------------------
+# BENCH_r05 settled the gather shape (pallas_gather_ab: Mosaic rejects
+# the dynamic gather on this vintage) but the SORT shape was never
+# measured — and it is NOT gather-blocked: a bitonic network is pure
+# compare-exchange over statically-shaped reshapes, exactly the op mix
+# Mosaic lowers. Sort backs the engine's sort exec, the range
+# partitioner's bounds, and the local shuffle's stats kernel, so a win
+# here would be load-bearing. bench.py A/Bs `sort_pallas` against
+# jax.lax.sort as `pallas_sort_ab`, recording a mosaic-rejected status
+# verbatim if lowering fails (same falsifiability contract as the
+# gather A/B).
+
+def sort_xla(keys):
+    return jax.lax.sort(keys)
+
+
+def _sort_kernel(k_ref, o_ref):
+    x = k_ref[...].reshape(-1)
+    n = x.shape[0]
+    # bitonic sort network: static log^2(n) compare-exchange stages.
+    # Pairs at distance `stride` sit in lanes [:, 0, :] / [:, 1, :] of
+    # a (n/2s, 2, s) reshape; the merge direction alternates per
+    # `size`-block, derived from a broadcasted iota (no dynamic
+    # indexing anywhere — the shape Mosaic should accept).
+    size = 2
+    while size <= n:
+        stride = size // 2
+        while stride >= 1:
+            y = x.reshape(-1, 2, stride)
+            a, b = y[:, 0, :], y[:, 1, :]
+            blk = jax.lax.broadcasted_iota(jnp.int32, (y.shape[0], 1), 0)
+            asc = ((blk * (2 * stride)) // size) % 2 == 0
+            lo = jnp.where(asc, jnp.minimum(a, b), jnp.maximum(a, b))
+            hi = jnp.where(asc, jnp.maximum(a, b), jnp.minimum(a, b))
+            x = jnp.stack([lo, hi], axis=1).reshape(-1)
+            stride //= 2
+        size *= 2
+    o_ref[...] = x.reshape(k_ref.shape)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def sort_pallas(keys, interpret: bool = False):
+    """Grid-free Pallas bitonic sort: the whole key array resident in
+    VMEM (the caller bounds it — 2^16 f32 keys is 256KB), length must
+    be a power of two >= 256 (the bench pads; engine batches are
+    power-of-two capacities anyway)."""
+    from jax.experimental import pallas as pl
+    n = keys.shape[0]
+    if n < 256 or n & (n - 1):
+        raise ValueError(f"sort_pallas needs a power-of-two length "
+                         f">= 256, got {n}")
+    k2 = keys.reshape(-1, _LANES)
+    call = pl.pallas_call(
+        _sort_kernel,
+        out_shape=jax.ShapeDtypeStruct(k2.shape, keys.dtype),
+        interpret=interpret)
+    return call(k2).reshape(-1)
 
 
 @functools.partial(jax.jit, static_argnums=(2,))

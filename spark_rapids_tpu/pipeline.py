@@ -17,14 +17,20 @@ only when the consumer RETRIEVES a result, so not-yet-consumed uploads
 — i.e. device residency — are capped at ``window``), worker and source
 exceptions surface at the consumer's corresponding ``next()``, and
 closing the generator early never deadlocks a feeder stuck on a full
-window.
+window. With ``weigher``/``max_weight`` the window is ALSO bounded in
+item weight (decoded bytes for the scan): the widened decode envelope
+feeds string blobs whose decoded size dwarfs a numeric row group's, so
+a count-only window could pin several oversized batches in HBM at
+once — the weight bound keeps the feeder from running ahead of the
+consumer by more bytes than the budget allows (one over-weight item is
+still admitted alone, so progress never stalls).
 """
 from __future__ import annotations
 
 import concurrent.futures
 import queue
 import threading
-from typing import Callable, Iterable, Iterator, TypeVar
+from typing import Callable, Iterable, Iterator, Optional, TypeVar
 
 __all__ = ["pipelined_map"]
 
@@ -36,8 +42,46 @@ _ERR = "err"
 _FUT = "fut"
 
 
+class _WeightedWindow:
+    """Count + weight bounded admission: acquire blocks while the
+    window holds ``window`` items OR ``max_weight`` total weight (a
+    single item heavier than the whole budget admits alone — otherwise
+    it could never run). ``close()`` unblocks a parked feeder."""
+
+    def __init__(self, window: int, max_weight: Optional[int]):
+        self._window = window
+        self._max_weight = max_weight
+        self._count = 0
+        self._weight = 0
+        self._closed = False
+        self._cv = threading.Condition()
+
+    def acquire(self, weight: int = 0) -> None:
+        with self._cv:
+            while not self._closed and (
+                    self._count >= self._window
+                    or (self._max_weight is not None and self._count
+                        and self._weight + weight > self._max_weight)):
+                self._cv.wait()
+            self._count += 1
+            self._weight += weight
+
+    def release(self, weight: int = 0) -> None:
+        with self._cv:
+            self._count -= 1
+            self._weight -= weight
+            self._cv.notify_all()
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+
 def pipelined_map(fn: Callable[[T], R], items: Iterable[T],
-                  threads: int = 1, window: int = 2) -> Iterator[R]:
+                  threads: int = 1, window: int = 2,
+                  weigher: Optional[Callable[[T], int]] = None,
+                  max_weight: Optional[int] = None) -> Iterator[R]:
     """Yield ``fn(item)`` for each item, in order, with up to ``window``
     results in flight across ``threads`` worker threads.
 
@@ -50,6 +94,9 @@ def pipelined_map(fn: Callable[[T], R], items: Iterable[T],
       that would have yielded that item's result; an exception raised
       by the source iterator is re-raised after every earlier result
       was delivered.
+    - ``weigher(item)`` + ``max_weight`` additionally bound the summed
+      weight of in-flight items (see module docstring); a weigher
+      exception is a source exception.
     - ``close()`` (or GC) of the generator stops the feeder, cancels
       queued work, and returns without waiting for stragglers.
     """
@@ -59,7 +106,8 @@ def pipelined_map(fn: Callable[[T], R], items: Iterable[T],
         return
 
     out: "queue.Queue" = queue.Queue()
-    slots = threading.Semaphore(window)
+    slots = _WeightedWindow(window,
+                            max_weight if weigher is not None else None)
     stop = threading.Event()
     pool = concurrent.futures.ThreadPoolExecutor(
         max_workers=threads, thread_name_prefix="pipelined-map")
@@ -69,10 +117,11 @@ def pipelined_map(fn: Callable[[T], R], items: Iterable[T],
             for x in items:
                 if stop.is_set():
                     return
-                slots.acquire()
+                w = int(weigher(x)) if weigher is not None else 0
+                slots.acquire(w)
                 if stop.is_set():
                     return
-                out.put((_FUT, pool.submit(fn, x)))
+                out.put((_FUT, (pool.submit(fn, x), w)))
             out.put((_END, None))
         except BaseException as e:  # source iterator failed
             out.put((_ERR, e))
@@ -87,20 +136,21 @@ def pipelined_map(fn: Callable[[T], R], items: Iterable[T],
                 return
             if kind == _ERR:
                 raise val
+            fut, w = val
             try:
                 # tpu-lint: allow[blocking-call-in-thread] consumer side: must re-raise worker exceptions; bounded by the in-flight window + pool shutdown in finally
-                result = val.result()  # re-raises worker exceptions
+                result = fut.result()  # re-raises worker exceptions
             finally:
-                slots.release()
+                slots.release(w)
             yield result
     finally:
         stop.set()
-        slots.release()  # unblock a feeder parked on a full window
+        slots.close()  # unblock a feeder parked on a full window
         while True:  # drop queued work so the pool can drain
             try:
                 kind, val = out.get_nowait()
             except queue.Empty:
                 break
             if kind == _FUT:
-                val.cancel()
+                val[0].cancel()
         pool.shutdown(wait=False, cancel_futures=True)

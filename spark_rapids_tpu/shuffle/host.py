@@ -142,6 +142,12 @@ class HostShuffleTransport(ShuffleTransport):
         self._failed: Dict[int, BaseException] = {}
         # per-staging-dir (size, crc) entries for the commit manifest
         self._manifests: Dict[str, Dict[str, Dict]] = {}
+        # free AQE stats: per-partition decoded byte counts recorded at
+        # WRITE time — the writer already downloaded and split the map
+        # batch, so the numbers cost nothing and partition_stats can
+        # serve them without ever touching device memory
+        self._nparts: Dict[int, int] = {}
+        self._pstats: Dict[int, Dict[int, int]] = {}
         self._fetch_retries = conf.get(SHUFFLE_FETCH_MAX_RETRIES)
         self._fetch_wait_s = conf.get(SHUFFLE_FETCH_RETRY_WAIT_MS) / 1e3
         self._lock = threading.Lock()
@@ -185,8 +191,20 @@ class HostShuffleTransport(ShuffleTransport):
         size, crc = integrity.write_block(path,
                                           sink.getvalue().to_pybytes())
         with self._lock:
+            # "raw" (decoded bytes) rides the manifest so a FRESH
+            # transport over an existing root can rebuild partition
+            # stats from committed manifests alone
             self._manifests.setdefault(os.path.dirname(path), {})[
-                os.path.basename(path)] = {"size": size, "crc": crc}
+                os.path.basename(path)] = {"size": size, "crc": crc,
+                                           "raw": int(rb.nbytes)}
+            if subdir is None:
+                # direct (non-attempt) writes are immediately visible to
+                # readers, so they credit the stats now; attempt-staged
+                # writes credit at COMMIT — an in-flight speculative
+                # duplicate must never transiently double-count a
+                # partition for a concurrent AQE stats read
+                ps = self._pstats.setdefault(sid, {})
+                ps[pid] = ps.get(pid, 0) + int(rb.nbytes)
         SHUF_PARTS_WRITTEN.labels("host").inc()
         SHUF_BYTES_WRITTEN.labels("host").inc(rb.nbytes)
 
@@ -253,6 +271,21 @@ class HostShuffleTransport(ShuffleTransport):
             f.write(f"{task_key} a{attempt}")
         return d
 
+    def _credit_stats(self, shuffle_id: int, entries: Dict) -> None:
+        """Fold a COMMITTED attempt's per-partition byte counts into
+        the writer-side stats (staged writes defer to here, so losing
+        and aborted attempts never touch the stats at all)."""
+        if not entries:
+            return
+        with self._lock:
+            ps = self._pstats.setdefault(shuffle_id, {})
+            for name, meta in entries.items():
+                m = integrity._PID_RE.search(name)
+                if m is None:
+                    continue
+                pid = int(m.group(1))
+                ps[pid] = ps.get(pid, 0) + int((meta or {}).get("raw", 0))
+
     def commit_task_attempt(self, shuffle_id: int, task_key: str,
                             attempt: int) -> bool:
         """Atomically publish the attempt's output; False = a sibling
@@ -273,11 +306,13 @@ class HostShuffleTransport(ShuffleTransport):
             pass  # staging already gone: the rename below settles it
         try:
             os.rename(staging, final)
+            self._credit_stats(shuffle_id, entries)
             return True
         except OSError as e:
             # lost the race (destination committed by a sibling) or the
             # driver already aborted this attempt (staging gone) — any
-            # other rename failure is real data loss, not a lost race
+            # other rename failure is real data loss, not a lost race;
+            # the loser never credited the stats, so nothing to undo
             if e.errno in (errno.EEXIST, errno.ENOTEMPTY) \
                     or not os.path.exists(staging):
                 shutil.rmtree(staging, ignore_errors=True)
@@ -306,6 +341,48 @@ class HostShuffleTransport(ShuffleTransport):
 
     def register_shuffle(self, shuffle_id: int, num_partitions: int):
         os.makedirs(self._sdir(shuffle_id), exist_ok=True)
+        with self._lock:
+            self._nparts[shuffle_id] = num_partitions
+
+    # --- free AQE statistics ----------------------------------------------
+
+    def partition_stats(self, shuffle_id: int, free_only: bool = False):
+        """Approximate decoded bytes per partition, recorded at WRITE
+        time (the writer downloads and splits every map batch anyway,
+        so the counts are free) — valid under free_only: serving them
+        touches no device memory and issues no device sync, which is
+        what keeps adaptive coalesce/skew safe on tunneled devices.
+        A transport instance that did not write the shuffle (separate
+        process over a shared root) rebuilds the counts from the
+        committed manifests' ``raw`` entries."""
+        self._drain(shuffle_id)  # writer-side counts must be settled
+        with self._lock:
+            n = self._nparts.get(shuffle_id)
+            ps = dict(self._pstats.get(shuffle_id, {}))
+        if not ps:
+            idx = integrity.expected_partition_index(
+                self._sdir(shuffle_id), shuffle_id=shuffle_id)
+            for pid, blocks in idx.items():
+                for _, meta in blocks:
+                    if not meta or "raw" not in meta:
+                        # a legacy/direct-write block with no recorded
+                        # byte count: partial stats would misreport its
+                        # partition as empty and mis-plan coalescing —
+                        # withhold rather than mislead
+                        return None
+                ps[pid] = sum(meta["raw"] for _, meta in blocks)
+            if not any(ps.values()):
+                return None  # nothing written: no stats
+        if n is None:
+            n = max(ps) + 1 if ps else 0
+        return [int(ps.get(p, 0)) for p in range(n)]
+
+    def stage_bytes(self, shuffle_id: int):
+        """Stage size from the same write-time counts — the AQE
+        join-strategy switch's input; no device sync. None when this
+        instance has no record of the shuffle."""
+        stats = self.partition_stats(shuffle_id, free_only=True)
+        return sum(stats) if stats is not None else None
 
     def writer(self, shuffle_id: int, map_id: int,
                subdir: Optional[str] = None) -> ShuffleWriteHandle:
@@ -452,6 +529,8 @@ class HostShuffleTransport(ShuffleTransport):
         with self._lock:
             self._schemas.pop(shuffle_id, None)
             self._failed.pop(shuffle_id, None)
+            self._nparts.pop(shuffle_id, None)
+            self._pstats.pop(shuffle_id, None)
             for d in [d for d in self._manifests
                       if d == sdir or d.startswith(sdir + os.sep)]:
                 del self._manifests[d]

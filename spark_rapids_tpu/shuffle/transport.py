@@ -136,18 +136,23 @@ class _MapEntry:
 
 
 class _LocalWriter(ShuffleWriteHandle):
-    def __init__(self, transport: "LocalShuffleTransport", store, map_id):
+    def __init__(self, transport: "LocalShuffleTransport", store, map_id,
+                 shuffle_id):
         self._transport = transport
         self._store = store
         self._mid = map_id
+        self._sid = shuffle_id
 
     def write(self, partition_id: int, batch: TpuBatch) -> None:
         # pre-split path (non-unsplit callers / tests): stored as-is,
-        # outside the spill catalog
+        # outside the spill catalog; per-partition views share the map
+        # batch's capacity so no free byte count exists for them
+        self._transport._mark_unrecorded(self._sid)
         self._store.setdefault(partition_id, []).append(
             (self._mid, batch))
 
     def write_unsplit(self, batch: TpuBatch, pids) -> None:
+        self._transport._record_write_stats(self._sid, batch, pids)
         entry = _MapEntry(self._transport._mm, batch, pids)
         self._store.setdefault(None, []).append((self._mid, entry))
 
@@ -170,15 +175,100 @@ class LocalShuffleTransport(ShuffleTransport):
         self._lock = threading.Lock()
         self._mm = None
         self._stats_jit: Dict[tuple, object] = {}
+        # writer-side AQE stats (see set_stats_recording):
+        # _wstats_pending holds (device counts, entry bytes) pairs
+        # dispatched during the map phase; they fold into _wstats on
+        # first read via ONE tiny batched readback
+        self._record_stats = False
+        self._wstats: Dict[int, "np.ndarray"] = {}
+        self._wstats_pending: Dict[int, list] = {}
+        self._wstats_dirty: Dict[int, bool] = {}
 
     def set_memory_manager(self, mm) -> None:
         """Attach the spill catalog; subsequent writes are spillable."""
         self._mm = mm
 
+    def set_stats_recording(self, enabled: bool) -> None:
+        """Writer-side partition statistics: while enabled, every
+        ``write_unsplit`` DISPATCHES a per-partition live-row count
+        kernel alongside the split dispatch the exchange just issued —
+        asynchronous, so the map phase keeps its pipelined dispatch —
+        and stores the tiny device count array.
+        ``partition_stats(free_only=True)`` then folds them in with ONE
+        deferred batched readback at the stage boundary (a few int32s
+        per map batch; no payload download, no read-time kernels, no
+        re-upload of spilled entries), after which the stats are cached
+        host-side. The exchange enables this when
+        ``spark.sql.adaptive.enabled`` is on."""
+        self._record_stats = bool(enabled)
+
     def register_shuffle(self, shuffle_id: int, num_partitions: int):
         with self._lock:
             self._shuffles.setdefault(shuffle_id, {})
             self._nparts[shuffle_id] = num_partitions
+
+    def _mark_unrecorded(self, shuffle_id: int) -> None:
+        """This shuffle received a write the writer-side stats cannot
+        account (pre-split views share capacity); free stats for it are
+        withheld rather than served wrong."""
+        with self._lock:
+            self._wstats_dirty[shuffle_id] = True
+
+    def _record_write_stats(self, shuffle_id: int, batch: TpuBatch,
+                            pids) -> None:
+        """Dispatch one map batch's per-partition row-count kernel
+        (ASYNC — nothing blocks here, the map phase's dispatch stream
+        stays pipelined) and park the device result for the deferred
+        stage-boundary readback. No-op unless recording is enabled and
+        the shuffle has >1 partition — a single partition needs no
+        adaptivity and must not pay the count dispatch."""
+        if not self._record_stats:
+            self._mark_unrecorded(shuffle_id)
+            return
+        n = self._nparts.get(shuffle_id, 0)
+        if n <= 1:
+            return
+        import jax
+        import jax.numpy as jnp
+        key = ("w", batch.capacity, n)
+        fn = self._stats_jit.get(key)
+        if fn is None:
+            def rows_per_pid(bb, pidvals):
+                sp = jax.lax.sort(
+                    jnp.where(bb.live_mask(), pidvals.astype(jnp.int32),
+                              jnp.int32(n)))
+                edges = jnp.searchsorted(
+                    sp, jnp.arange(n + 1, dtype=jnp.int32))
+                return edges[1:] - edges[:-1]
+            fn = jax.jit(rows_per_pid)
+            self._stats_jit[key] = fn
+        counts_dev = fn(batch, pids)  # async dispatch, tiny result
+        nbytes = batch.device_size_bytes()  # capacity metadata, free
+        with self._lock:
+            self._wstats_pending.setdefault(shuffle_id, []).append(
+                (counts_dev, nbytes))
+
+    def _fold_pending_stats(self, shuffle_id: int) -> None:
+        """Materialize parked write-time count arrays into the cached
+        host-side stats: ONE batched readback of a few int32s per map
+        batch, paid once per shuffle at the stage boundary."""
+        with self._lock:
+            pending = self._wstats_pending.pop(shuffle_id, [])
+        if not pending:
+            return
+        import jax
+        import numpy as np
+        host = jax.device_get([c for c, _ in pending])
+        sizes = None
+        for counts, nbytes in zip(host, (b for _, b in pending)):
+            counts = np.asarray(counts).astype(np.int64)
+            tot = max(int(counts.sum()), 1)
+            s = counts * nbytes // tot
+            sizes = s if sizes is None else sizes + s
+        with self._lock:
+            prev = self._wstats.get(shuffle_id)
+            self._wstats[shuffle_id] = sizes if prev is None \
+                else prev + sizes
 
     def stage_bytes(self, shuffle_id: int) -> int:
         """Total bytes materialized for this shuffle, from CAPACITY
@@ -196,13 +286,32 @@ class LocalShuffleTransport(ShuffleTransport):
         return total
 
     def partition_stats(self, shuffle_id: int, free_only: bool = False):
-        """Approximate bytes per partition for AQE: per map entry, live
-        row counts per partition (sorted pids + searchsorted — no
-        scatter) scaled to the entry's byte size; ONE host readback per
-        shuffle, paid only when an AQE read asks (SURVEY.md:161). With
-        free_only (spark.rapids.sql.adaptive.freeStatsOnly), this
-        transport has no readback to fold the stats into, so it reports
-        None and the adaptive reader passes through."""
+        """Approximate bytes per partition for AQE. Preferred source:
+        the WRITER-side count kernels ``set_stats_recording`` dispatched
+        as each map batch was written — valid under free_only: folding
+        them costs ONE deferred readback of a few int32s per map batch
+        (no payload downloads, no read-time kernels, no re-upload of
+        spilled entries), cached afterwards. When a shuffle carries
+        writes the writer-side stats cannot account (pre-split views,
+        or recording was off), free_only reports None and the adaptive
+        reader passes through; without free_only the legacy read-side
+        path computes per-entry live row counts (sorted pids +
+        searchsorted) scaled to entry bytes — ONE host readback per
+        shuffle, paid only when an AQE read asks (SURVEY.md:161)."""
+        n_reg = self._nparts.get(shuffle_id)
+        if n_reg is not None:
+            with self._lock:
+                dirty = self._wstats_dirty.get(shuffle_id, False)
+            if not dirty:
+                self._fold_pending_stats(shuffle_id)
+                with self._lock:
+                    w = self._wstats.get(shuffle_id)
+                if w is not None:
+                    return [int(v) for v in w]
+                if n_reg == 1 and self._shuffles.get(shuffle_id):
+                    # nothing to adapt; capacity metadata is exact
+                    # enough and free
+                    return [self.stage_bytes(shuffle_id)]
         if free_only:
             return None
         import jax
@@ -249,7 +358,7 @@ class LocalShuffleTransport(ShuffleTransport):
     def writer(self, shuffle_id: int, map_id: int) -> ShuffleWriteHandle:
         with self._lock:
             store = self._shuffles.setdefault(shuffle_id, {})
-        return _LocalWriter(self, store, map_id)
+        return _LocalWriter(self, store, map_id, shuffle_id)
 
     def read_partition(self, shuffle_id: int, partition_id: int):
         store = self._shuffles.get(shuffle_id, {})
@@ -265,5 +374,8 @@ class LocalShuffleTransport(ShuffleTransport):
         with self._lock:
             store = self._shuffles.pop(shuffle_id, None)
             self._nparts.pop(shuffle_id, None)
+            self._wstats.pop(shuffle_id, None)
+            self._wstats_pending.pop(shuffle_id, None)
+            self._wstats_dirty.pop(shuffle_id, None)
         for _, entry in (store or {}).get(None, []):
             entry.release()

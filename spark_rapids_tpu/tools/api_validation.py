@@ -98,15 +98,22 @@ def generate_supported_ops() -> str:
         "", "## Format notes", "",
         "- Parquet device decode "
         "(`spark.rapids.sql.format.parquet.deviceDecode.enabled`): the "
-        "supported envelope is unchanged by the overlapped/coalesced "
-        "upload tunnel — v1 data pages of flat int32/int64/float/"
-        "double/boolean in PLAIN / PLAIN_DICTIONARY / RLE_DICTIONARY "
-        "encodings (plus dictionary-encoded strings), snappy/zstd/gzip/"
-        "brotli codecs, definition depth <= 1. Everything else "
-        "(nested, v2 pages, DELTA_*, LZ4, PLAIN strings) still decodes "
-        "on host per column chunk, and pipelining/coalescing never "
-        "widens that envelope: coalesced row groups merge only when "
-        "every column takes the same (device or host) route.",
+        "envelope covers v1 AND v2 (DATA_PAGE_V2) data pages of flat "
+        "int32/int64/float/double/boolean/string columns in PLAIN "
+        "(including PLAIN BYTE_ARRAY strings — length prefixes walked "
+        "host-side, characters gathered on device), PLAIN_DICTIONARY /"
+        " RLE_DICTIONARY (dictionary-then-PLAIN mixed chunks "
+        "included), DELTA_BINARY_PACKED (device prefix-sum "
+        "reconstruction; miniblock widths <= 32 bits) and "
+        "DELTA_LENGTH_BYTE_ARRAY encodings, under snappy/zstd/gzip/"
+        "brotli codecs, definition depth <= 1. Chunks still outside "
+        "it (nested, FIXED_LEN_BYTE_ARRAY, DELTA_BYTE_ARRAY, "
+        "BYTE_STREAM_SPLIT, LZ4) decode on host per column chunk, "
+        "counted per bounded reason in "
+        "`rapids_scan_fallback_chunks_total` and the scan's "
+        "`deviceChunks`/`fallbackChunks` metrics; coalesced row "
+        "groups merge only when every column takes the same (device "
+        "or host) route.",
     ]
     return "\n".join(lines)
 

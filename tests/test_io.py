@@ -185,13 +185,16 @@ def test_parquet_device_decode_dict_strings(tmp_path):
     m = ctx.metrics[scan.node_label()]
     # the string chunks were device-decoded (they count toward encoded)
     assert m["encodedBytes"].value > 0
-    # PLAIN (non-dict) strings still fall back per chunk
+    # high-cardinality strings overflow the dictionary into PLAIN pages
+    # mid-chunk — since the envelope widened, those decode on device too
     many = pa.table({"u": pa.array([f"unique-{i}" * 3
                                     for i in range(n)])})
     p2 = os.path.join(str(tmp_path), "plain.parquet")
     pq.write_table(many, p2, dictionary_pagesize_limit=1024,
                    compression="snappy")
     assert_tpu_and_cpu_plan_equal(TpuFileScanExec([p2]))
+    _, dev2, fb2 = _scan_coverage(p2)
+    assert fb2 == 0 and dev2 > 0, (dev2, fb2)
 
 
 def test_parquet_device_decode_coalesced_bit_exact(tmp_path):
@@ -273,9 +276,20 @@ def test_parquet_device_decode_jit_cache_quantized(tmp_path):
     assert len(pd_._JIT_CACHE) == before
 
 
+def _scan_coverage(path, conf=None):
+    """(arrow table via device path, deviceChunks, fallbackChunks)."""
+    conf = conf or RapidsConf()
+    scan = TpuFileScanExec([path], conf=conf)
+    ctx = ExecCtx(conf)
+    got = pa.Table.from_batches([_to_arrow(b) for b in scan.execute(ctx)])
+    m = ctx.metrics[scan.node_label()]
+    return got, int(m["deviceChunks"].value), int(m["fallbackChunks"].value)
+
+
 def test_parquet_device_decode_fallback_encodings(tmp_path):
-    """DELTA_BINARY_PACKED / byte-stream-split chunks are outside the
-    device envelope: per-chunk host fallback keeps results right."""
+    """DELTA_BINARY_PACKED is now INSIDE the device envelope;
+    byte-stream-split is still outside — the per-chunk fallback keeps
+    results right and the coverage counters tell the two apart."""
     rng = np.random.default_rng(8)
     n = 5000
     tab = pa.table({
@@ -289,14 +303,183 @@ def test_parquet_device_decode_fallback_encodings(tmp_path):
                                     "bss": "BYTE_STREAM_SPLIT",
                                     "ok": "PLAIN"})
     assert_tpu_and_cpu_plan_equal(TpuFileScanExec([p]))
+    got, dev, fb = _scan_coverage(p)
+    assert fb == 1, (dev, fb)   # only the BYTE_STREAM_SPLIT chunk
+    assert dev == 2, (dev, fb)  # delta + plain decode on device
 
 
-def test_parquet_device_decode_v2_pages_fallback(tmp_path):
-    rb = gen_table([IntegerGen(), LongGen(), FloatGen(dt.FLOAT64)], n=800)
+def test_parquet_device_decode_v2_pages(tmp_path):
+    """DATA_PAGE_V2 files decode ON DEVICE now (levels split from the
+    data region, no length prefix, nulls from the page header):
+    bit-exact vs the CPU oracle, zero fallback chunks."""
+    rng = np.random.default_rng(21)
+    n = 12_000
+    arrays = {
+        "i32": pa.array(rng.integers(0, 9, n).astype(np.int32)),
+        "ni64": pa.array(rng.integers(0, 60, n).astype(np.int64),
+                         mask=rng.uniform(0, 1, n) < 0.3),
+        "s": pa.array([None if i % 9 == 0 else f"v{i % 13}"
+                       for i in range(n)]),
+        "b": pa.array(rng.integers(0, 2, n).astype(bool)),
+        "all_null": pa.array([None] * n, type=pa.int32()),
+    }
     p = os.path.join(str(tmp_path), "v2.parquet")
-    pq.write_table(pa.Table.from_batches([rb]), p,
-                   data_page_version="2.0")
-    assert_tpu_and_cpu_plan_equal(TpuFileScanExec([p]))
+    pq.write_table(pa.table(arrays), p, data_page_version="2.0",
+                   row_group_size=4000, compression="zstd",
+                   data_page_size=4 << 10)
+    got, dev, fb = _scan_coverage(p)
+    want = pa.Table.from_batches(
+        list(TpuFileScanExec([p]).execute_cpu(ExecCtx())))
+    assert _canon(got) == _canon(want)
+    assert fb == 0 and dev > 0, (dev, fb)
+
+
+def test_parquet_device_decode_plain_strings_matrix(tmp_path):
+    """PLAIN BYTE_ARRAY strings decode on device (host walks the
+    length prefixes into the store, device gathers the characters):
+    nulls, empty strings, unicode, v1 AND v2 pages, and the coalesced
+    path, all bit-exact vs the CPU oracle with zero fallbacks."""
+    rng = np.random.default_rng(23)
+    n = 12_000
+    cats = ["", "alpha", "β-unicode", "a-much-longer-plain-value",
+            "日本語テキスト", "x"]
+    arrays = {
+        "ps": pa.array([None if rng.uniform() < 0.25
+                        else cats[i % len(cats)] + str(i % 7)
+                        for i in range(n)]),
+        "pb": pa.array([None if i % 17 == 0 else b"\x00bin%d" % (i % 5)
+                        for i in range(n)], pa.binary()),
+        "i": pa.array(rng.integers(0, 1 << 16, n).astype(np.int32)),
+    }
+    for ver, codec in (("1.0", "snappy"), ("2.0", "zstd")):
+        p = os.path.join(str(tmp_path), f"ps_{ver}.parquet")
+        pq.write_table(pa.table(arrays), p, use_dictionary=False,
+                       data_page_version=ver, compression=codec,
+                       row_group_size=3000, data_page_size=8 << 10)
+        want = pa.Table.from_batches(
+            list(TpuFileScanExec([p]).execute_cpu(ExecCtx())))
+        for target in ("0", "1g"):
+            conf = RapidsConf(
+                {"spark.rapids.sql.scan.coalesceTargetBytes": target})
+            got, dev, fb = _scan_coverage(p, conf)
+            assert _canon(got) == _canon(want), (ver, target)
+            assert fb == 0 and dev > 0, (ver, target, dev, fb)
+
+
+def test_parquet_device_decode_delta_matrix(tmp_path):
+    """DELTA_BINARY_PACKED int32/int64 (negative deltas, nulls,
+    multi-page chunks — the device prefix sum restarts per page) and
+    DELTA_LENGTH_BYTE_ARRAY strings (nulls, empties): bit-exact vs the
+    CPU oracle across per-group and coalesced dispatch, zero
+    fallbacks."""
+    rng = np.random.default_rng(29)
+    n = 16_000
+    arrays = {
+        "d32": pa.array((rng.integers(-100, 100, n).cumsum()
+                         % 1_000_000).astype(np.int32)),
+        "d64": pa.array(rng.integers(-1000, 1000, n).cumsum()
+                        .astype(np.int64),
+                        mask=rng.uniform(0, 1, n) < 0.2),
+        "dls": pa.array([None if i % 11 == 0 else
+                         ["", f"dl-{i % 53}", "長い" * (i % 4)][i % 3]
+                        for i in range(n)]),
+    }
+    p = os.path.join(str(tmp_path), "delta.parquet")
+    pq.write_table(pa.table(arrays), p, use_dictionary=False,
+                   compression="snappy", row_group_size=4000,
+                   data_page_size=4 << 10,
+                   column_encoding={"d32": "DELTA_BINARY_PACKED",
+                                    "d64": "DELTA_BINARY_PACKED",
+                                    "dls": "DELTA_LENGTH_BYTE_ARRAY"})
+    want = pa.Table.from_batches(
+        list(TpuFileScanExec([p]).execute_cpu(ExecCtx())))
+    for target in ("0", "1g"):
+        conf = RapidsConf(
+            {"spark.rapids.sql.scan.coalesceTargetBytes": target})
+        got, dev, fb = _scan_coverage(p, conf)
+        assert _canon(got) == _canon(want), target
+        assert fb == 0 and dev > 0, (target, dev, fb)
+
+
+def test_delta_stream_truncation_classified():
+    """A truncated DELTA stream must surface as a classified
+    HostFallback(reason='truncated') — never an IndexError escaping the
+    per-chunk fallback net (code-review r7)."""
+    from spark_rapids_tpu.io.parquet_device import (
+        HostFallback, _decode_delta_ints, _plan_delta_page)
+    # valid header (block 128, 4 miniblocks, 100 values, first 0) with
+    # the block payload cut off
+    hdr = b"\x80\x01" + b"\x04" + b"\x64" + b"\x00"
+    for trunc in (hdr,                      # cut at min_delta
+                  hdr + b"\x02",            # cut inside the widths
+                  hdr + b"\x02" + b"\x08" * 4):  # widths, no payload
+        with pytest.raises(HostFallback) as ei:
+            _decode_delta_ints(trunc, 0)
+        assert ei.value.reason == "truncated", trunc
+        with pytest.raises(HostFallback) as ei:
+            _plan_delta_page(trunc, 0, 100)
+        assert ei.value.reason == "truncated", trunc
+
+
+def test_parquet_device_decode_mixed_dict_plain_strings(tmp_path):
+    """A chunk whose dictionary page overflows mid-write (dict pages
+    then PLAIN pages in ONE column chunk) decodes on device: dict runs
+    index the dictionary slice of the store, identity runs index their
+    page's slice — nulls included, coalesced included."""
+    rng = np.random.default_rng(31)
+    n = 20_000
+    tab = pa.table({
+        "u": pa.array([None if i % 13 == 0
+                       else f"val-{i}-{'pad' * (i % 3)}"
+                       for i in range(n)]),
+        "k": pa.array(rng.integers(0, 5, n).astype(np.int32)),
+    })
+    p = os.path.join(str(tmp_path), "mixed.parquet")
+    pq.write_table(tab, p, dictionary_pagesize_limit=2048,
+                   compression="zstd", row_group_size=5000,
+                   data_page_size=4096)
+    want = pa.Table.from_batches(
+        list(TpuFileScanExec([p]).execute_cpu(ExecCtx())))
+    for target in ("0", "1g"):
+        conf = RapidsConf(
+            {"spark.rapids.sql.scan.coalesceTargetBytes": target})
+        got, dev, fb = _scan_coverage(p, conf)
+        assert _canon(got) == _canon(want), target
+        assert fb == 0 and dev > 0, (target, dev, fb)
+
+
+def test_parquet_device_decode_string_jit_cache_quantized(tmp_path):
+    """String-gather variants share the quantized JIT cache: similar
+    heterogeneous PLAIN-string row groups must collapse to a couple of
+    fused-program variants per capacity bucket, and a re-scan compiles
+    nothing new."""
+    from spark_rapids_tpu.io import parquet_device as pd_
+    rng = np.random.default_rng(37)
+    n = 24_000
+    grp = np.arange(n) // 8000
+    tab = pa.table({
+        "s": pa.array([f"g{g}-{'x' * int(rng.integers(3, 9))}-{i % 11}"
+                       for i, g in enumerate(grp)]),
+        "i": pa.array((rng.integers(0, 50, n) + grp * 100)
+                      .astype(np.int64)),
+    })
+    p = os.path.join(str(tmp_path), "sq.parquet")
+    pq.write_table(tab, p, use_dictionary=False, compression="snappy",
+                   row_group_size=8000)
+    conf = RapidsConf(
+        {"spark.rapids.sql.scan.coalesceTargetBytes": "0"})
+    pd_._JIT_CACHE.clear()
+    got, dev, fb = _scan_coverage(p, conf)
+    assert fb == 0, (dev, fb)
+    want = pa.Table.from_batches(
+        list(TpuFileScanExec([p]).execute_cpu(ExecCtx())))
+    assert _canon(got) == _canon(want)
+    keys = [k for k in pd_._JIT_CACHE if k[0] == "rg"]
+    caps = {k[1] for k in keys}
+    assert len(keys) <= 2 * len(caps), keys
+    before = len(pd_._JIT_CACHE)
+    list(TpuFileScanExec([p], conf=conf).execute(ExecCtx(conf)))
+    assert len(pd_._JIT_CACHE) == before
 
 
 def test_csv_scan(tmp_path):

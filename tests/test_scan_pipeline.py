@@ -100,6 +100,50 @@ def test_bounded_inflight_under_slow_consumer():
     assert state["max_excess"] <= 3 + 1, state
 
 
+def test_weight_bounded_inflight():
+    """With a weigher, the in-flight window is bounded in summed weight
+    too: heavy items (the widened envelope's string blobs) must not
+    stack up to `window` at once; a single over-budget item still
+    admits alone (progress, not deadlock)."""
+    lock = threading.Lock()
+    state = {"inflight": 0, "max_w": 0}
+    weights = [10, 10, 100, 10, 250, 10, 10, 10]  # 250 > max_weight
+
+    def fn(i):
+        with lock:
+            state["inflight"] += weights[i]
+            state["max_w"] = max(state["max_w"], state["inflight"])
+        time.sleep(0.003)
+        return i
+
+    out = []
+    for i in pipelined_map(fn, range(len(weights)), threads=4, window=8,
+                           weigher=lambda i: weights[i],
+                           max_weight=120):
+        with lock:
+            state["inflight"] -= weights[i]
+        out.append(i)
+    assert out == list(range(len(weights)))
+    # admitted weight never exceeds budget + one in-hand-over item,
+    # except the single over-budget item which runs alone
+    assert state["max_w"] <= 250 + 10, state
+
+
+def test_weigher_exception_is_source_exception():
+    def bad_weigher(i):
+        if i == 2:
+            raise RuntimeError("weigher boom")
+        return 1
+
+    got = []
+    gen = pipelined_map(lambda x: x, range(5), threads=2, window=2,
+                        weigher=bad_weigher, max_weight=10)
+    with pytest.raises(RuntimeError, match="weigher boom"):
+        for v in gen:
+            got.append(v)
+    assert got == [0, 1]
+
+
 # --- device-decode scan pipeline -------------------------------------------
 
 def _write_rg_file(tmp_path, n=8000, rg=2000, name="f.parquet"):

@@ -461,6 +461,136 @@ def test_host_shuffle_files_cleaned_up():
     assert not os.path.exists(t.root)
 
 
+def test_host_shuffle_writer_side_partition_stats():
+    """The host transport records per-partition byte counts at WRITE
+    time (the writer downloaded + split the map batch anyway) and
+    serves them under free_only with no device access; a FRESH
+    transport over the same root rebuilds them from the committed
+    manifests' `raw` entries."""
+    import os
+    import pyarrow as pa
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.shuffle.host import HostShuffleTransport
+    from spark_rapids_tpu.columnar.arrow_bridge import arrow_to_device
+    t = HostShuffleTransport(threads=0)
+    try:
+        t.register_shuffle(7, 3)
+        rb = pa.record_batch({"v": pa.array(list(range(90)), pa.int64())})
+        b = arrow_to_device(rb)
+        w = t.writer(7, 0)
+        # pre-split writes: partition 0 twice as large as partition 2
+        w.write(0, arrow_to_device(rb.slice(0, 60)))
+        w.write(2, arrow_to_device(rb.slice(60, 30)))
+        w.close()
+        stats = t.partition_stats(7, free_only=True)
+        assert stats is not None and len(stats) == 3
+        assert stats[1] == 0 and stats[0] > stats[2] > 0, stats
+        assert t.stage_bytes(7) == sum(stats)
+        # attempt-protocol writes land in the stats only when committed
+        d = t.begin_task_attempt(7, "m9", 0)
+        sub = t.writer(7, 9, subdir=d)
+        sub.write(1, b)
+        sub.close()
+        assert t.commit_task_attempt(7, "m9", 0)
+        stats2 = t.partition_stats(7, free_only=True)
+        assert stats2[1] > 0, stats2
+        # a fresh instance over the same root: this shuffle mixes flat
+        # legacy blocks (no recorded byte counts) with a committed
+        # manifest — partial stats would mis-plan coalescing, so the
+        # rebuild WITHHOLDS rather than misleads
+        t2 = HostShuffleTransport(threads=0, root=t.root)
+        try:
+            assert t2.partition_stats(7, free_only=True) is None
+            # a shuffle whose root holds ONLY committed manifests
+            # rebuilds exactly
+            t.register_shuffle(8, 3)
+            d8 = t.begin_task_attempt(8, "m0", 0)
+            w8 = t.writer(8, 8, subdir=d8)
+            w8.write(1, b)
+            w8.close()
+            assert t.commit_task_attempt(8, "m0", 0)
+            want8 = t.partition_stats(8, free_only=True)
+            rebuilt = t2.partition_stats(8, free_only=True)
+            assert rebuilt is not None and rebuilt[1] == want8[1] > 0, \
+                (rebuilt, want8)
+        finally:
+            t2._own_root = False
+            t2.close()
+    finally:
+        t.close()
+
+
+def test_host_shuffle_zombie_attempt_never_counts():
+    """Attempt-staged writes credit the stats at COMMIT, not at write:
+    an in-flight speculative duplicate must not transiently inflate a
+    partition for a concurrent AQE stats read, and losing/aborted
+    attempts never touch the stats at all."""
+    import pyarrow as pa
+    from spark_rapids_tpu.shuffle.host import HostShuffleTransport
+    from spark_rapids_tpu.columnar.arrow_bridge import arrow_to_device
+    t = HostShuffleTransport(threads=0)
+    try:
+        t.register_shuffle(3, 2)
+        rb = pa.record_batch({"v": pa.array(list(range(50)), pa.int64())})
+        b = arrow_to_device(rb)
+        d0 = t.begin_task_attempt(3, "m0", 0)
+        w0 = t.writer(3, 0, subdir=d0)
+        w0.write(0, b)
+        w0.close()
+        # staged but uncommitted: invisible to stats (no transient
+        # double-count window during speculation)
+        assert (t.partition_stats(3, free_only=True) or [0])[0] == 0
+        assert t.commit_task_attempt(3, "m0", 0)
+        committed = t.partition_stats(3, free_only=True)[0]
+        assert committed > 0
+        # a second attempt writes the same output then loses the race
+        d1 = t.begin_task_attempt(3, "m0", 1)
+        w1 = t.writer(3, 0, subdir=d1)
+        w1.write(0, b)
+        w1.close()
+        assert t.partition_stats(3, free_only=True)[0] == committed
+        assert not t.commit_task_attempt(3, "m0", 1)
+        assert t.partition_stats(3, free_only=True)[0] == committed
+        # an aborted attempt never counts either
+        d2 = t.begin_task_attempt(3, "m0", 2)
+        w2 = t.writer(3, 0, subdir=d2)
+        w2.write(0, b)
+        w2.close()
+        t.abort_task_attempt(3, "m0", 2)
+        assert t.partition_stats(3, free_only=True)[0] == committed
+    finally:
+        t.close()
+
+
+def test_local_transport_writer_side_stats_unsplit():
+    """LocalShuffleTransport with stats recording on: write_unsplit
+    folds per-partition counts in at write time, and free_only serves
+    them; with recording off the old behavior (None) is preserved."""
+    import jax.numpy as jnp
+    import pyarrow as pa
+    from spark_rapids_tpu.shuffle.transport import LocalShuffleTransport
+    from spark_rapids_tpu.columnar.arrow_bridge import arrow_to_device
+    rb = pa.record_batch({"v": pa.array(list(range(100)), pa.int64())})
+    b = arrow_to_device(rb)
+    pids = jnp.asarray((np.arange(b.capacity) % 4).astype(np.int32))
+    t = LocalShuffleTransport()
+    t.set_stats_recording(True)
+    t.register_shuffle(1, 4)
+    w = t.writer(1, 0)
+    w.write_unsplit(b, pids)
+    stats = t.partition_stats(1, free_only=True)
+    assert stats is not None and len(stats) == 4
+    assert all(s > 0 for s in stats), stats
+    t.unregister_shuffle(1)
+    t2 = LocalShuffleTransport()  # recording defaults off
+    t2.register_shuffle(2, 4)
+    w2 = t2.writer(2, 0)
+    w2.write_unsplit(b, pids)
+    assert t2.partition_stats(2, free_only=True) is None
+    assert t2.partition_stats(2) is not None  # sync path still works
+    t2.unregister_shuffle(2)
+
+
 def test_host_shuffle_bad_codec_rejected():
     from spark_rapids_tpu.config import RapidsConf
     from spark_rapids_tpu.shuffle.host import HostShuffleTransport

@@ -356,12 +356,17 @@ _SCAN_FAMILIES = ("rapids_scan_assemble_seconds",
                   "rapids_scan_upload_seconds")
 
 
-def run_scan_smoke(out_dir):
+def run_scan_smoke(out_dir, mixed=False):
     """Device-decode parquet scan smoke (CPU backend): run a small
     multi-row-group scan through the overlapped upload tunnel, check
     the rows against the host-decode oracle, assert the
     assemble/upload metric split exists, and dump the process metrics
-    registry for Prometheus validation. Returns the prom path."""
+    registry for Prometheus validation. With ``mixed`` the file
+    exercises the WIDENED decode envelope — PLAIN BYTE_ARRAY strings,
+    DATA_PAGE_V2 pages, DELTA_BINARY_PACKED ints and
+    DELTA_LENGTH_BYTE_ARRAY strings in one scan — and the smoke
+    asserts ZERO host-fallback chunks (the envelope-regression CI
+    gate). Returns the prom path."""
     import numpy as np
     import pyarrow as pa
     import pyarrow.parquet as pq
@@ -372,15 +377,44 @@ def run_scan_smoke(out_dir):
     from spark_rapids_tpu.obs.metrics import dump_prometheus
     rng = np.random.default_rng(0)
     n = 6000
-    t = pa.table({
-        "i": pa.array(rng.integers(0, 9, n).astype(np.int32)),
-        "f": pa.array(rng.uniform(0, 1, n)),
-        "ni": pa.array(rng.integers(0, 40, n).astype(np.int64),
-                       mask=rng.uniform(0, 1, n) < 0.2),
-        "s": pa.array([f"v{i % 11}" for i in range(n)]),
-    })
-    path = os.path.join(out_dir, "scan_smoke.parquet")
-    pq.write_table(t, path, row_group_size=1024, compression="snappy")
+    if mixed:
+        t = pa.table({
+            # PLAIN strings (dictionary disabled): nulls + empties
+            "ps": pa.array([None if i % 13 == 0 else
+                            ["", f"plain-{i % 97}", "uni-β"][i % 3]
+                            for i in range(n)]),
+            # DELTA_BINARY_PACKED int64 with nulls, negative deltas
+            "d64": pa.array(rng.integers(-500, 500, n).cumsum()
+                            .astype(np.int64),
+                            mask=rng.uniform(0, 1, n) < 0.2),
+            # DELTA_LENGTH_BYTE_ARRAY strings
+            "dls": pa.array([f"dl{i % 41}" + "x" * (i % 7)
+                             for i in range(n)]),
+            # plain int32 rides along
+            "i": pa.array(rng.integers(0, 1 << 20, n).astype(np.int32)),
+        })
+        path = os.path.join(out_dir, "scan_envelope_smoke.parquet")
+        # data_page_version 2.0 makes every data page a V2 page, so
+        # the file covers all three new encoding classes at once
+        pq.write_table(t, path, row_group_size=2048,
+                       compression="snappy", use_dictionary=False,
+                       data_page_version="2.0",
+                       column_encoding={
+                           "ps": "PLAIN",
+                           "d64": "DELTA_BINARY_PACKED",
+                           "dls": "DELTA_LENGTH_BYTE_ARRAY",
+                           "i": "PLAIN"})
+    else:
+        t = pa.table({
+            "i": pa.array(rng.integers(0, 9, n).astype(np.int32)),
+            "f": pa.array(rng.uniform(0, 1, n)),
+            "ni": pa.array(rng.integers(0, 40, n).astype(np.int64),
+                           mask=rng.uniform(0, 1, n) < 0.2),
+            "s": pa.array([f"v{i % 11}" for i in range(n)]),
+        })
+        path = os.path.join(out_dir, "scan_smoke.parquet")
+        pq.write_table(t, path, row_group_size=1024,
+                       compression="snappy")
     scan = TpuFileScanExec([path])
     ctx = ExecCtx()
     got = pa.Table.from_batches(
@@ -393,6 +427,13 @@ def run_scan_smoke(out_dir):
     missing = [name for name in _SCAN_METRICS if name not in m]
     assert not missing, f"scan metrics missing: {missing}"
     assert m["uploadTime"].value >= 0 and m["assembleTime"].value >= 0
+    assert "deviceChunks" in m and "fallbackChunks" in m, \
+        "decode-coverage metrics missing"
+    if mixed:
+        assert m["fallbackChunks"].value == 0, \
+            (f"widened-envelope smoke hit "
+             f"{m['fallbackChunks'].value} host-fallback chunks")
+        assert m["deviceChunks"].value > 0
     prom = dump_prometheus()
     missing = [f for f in _SCAN_FAMILIES if f + "_count" not in prom]
     assert not missing, f"obs families missing samples: {missing}"
@@ -411,6 +452,11 @@ def main(argv=None):
     ap.add_argument("--scan-smoke", metavar="DIR", dest="scan_smoke",
                     help="run a device-decode parquet scan, check the "
                          "assemble/upload metric split, emit + validate")
+    ap.add_argument("--mixed-encodings", action="store_true",
+                    dest="mixed_encodings",
+                    help="with --scan-smoke: the file exercises PLAIN "
+                         "strings + DATA_PAGE_V2 + DELTA_* and the "
+                         "smoke asserts zero host-fallback chunks")
     ap.add_argument("--flight", help="incident bundle JSON to validate")
     ap.add_argument("--flight-smoke", metavar="DIR", dest="flight_smoke",
                     help="run an injected-crash cluster query with "
@@ -433,7 +479,8 @@ def main(argv=None):
         print(f"smoke outputs: {trace} {prom}")
     if args.scan_smoke:
         os.makedirs(args.scan_smoke, exist_ok=True)
-        prom = run_scan_smoke(args.scan_smoke)
+        prom = run_scan_smoke(args.scan_smoke,
+                              mixed=args.mixed_encodings)
         print(f"scan smoke output: {prom}")
     if args.flight_smoke:
         os.makedirs(args.flight_smoke, exist_ok=True)

@@ -23,40 +23,47 @@
 #      violations, JSON summary printed), SUPPORTED_OPS.md drift check,
 #      and a plan-verifier smoke (all 14 NDS corpus plans verify clean;
 #      one seeded-broken plan must be rejected with a named reason)
+#   9. widened-envelope scan smoke: a mixed-encoding parquet file
+#      (PLAIN strings + DATA_PAGE_V2 + DELTA_BINARY_PACKED +
+#      DELTA_LENGTH_BYTE_ARRAY) must decode entirely on device —
+#      zero host-fallback chunks — and match the host oracle
 #
 # Pass --full to also run the tier-1 suite (see ROADMAP.md), bounded to
 # 870s like the driver's own gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/8 compileall =="
+echo "== 1/9 compileall =="
 python -m compileall -q spark_rapids_tpu tests
 
-echo "== 2/8 package import =="
+echo "== 2/9 package import =="
 JAX_PLATFORMS=cpu python -c "import spark_rapids_tpu; print('import ok:', spark_rapids_tpu.__name__)"
 
-echo "== 3/8 pytest collection =="
+echo "== 3/9 pytest collection =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q --collect-only -m 'not slow' \
     -p no:cacheprovider 2>&1 | tail -3
 
-echo "== 4/8 observability smoke =="
+echo "== 4/9 observability smoke =="
 OBS_TMP="$(mktemp -d)"
 trap 'rm -rf "$OBS_TMP"' EXIT
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --smoke "$OBS_TMP"
 
-echo "== 5/8 device-decode scan smoke =="
+echo "== 5/9 device-decode scan smoke =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --scan-smoke "$OBS_TMP/scan"
 
-echo "== 6/8 flight-recorder smoke =="
+echo "== 6/9 flight-recorder smoke =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --flight-smoke "$OBS_TMP/flight"
 
-echo "== 7/8 shuffle-durability smoke =="
+echo "== 7/9 shuffle-durability smoke =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --shuffle-smoke "$OBS_TMP/shuffle"
 
-echo "== 8/8 static analysis (tpu-lint + plan verifier) =="
+echo "== 8/9 static analysis (tpu-lint + plan verifier) =="
 JAX_PLATFORMS=cpu python tools/tpu_lint.py --json
 JAX_PLATFORMS=cpu python tools/tpu_lint.py --check-docs
 JAX_PLATFORMS=cpu python -m spark_rapids_tpu.analysis.plan_verifier --smoke
+
+echo "== 9/9 widened-envelope scan smoke (mixed encodings) =="
+JAX_PLATFORMS=cpu python tools/check_obs_output.py --scan-smoke "$OBS_TMP/scan-envelope" --mixed-encodings
 
 if [[ "${1:-}" == "--full" ]]; then
     echo "== tier-1 (full) =="
