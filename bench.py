@@ -235,7 +235,7 @@ def setup_join_groupby(n_li=1 << 23, n_ord=1 << 17):
     return run, host_run, finish_check, n_li
 
 
-def bench_nds_from_files(tmp_dir, n_sales=1 << 20):
+def bench_nds_from_files(tmp_dir, n_sales=1 << 20, use_sql=True):
     """NDS-shaped queries with the SCAN in the timed region
     (VERDICT r4 weak #2: the cached geomean is compute-only): tables
     written as snappy parquet once, then per query the engine pipeline
@@ -253,8 +253,11 @@ def bench_nds_from_files(tmp_dir, n_sales=1 << 20):
     from spark_rapids_tpu.exec.base import ExecCtx
     from spark_rapids_tpu.planner import TpuOverrides
     from spark_rapids_tpu.session import TpuSession
-    from spark_rapids_tpu.tools.nds import (build_query, gen_tables,
-                                            pandas_oracle)
+    from spark_rapids_tpu.tools.nds import (build_query,
+                                            build_query_sql, gen_tables,
+                                            pandas_oracle,
+                                            register_frames)
+    build = build_query_sql if use_sql else build_query
     order = ["q3", "q55"]
     tables = gen_tables(n_sales=n_sales)
     # cache keyed by the data shape: a gen_tables/n_sales change must
@@ -271,6 +274,7 @@ def bench_nds_from_files(tmp_dir, n_sales=1 << 20):
     s = TpuSession(conf={"spark.sql.shuffle.partitions": "1"})
     frames = {name: s.read_parquet(p) for name, p in paths.items()}
     s._nds_frames = (tables, frames)
+    register_frames(s, frames)  # SQL texts resolve the same scans
     results = {}
     ratios = []
     outs = {}
@@ -279,7 +283,7 @@ def bench_nds_from_files(tmp_dir, n_sales=1 << 20):
     # regression tripwire — acceptance wants ZERO fallbacks here)
     chunks = {"device": 0, "fallback": 0}
     for name in order:
-        df = build_query(name, s, tables)
+        df = build(name, s, tables)
         pp = TpuOverrides(s.conf).apply(df._node)
         ctx = ExecCtx(s.conf)
 
@@ -329,7 +333,7 @@ def bench_nds_from_files(tmp_dir, n_sales=1 << 20):
         pdt = {n2: pq.read_table(p).to_pandas()
                for n2, p in paths.items()}
         for name in order:
-            df = build_query(name, s, tables)
+            df = build(name, s, tables)
             rbs = [device_to_arrow(b) for b in outs[name]]
             got = pa.Table.from_batches(
                 rbs, schema=arrow_schema(df._node.output_schema)) \
@@ -348,7 +352,7 @@ def bench_nds_from_files(tmp_dir, n_sales=1 << 20):
     return round(geomean, 3), results, verify, chunks
 
 
-def bench_nds_subset(n_sales=1 << 21):
+def bench_nds_subset(n_sales=1 << 21, use_sql=True):
     """TPC-DS-shaped corpus (spark_rapids_tpu.tools.nds): per query,
     device wall time through the full session/planner path vs the
     pandas oracle on the same tables; returns (geomean vs host,
@@ -363,9 +367,12 @@ def bench_nds_subset(n_sales=1 << 21):
 
     from spark_rapids_tpu.planner import TpuOverrides
     from spark_rapids_tpu.session import TpuSession
-    from spark_rapids_tpu.tools.nds import (build_query, gen_tables,
-                                            pandas_frames, pandas_oracle)
-    # six of the twelve corpus queries: the full set lives in
+    from spark_rapids_tpu.tools.nds import (build_query,
+                                            build_query_sql, gen_tables,
+                                            pandas_frames, pandas_oracle,
+                                            register_frames)
+    build = build_query_sql if use_sql else build_query
+    # six of the corpus queries: the full set lives in
     # tests/test_nds.py; the bench subset bounds FIRST-RUN XLA compile
     # time through the tunnel (each fresh sort/agg program costs
     # minutes to compile there; all are persistent-cached afterwards)
@@ -382,13 +389,14 @@ def bench_nds_subset(n_sales=1 << 21):
     for k in list(frames):
         frames[k] = frames[k].cache()
     s._nds_frames = (tables, frames)
+    register_frames(s, frames)  # SQL texts see the same cached inputs
     from spark_rapids_tpu.exec.base import ExecCtx
     pd_frames = pandas_frames(tables)  # hoisted: matches cached device
     results = {}
     ratios = []
     outs = {}
     for name in order:
-        df = build_query(name, s, tables)
+        df = build(name, s, tables)
         pp = TpuOverrides(s.conf).apply(df._node)
         ctx = ExecCtx(s.conf)
 
@@ -426,7 +434,7 @@ def bench_nds_subset(n_sales=1 << 21):
         from spark_rapids_tpu.columnar.arrow_bridge import (
             arrow_schema, device_to_arrow)
         for name in order:
-            df = build_query(name, s, tables)
+            df = build(name, s, tables)
             bs = outs[name]
             if bs and not isinstance(bs[0], _pa.RecordBatch):
                 rbs = [device_to_arrow(b) for b in bs]
@@ -874,6 +882,10 @@ def main():
             round(join_rows / join_sync_t / 1e6, 2),
         "nds_subset_geomean_vs_host": nds_geomean,
         "nds_subset_detail": nds_detail,
+        # the corpus is driven from SQL text (tools/nds.py SQL_QUERIES
+        # through session.sql) — the hand-built plans remain only as
+        # the dual-run oracle counterpart
+        "nds_driven_from_sql": True,
         # scans in the timed region (VERDICT r4 weak #2): engine
         # files->device-decode->query vs pandas read_parquet + compute
         "nds_subset_from_files_vs_host": nds_files_geo,

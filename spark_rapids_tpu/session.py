@@ -372,12 +372,63 @@ class DataFrame:
 
 
 class TpuSession:
-    """The SparkSession analog: conf + DataFrame builders."""
+    """The SparkSession analog: conf + DataFrame builders + a temp-view
+    catalog feeding the SQL frontend (``session.sql``)."""
 
     def __init__(self, conf: Optional[Union[RapidsConf, Dict]] = None):
         if isinstance(conf, dict):
             conf = RapidsConf(conf)
         self.conf = conf or RapidsConf()
+        self._tables: Dict[str, DataFrame] = {}
+
+    # --- SQL frontend -----------------------------------------------------
+    def register_table(self, name: str, df: Union["DataFrame",
+                                                  pa.Table, dict]):
+        """Register a DataFrame (or anything create_dataframe accepts)
+        as a temp view for ``sql()`` — createOrReplaceTempView analog.
+        Names resolve case-insensitively; WITH-clause CTEs shadow
+        catalog names."""
+        if not isinstance(df, DataFrame):
+            df = self.create_dataframe(df)
+        self._tables[name.lower()] = df
+        return df
+
+    create_or_replace_temp_view = register_table
+
+    def table(self, name: str) -> "DataFrame":
+        df = self._tables.get(name.lower())
+        if df is None:
+            raise KeyError(f"table or view {name!r} is not registered")
+        return df
+
+    def _catalog_node(self, name: str):
+        """SQL-compiler hook: exec node for a registered view, or
+        None."""
+        df = self._tables.get(name.lower())
+        return df._node if df is not None else None
+
+    def sql(self, text: str) -> Union["DataFrame", str]:
+        """Compile a SQL query into a DataFrame over the same planner
+        path DataFrames use. ``EXPLAIN <query>`` returns the
+        placement-annotated plan text instead (``EXPLAIN FORMATTED``
+        the full operator tree) without executing. Parse/analysis
+        failures raise SqlParseError / SqlAnalysisError and leave one
+        event-log line (type = the error slug) when
+        ``spark.rapids.eventLog.dir`` is set."""
+        from .sql import SqlError, sql_to_plan
+        from .tools.event_log import log_sql_error
+        try:
+            node, stmt = sql_to_plan(text, self)
+        except SqlError as e:
+            log_sql_error(self.conf, e, text)
+            raise
+        if stmt.explain:
+            from .planner import TpuOverrides
+            pp = TpuOverrides(self.conf).apply(node)
+            if stmt.formatted:
+                return pp.root.tree_string()
+            return pp.explain("ALL")
+        return DataFrame(node, self)
 
     # --- builders ---------------------------------------------------------
     def create_dataframe(self, data) -> DataFrame:

@@ -1,0 +1,313 @@
+"""SQL function registry: name -> expression builder.
+
+Three namespaces — scalar, aggregate, window — all mapping onto the
+existing ``expr/*`` classes (the registry is the SAME surface the
+planner's per-expression kill switches and SUPPORTED_OPS.md already
+govern; nothing here adds evaluation code). Builders receive the
+compiled engine child expressions plus the raw AST args (for
+parameters that must be literals, e.g. ``round``'s digit count) and
+raise ``SqlAnalysisError`` with a stable ``detail`` code on unknown
+names or bad arity.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from . import ast as A
+from .errors import SqlAnalysisError
+
+__all__ = ["SCALAR_FUNCTIONS", "AGGREGATE_FUNCTIONS",
+           "WINDOW_FUNCTIONS", "is_aggregate_name", "build_scalar",
+           "build_aggregate", "build_window", "dialect_function_names"]
+
+
+def _err(msg, node: A.Node, detail: str, sql: str = ""):
+    return SqlAnalysisError(msg, sql, node.loc, detail)
+
+
+def _arity(name, node, args, lo, hi=None, sql=""):
+    hi = lo if hi is None else hi
+    if not (lo <= len(args) <= hi):
+        want = str(lo) if lo == hi else f"{lo}..{hi}"
+        raise _err(f"{name}() takes {want} argument(s), got "
+                   f"{len(args)}", node, "bad_arity", sql)
+
+
+def _lit_arg(name, node: A.Func, i, types, what, sql=""):
+    """The i-th AST argument, required to be a literal of given types."""
+    a = node.args[i]
+    if not isinstance(a, A.Lit) or not isinstance(a.value, types) \
+            or isinstance(a.value, bool):
+        raise _err(f"{name}() argument {i + 1} must be a {what} "
+                   "literal", node, "literal_required", sql)
+    return a.value
+
+
+# --- scalar ---------------------------------------------------------------
+
+def _simple(cls, lo, hi=None):
+    def build(node, args, sql):
+        _arity(node.name, node, args, lo, hi, sql)
+        return cls(*args)
+    return build
+
+
+def _varargs(cls, lo):
+    def build(node, args, sql):
+        if len(args) < lo:
+            raise _err(f"{node.name}() takes at least {lo} arguments",
+                       node, "bad_arity", sql)
+        return cls(*args)
+    return build
+
+
+def _build_round(half_even):
+    def build(node, args, sql):
+        from ..expr.math import BRound, Round
+        _arity(node.name, node, args, 1, 2, sql)
+        digits = 0
+        if len(node.args) == 2:
+            digits = _lit_arg(node.name, node, 1, int, "integer", sql)
+        cls = BRound if half_even else Round
+        return cls(args[0], digits)
+    return build
+
+
+def _build_log(node, args, sql):
+    from ..expr.math import Log
+    _arity("log", node, args, 1, 1, sql)
+    return Log(args[0])
+
+
+def _build_if(node, args, sql):
+    from ..expr.conditional import If
+    _arity("if", node, args, 3, 3, sql)
+    return If(args[0], args[1], args[2])
+
+
+def _scalar_table() -> Dict[str, Callable]:
+    from ..expr import (Abs, Acos, AddMonths, Asin, Atan, Atan2, Cbrt,
+                        Ceil, Coalesce, ConcatStrings, Contains, Cos,
+                        DateAdd, DateDiff, DateSub, DayOfMonth,
+                        DayOfWeek, DayOfYear, EndsWith, Exp, Floor,
+                        FromUnixTime, Greatest, Hour, IsNaN, LastDay,
+                        Least, Length, Log10, Log2, Lower, Minute,
+                        Month, MonthsBetween, NullIf, Pow, Quarter,
+                        Reverse, Second, Signum, Sin, Sqrt, StartsWith,
+                        StringLocate, StringLpad, StringRepeat,
+                        StringReplace, StringRpad, StringTrim,
+                        StringTrimLeft, StringTrimRight, Substring,
+                        Tan, TruncDate, UnixTimestamp, Upper, WeekDay,
+                        Year)
+    t = {
+        "abs": _simple(Abs, 1), "sqrt": _simple(Sqrt, 1),
+        "cbrt": _simple(Cbrt, 1), "exp": _simple(Exp, 1),
+        "ln": _build_log, "log": _build_log,
+        "log10": _simple(Log10, 1), "log2": _simple(Log2, 1),
+        "pow": _simple(Pow, 2), "power": _simple(Pow, 2),
+        "sin": _simple(Sin, 1), "cos": _simple(Cos, 1),
+        "tan": _simple(Tan, 1), "asin": _simple(Asin, 1),
+        "acos": _simple(Acos, 1), "atan": _simple(Atan, 1),
+        "atan2": _simple(Atan2, 2),
+        "floor": _simple(Floor, 1), "ceil": _simple(Ceil, 1),
+        "ceiling": _simple(Ceil, 1),
+        "sign": _simple(Signum, 1), "signum": _simple(Signum, 1),
+        "round": _build_round(False), "bround": _build_round(True),
+        "isnan": _simple(IsNaN, 1),
+        "length": _simple(Length, 1),
+        "char_length": _simple(Length, 1),
+        "upper": _simple(Upper, 1), "ucase": _simple(Upper, 1),
+        "lower": _simple(Lower, 1), "lcase": _simple(Lower, 1),
+        "substring": _simple(Substring, 3),
+        "substr": _simple(Substring, 3),
+        "concat": _varargs(ConcatStrings, 1),
+        "trim": _simple(StringTrim, 1),
+        "ltrim": _simple(StringTrimLeft, 1),
+        "rtrim": _simple(StringTrimRight, 1),
+        "replace": _simple(StringReplace, 3),
+        "locate": _simple(StringLocate, 2, 3),
+        "lpad": _simple(StringLpad, 3),
+        "rpad": _simple(StringRpad, 3),
+        "repeat": _simple(StringRepeat, 2),
+        "reverse": _simple(Reverse, 1),
+        "startswith": _simple(StartsWith, 2),
+        "endswith": _simple(EndsWith, 2),
+        "contains": _simple(Contains, 2),
+        "coalesce": _varargs(Coalesce, 1),
+        "nullif": _simple(NullIf, 2),
+        "least": _varargs(Least, 2),
+        "greatest": _varargs(Greatest, 2),
+        "if": _build_if,
+        "year": _simple(Year, 1), "month": _simple(Month, 1),
+        "day": _simple(DayOfMonth, 1),
+        "dayofmonth": _simple(DayOfMonth, 1),
+        "quarter": _simple(Quarter, 1),
+        "dayofweek": _simple(DayOfWeek, 1),
+        "weekday": _simple(WeekDay, 1),
+        "dayofyear": _simple(DayOfYear, 1),
+        "last_day": _simple(LastDay, 1),
+        "hour": _simple(Hour, 1), "minute": _simple(Minute, 1),
+        "second": _simple(Second, 1),
+        "date_add": _simple(DateAdd, 2),
+        "date_sub": _simple(DateSub, 2),
+        "datediff": _simple(DateDiff, 2),
+        "add_months": _simple(AddMonths, 2),
+        "months_between": _simple(MonthsBetween, 2),
+        "trunc": _simple(TruncDate, 2),
+        "unix_timestamp": _simple(UnixTimestamp, 1),
+        "from_unixtime": _simple(FromUnixTime, 1),
+    }
+    return t
+
+
+# --- aggregates -----------------------------------------------------------
+
+def _build_count(node, args, sql):
+    from ..expr.aggregates import Count
+    if node.star:
+        return Count()
+    _arity("count", node, args, 1, 1, sql)
+    if isinstance(node.args[0], A.Lit) and node.args[0].value is not None:
+        return Count()  # count(1) counts rows
+    return Count(args[0])
+
+
+def _build_approx_percentile(node, args, sql):
+    from ..expr.aggregates import ApproxPercentile
+    _arity("approx_percentile", node, args, 2, 3, sql)
+    pct = _lit_arg("approx_percentile", node, 1, (int, float),
+                   "numeric", sql)
+    acc = 10000
+    if len(node.args) == 3:
+        acc = _lit_arg("approx_percentile", node, 2, int, "integer",
+                       sql)
+    return ApproxPercentile(args[0], pct, acc)
+
+
+def _agg_table() -> Dict[str, Callable]:
+    from ..expr.aggregates import (Average, CollectList, CollectSet,
+                                   First, Last, Max, Min, StddevPop,
+                                   StddevSamp, Sum, VariancePop,
+                                   VarianceSamp)
+    return {
+        "sum": _simple(Sum, 1),
+        "count": _build_count,
+        "min": _simple(Min, 1), "max": _simple(Max, 1),
+        "avg": _simple(Average, 1), "mean": _simple(Average, 1),
+        "first": _simple(First, 1), "last": _simple(Last, 1),
+        "stddev": _simple(StddevSamp, 1),
+        "stddev_samp": _simple(StddevSamp, 1),
+        "stddev_pop": _simple(StddevPop, 1),
+        "variance": _simple(VarianceSamp, 1),
+        "var_samp": _simple(VarianceSamp, 1),
+        "var_pop": _simple(VariancePop, 1),
+        "collect_list": _simple(CollectList, 1),
+        "collect_set": _simple(CollectSet, 1),
+        "approx_percentile": _build_approx_percentile,
+    }
+
+
+# --- window ranking family ------------------------------------------------
+
+def _build_ntile(node, args, sql):
+    from ..expr.window import NTile
+    _arity("ntile", node, args, 1, 1, sql)
+    n = _lit_arg("ntile", node, 0, int, "integer", sql)
+    return NTile(n)
+
+
+def _build_offset(cls):
+    def build(node, args, sql):
+        name = node.name
+        _arity(name, node, args, 1, 3, sql)
+        offset = 1
+        if len(node.args) >= 2:
+            offset = _lit_arg(name, node, 1, int, "integer", sql)
+        default = args[2] if len(args) == 3 else None
+        return cls(args[0], offset, default)
+    return build
+
+
+def _window_table() -> Dict[str, Callable]:
+    from ..expr.window import (DenseRank, Lag, Lead, PercentRank, Rank,
+                               RowNumber)
+    return {
+        "row_number": _simple(RowNumber, 0),
+        "rank": _simple(Rank, 0),
+        "dense_rank": _simple(DenseRank, 0),
+        "percent_rank": _simple(PercentRank, 0),
+        "ntile": _build_ntile,
+        "lag": _build_offset(Lag),
+        "lead": _build_offset(Lead),
+    }
+
+
+SCALAR_FUNCTIONS = _scalar_table()
+AGGREGATE_FUNCTIONS = _agg_table()
+WINDOW_FUNCTIONS = _window_table()
+
+
+def is_aggregate_name(name: str) -> bool:
+    return name in AGGREGATE_FUNCTIONS
+
+
+def _no_distinct(node: A.Func, sql: str):
+    if node.distinct:
+        raise _err(f"{node.name}(DISTINCT ...) is not in the dialect "
+                   "subset", node, "unsupported_feature", sql)
+
+
+def build_scalar(node: A.Func, args: List, sql: str):
+    _no_distinct(node, sql)
+    b = SCALAR_FUNCTIONS.get(node.name)
+    if b is None:
+        kind = ("aggregate" if node.name in AGGREGATE_FUNCTIONS else
+                "window" if node.name in WINDOW_FUNCTIONS else None)
+        if kind is not None:
+            raise _err(f"{kind} function {node.name}() is not valid "
+                       "here", node, "misplaced_function", sql)
+        raise _err(f"unknown function {node.name}()", node,
+                   "unknown_function", sql)
+    try:
+        return b(node, args, sql)
+    except (TypeError, ValueError) as e:
+        raise _err(f"{node.name}(): {e}", node, "bad_arguments",
+                   sql) from e
+
+
+def build_aggregate(node: A.Func, args: List, sql: str):
+    _no_distinct(node, sql)
+    b = AGGREGATE_FUNCTIONS.get(node.name)
+    if b is None:
+        raise _err(f"unknown aggregate function {node.name}()", node,
+                   "unknown_function", sql)
+    try:
+        return b(node, args, sql)
+    except (TypeError, ValueError) as e:
+        raise _err(f"{node.name}(): {e}", node, "bad_arguments",
+                   sql) from e
+
+
+def build_window(node: A.Func, args: List, sql: str):
+    """Ranking-family window function (aggregates-over-windows build
+    through build_aggregate)."""
+    _no_distinct(node, sql)
+    b = WINDOW_FUNCTIONS.get(node.name)
+    if b is None:
+        raise _err(f"unknown window function {node.name}()", node,
+                   "unknown_function", sql)
+    try:
+        return b(node, args, sql)
+    except (TypeError, ValueError) as e:
+        raise _err(f"{node.name}(): {e}", node, "bad_arguments",
+                   sql) from e
+
+
+def dialect_function_names() -> Dict[str, List[str]]:
+    """The live registry, for the generated SUPPORTED_OPS.md dialect
+    note."""
+    return {
+        "scalar": sorted(SCALAR_FUNCTIONS),
+        "aggregate": sorted(AGGREGATE_FUNCTIONS),
+        "window": sorted(WINDOW_FUNCTIONS),
+    }

@@ -115,6 +115,15 @@ def generate_supported_ops() -> str:
         "groups merge only when every column takes the same (device "
         "or host) route.",
     ]
+    from ..sql import dialect_note
+    lines += [
+        "", "## SQL frontend", "",
+        "`TpuSession.sql(text)` compiles the dialect below through "
+        "the same planner path DataFrames use (section generated from "
+        "the live `spark_rapids_tpu/sql` registries).",
+        "",
+        dialect_note(),
+    ]
     return "\n".join(lines)
 
 

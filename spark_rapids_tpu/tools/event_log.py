@@ -23,7 +23,8 @@ import time
 from typing import Dict, Iterator, List, Optional
 
 __all__ = ["EVENT_LOG_DIR", "log_query_event", "log_scheduler_events",
-           "log_plan_rejected", "read_event_logs", "plan_fingerprint"]
+           "log_plan_rejected", "log_sql_error", "read_event_logs",
+           "plan_fingerprint"]
 
 from ..config import register
 
@@ -122,6 +123,23 @@ def log_plan_rejected(conf, report, root, query_id: str = "") -> None:
         "report": report.to_dict(),
         "plan": root.tree_string(),
     }
+    with open(_app_path(base), "a") as f:
+        f.write(json.dumps(event) + "\n")
+    _prune_event_logs(conf, base)
+
+
+def log_sql_error(conf, err, sql_text: str) -> None:
+    """Append one SQL frontend failure event (type = the error's
+    stable slug, ``sql_parse_error`` / ``sql_analysis_error``) with
+    line/col, detail code, and caret snippet — the "why didn't my SQL
+    run" record, mirroring ``plan_rejected``. No-op unless
+    spark.rapids.eventLog.dir is set."""
+    base = conf.get(EVENT_LOG_DIR)
+    if not base:
+        return
+    event = dict(err.to_dict())
+    event["ts"] = time.time()
+    event["sql"] = sql_text[:4000]
     with open(_app_path(base), "a") as f:
         f.write(json.dumps(event) + "\n")
     _prune_event_logs(conf, base)

@@ -20,7 +20,8 @@ from __future__ import annotations
 import numpy as np
 import pyarrow as pa
 
-__all__ = ["gen_tables", "QUERIES", "build_query", "pandas_oracle"]
+__all__ = ["gen_tables", "QUERIES", "SQL_QUERIES", "build_query",
+           "build_query_sql", "pandas_oracle", "register_frames"]
 
 
 def gen_tables(n_sales: int = 1 << 15, seed: int = 42):
@@ -87,6 +88,15 @@ def gen_tables(n_sales: int = 1 << 15, seed: int = 42):
 
 # --- query builders (session DataFrames) ----------------------------------
 
+def register_frames(session, frames):
+    """Expose corpus frames as SQL temp views (session catalog) — the
+    SQL texts in SQL_QUERIES resolve table names through these. Bench
+    harnesses that re-wrap frames (e.g. .cache()) re-register so the
+    SQL path sees the same cached inputs the hand-built path does."""
+    for k, df in frames.items():
+        session.register_table(k, df)
+
+
 def _frames(session, tables):
     """Session-memoized DataFrames for the corpus tables: repeated
     query builds share one frame per table, so bench harnesses can
@@ -97,6 +107,7 @@ def _frames(session, tables):
         return memo[1]
     f = {k: session.create_dataframe(t) for k, t in tables.items()}
     session._nds_frames = (tables, f)
+    register_frames(session, f)
     return f
 
 
@@ -558,6 +569,272 @@ def q_rolling_revenue_pd(pd, t):
         .reset_index(drop=True)
 
 
+def q52(session, t):
+    """q52 shape: brand revenue for one December (q3 cousin)."""
+    from ..expr.aggregates import Sum
+    from ..expr.predicates import And
+    f = _frames(session, t)
+    dd = f["date_dim"].filter(And(_cmp("==", "d_moy", 12),
+                                  _cmp("==", "d_year", 2001))) \
+        .select(_col("d_date_sk"), _col("d_year"))
+    it = f["item"].select(_col("i_item_sk"), _col("i_brand_id"))
+    df = (f["store_sales"]
+          .join(dd, on=[("ss_sold_date_sk", "d_date_sk")], build_unique=True)
+          .join(it, on=[("ss_item_sk", "i_item_sk")], build_unique=True)
+          .group_by("d_year", "i_brand_id")
+          .agg(_alias(Sum(_col("ss_ext_sales_price")), "ext_price"))
+          .order_by("d_year", "ext_price", "i_brand_id",
+                    ascending=[True, False, True])
+          .limit(10))
+    return df
+
+
+def q52_pd(pd, t):
+    ss, dd, it = t["store_sales"], t["date_dim"], t["item"]
+    d = dd[(dd.d_moy == 12) & (dd.d_year == 2001)]
+    j = ss.merge(d, left_on="ss_sold_date_sk", right_on="d_date_sk") \
+        .merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    g = j.groupby(["d_year", "i_brand_id"], as_index=False) \
+        .agg(ext_price=("ss_ext_sales_price", "sum"))
+    return g.sort_values(["d_year", "ext_price", "i_brand_id"],
+                         ascending=[True, False, True]).head(10)
+
+
+def q_cte(session, t):
+    """CTE shape: year-over-year revenue via a twice-referenced
+    year_rev CTE (expression join key d_year = prev + 1)."""
+    from .. import datatypes as dt_
+    from ..expr.aggregates import Sum
+    from ..expr.arithmetic import Add
+    from ..expr.base import Literal
+    f = _frames(session, t)
+    yr = (f["store_sales"]
+          .join(f["date_dim"], on=[("ss_sold_date_sk", "d_date_sk")],
+                build_unique=True)
+          .group_by("d_year")
+          .agg(_alias(Sum(_col("ss_ext_sales_price")), "rev")))
+    prev = yr.select(_alias(_col("d_year"), "py"),
+                     _alias(_col("rev"), "prev_rev"))
+    df = (yr.join(prev, on=[(_col("d_year"),
+                             Add(_col("py"), Literal(1, dt_.INT32)))])
+          .select(_col("d_year"), _col("rev"), _col("prev_rev"))
+          .order_by("d_year"))
+    return df
+
+
+def q_cte_pd(pd, t):
+    ss, dd = t["store_sales"], t["date_dim"]
+    j = ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+    g = j.groupby("d_year", as_index=False) \
+        .agg(rev=("ss_ext_sales_price", "sum"))
+    p = g.rename(columns={"rev": "prev_rev"}).copy()
+    p["jk"] = p["d_year"] + 1
+    m = g.merge(p[["jk", "prev_rev"]], left_on="d_year", right_on="jk")
+    return m[["d_year", "rev", "prev_rev"]].sort_values("d_year")
+
+
+def q_union(session, t):
+    """UNION ALL shape: per-state profit for two quarters stacked."""
+    from ..expr.aggregates import Sum
+    f = _frames(session, t)
+
+    def half(q):
+        return (f["store_sales"]
+                .join(f["date_dim"].filter(_cmp("==", "d_qoy", q))
+                      .select(_col("d_date_sk")),
+                      on=[("ss_sold_date_sk", "d_date_sk")],
+                      build_unique=True)
+                .join(f["store"], on=[("ss_store_sk", "s_store_sk")],
+                      build_unique=True)
+                .group_by("s_state")
+                .agg(_alias(Sum(_col("ss_net_profit")), "profit"))
+                .select(_alias(_lit(q), "qtr"), _col("s_state"),
+                        _col("profit")))
+
+    return half(1).union(half(2)).order_by("qtr", "s_state")
+
+
+def q_union_pd(pd, t):
+    ss, dd, st = t["store_sales"], t["date_dim"], t["store"]
+
+    def half(q):
+        j = ss.merge(dd[dd.d_qoy == q], left_on="ss_sold_date_sk",
+                     right_on="d_date_sk") \
+            .merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+        g = j.groupby("s_state", as_index=False) \
+            .agg(profit=("ss_net_profit", "sum"))
+        g.insert(0, "qtr", np.int32(q))
+        return g
+
+    out = pd.concat([half(1), half(2)], ignore_index=True)
+    return out.sort_values(["qtr", "s_state"])
+
+
+def q_having(session, t):
+    """HAVING shape: busy brands only (post-aggregation filter)."""
+    from .. import datatypes as dt_
+    from ..expr.aggregates import Count, Sum
+    from ..expr.base import Literal
+    from ..expr.predicates import GreaterThan
+    f = _frames(session, t)
+    it = f["item"].select(_col("i_item_sk"), _col("i_brand_id"))
+    df = (f["store_sales"]
+          .join(it, on=[("ss_item_sk", "i_item_sk")], build_unique=True)
+          .group_by("i_brand_id")
+          .agg(_alias(Count(), "n"),
+               _alias(Sum(_col("ss_ext_sales_price")), "rev"))
+          .filter(GreaterThan(_col("n"), Literal(250, dt_.INT64)))
+          .order_by("i_brand_id"))
+    return df
+
+
+def q_having_pd(pd, t):
+    ss, it = t["store_sales"], t["item"]
+    j = ss.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    g = j.groupby("i_brand_id", as_index=False).agg(
+        n=("ss_ext_sales_price", "size"),
+        rev=("ss_ext_sales_price", "sum"))
+    g = g[g.n > 250]
+    return g.sort_values("i_brand_id")
+
+
+def q_in_between(session, t):
+    """IN + BETWEEN shape: category revenue for a quantity band."""
+    from ..expr.aggregates import Sum
+    from ..expr.predicates import And, In
+    f = _frames(session, t)
+    it = f["item"].filter(In(_col("i_category"),
+                             ("Books", "Music", "Sports")))
+    df = (f["store_sales"]
+          .filter(And(_cmp(">=", "ss_quantity", 20),
+                      _cmp("<=", "ss_quantity", 40)))
+          .join(f["date_dim"].filter(_cmp("==", "d_year", 2000))
+                .select(_col("d_date_sk")),
+                on=[("ss_sold_date_sk", "d_date_sk")], build_unique=True)
+          .join(it, on=[("ss_item_sk", "i_item_sk")], build_unique=True)
+          .group_by("i_category")
+          .agg(_alias(Sum(_col("ss_ext_sales_price")), "rev"))
+          .order_by("i_category"))
+    return df
+
+
+def q_in_between_pd(pd, t):
+    ss, dd, it = t["store_sales"], t["date_dim"], t["item"]
+    s = ss[(ss.ss_quantity >= 20) & (ss.ss_quantity <= 40)]
+    j = s.merge(dd[dd.d_year == 2000], left_on="ss_sold_date_sk",
+                right_on="d_date_sk")
+    i = it[it.i_category.isin(["Books", "Music", "Sports"])]
+    j = j.merge(i, left_on="ss_item_sk", right_on="i_item_sk")
+    g = j.groupby("i_category", as_index=False) \
+        .agg(rev=("ss_ext_sales_price", "sum"))
+    return g.sort_values("i_category")
+
+
+def q_agg_expr(session, t):
+    """Expression-over-aggregates shape: bulk-order revenue share per
+    state (sum(case)/sum)."""
+    from .. import datatypes as dt_
+    from ..expr.aggregates import Sum
+    from ..expr.arithmetic import Divide
+    from ..expr.base import Literal
+    from ..expr.conditional import If
+    from ..expr.predicates import GreaterThanOrEqual
+    f = _frames(session, t)
+    bulk = Sum(If(GreaterThanOrEqual(_col("ss_quantity"),
+                                     Literal(50, dt_.INT32)),
+                  _col("ss_ext_sales_price"),
+                  Literal(0.0, dt_.FLOAT64)))
+    df = (f["store_sales"]
+          .join(f["store"], on=[("ss_store_sk", "s_store_sk")],
+                build_unique=True)
+          .group_by("s_state")
+          .agg(_alias(bulk, "__b"),
+               _alias(Sum(_col("ss_ext_sales_price")), "__t"))
+          .select(_col("s_state"),
+                  _alias(Divide(_col("__b"), _col("__t")), "bulk_share"))
+          .order_by("s_state"))
+    return df
+
+
+def q_agg_expr_pd(pd, t):
+    ss, st = t["store_sales"], t["store"]
+    j = ss.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.assign(bulk=np.where(j.ss_quantity >= 50,
+                               j.ss_ext_sales_price, 0.0))
+    g = j.groupby("s_state", as_index=False).agg(
+        b=("bulk", "sum"), tt=("ss_ext_sales_price", "sum"))
+    g["bulk_share"] = g.b / g.tt
+    return g[["s_state", "bulk_share"]].sort_values("s_state")
+
+
+def q_rownum(session, t):
+    """ROW_NUMBER shape: single best-selling item per category."""
+    from .. import datatypes as dt_
+    from ..exec.sort import SortOrder
+    from ..exec.window import TpuWindowExec
+    from ..expr import RowNumber, WindowExpression
+    from ..expr.aggregates import Sum
+    from ..expr.base import Literal
+    from ..expr.predicates import EqualTo
+    from ..session import DataFrame
+    f = _frames(session, t)
+    base = (f["store_sales"]
+            .join(f["item"], on=[("ss_item_sk", "i_item_sk")],
+                  build_unique=True)
+            .group_by("i_category", "i_item_sk")
+            .agg(_alias(Sum(_col("ss_ext_sales_price")), "rev")))
+    win = TpuWindowExec(
+        [_alias(WindowExpression(
+            RowNumber(), [_col("i_category")],
+            [SortOrder(_col("rev"), ascending=False),
+             SortOrder(_col("i_item_sk"))]), "rn")],
+        base._node)
+    return (DataFrame(win, session)
+            .filter(EqualTo(_col("rn"), Literal(1, dt_.INT32)))
+            .select(_col("i_category"), _col("i_item_sk"), _col("rev"))
+            .order_by("i_category"))
+
+
+def q_rownum_pd(pd, t):
+    ss, it = t["store_sales"], t["item"]
+    j = ss.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    g = j.groupby(["i_category", "i_item_sk"], as_index=False) \
+        .agg(rev=("ss_ext_sales_price", "sum"))
+    g = g.sort_values(["i_category", "rev", "i_item_sk"],
+                      ascending=[True, False, True])
+    top = g.groupby("i_category", group_keys=False).head(1)
+    return top[["i_category", "i_item_sk", "rev"]] \
+        .sort_values("i_category")
+
+
+def q_not_or(session, t):
+    """Precedence shape: NOT/OR month exclusion + profit filter."""
+    from ..expr.aggregates import Count
+    from ..expr.predicates import Not, Or
+    f = _frames(session, t)
+    dd = f["date_dim"].filter(Not(Or(_cmp("==", "d_moy", 1),
+                                     _cmp("==", "d_moy", 12)))) \
+        .select(_col("d_date_sk"), _col("d_year"))
+    df = (f["store_sales"]
+          .filter(_cmp(">", "ss_net_profit", 0.0))
+          .join(dd, on=[("ss_sold_date_sk", "d_date_sk")],
+                build_unique=True)
+          .group_by("d_year")
+          .agg(_alias(Count(), "n"))
+          .order_by("d_year"))
+    return df
+
+
+def q_not_or_pd(pd, t):
+    ss, dd = t["store_sales"], t["date_dim"]
+    d = dd[~((dd.d_moy == 1) | (dd.d_moy == 12))]
+    s = ss[ss.ss_net_profit > 0.0]
+    j = s.merge(d, left_on="ss_sold_date_sk", right_on="d_date_sk")
+    g = j.groupby("d_year", as_index=False) \
+        .agg(n=("d_date_sk", "size"))
+    return g.sort_values("d_year")
+
+
 QUERIES = {
     "q3": (q3, q3_pd), "q42": (q42, q42_pd), "q55": (q55, q55_pd),
     "q7": (q7, q7_pd), "q96": (q96, q96_pd), "q97": (q97, q97_pd),
@@ -569,11 +846,273 @@ QUERIES = {
     "q_price_band": (q_price_band, q_price_band_pd),
     "q_rank": (q_rank_in_category, q_rank_in_category_pd),
     "q_rolling": (q_rolling_revenue, q_rolling_revenue_pd),
+    "q52": (q52, q52_pd),
+    "q_cte": (q_cte, q_cte_pd),
+    "q_union": (q_union, q_union_pd),
+    "q_having": (q_having, q_having_pd),
+    "q_in_between": (q_in_between, q_in_between_pd),
+    "q_agg_expr": (q_agg_expr, q_agg_expr_pd),
+    "q_rownum": (q_rownum, q_rownum_pd),
+    "q_not_or": (q_not_or, q_not_or_pd),
+}
+
+
+# --- SQL corpus ------------------------------------------------------------
+# Every query re-expressed as REAL NDS-style SQL text (comma FROM
+# lists, WHERE-clause join predicates, /*+ UNIQUE(...) */ hints where
+# the hand-built plan passes build_unique=True). tests/test_sql_nds.py
+# dual-runs each against its hand-built plan row-for-row; bench.py
+# drives the corpus from these texts by default.
+
+SQL_QUERIES = {
+    "q3": """
+SELECT /*+ UNIQUE(dt, item) */ dt.d_year, item.i_brand_id,
+       SUM(ss_ext_sales_price) AS sum_agg
+FROM date_dim dt, store_sales, item
+WHERE dt.d_date_sk = ss_sold_date_sk
+  AND ss_item_sk = item.i_item_sk
+  AND dt.d_moy = 11
+GROUP BY dt.d_year, item.i_brand_id
+ORDER BY dt.d_year, sum_agg DESC, i_brand_id
+LIMIT 10
+""",
+    "q42": """
+SELECT /*+ UNIQUE(dt, item) */ i_category_id,
+       SUM(ss_ext_sales_price) AS s
+FROM date_dim dt, store_sales, item
+WHERE dt.d_date_sk = ss_sold_date_sk
+  AND ss_item_sk = i_item_sk
+  AND dt.d_moy = 12 AND dt.d_year = 2000
+GROUP BY i_category_id
+ORDER BY s DESC, i_category_id
+""",
+    "q55": """
+SELECT /*+ UNIQUE(item) */ i_brand_id,
+       SUM(ss_ext_sales_price) AS rev
+FROM store_sales, item
+WHERE ss_item_sk = i_item_sk
+  AND i_manufact_id >= 20 AND i_manufact_id < 40
+GROUP BY i_brand_id
+ORDER BY rev DESC, i_brand_id
+LIMIT 20
+""",
+    "q7": """
+SELECT /*+ UNIQUE(dt, item) */ i_category_id,
+       AVG(ss_quantity) AS avg_q, AVG(ss_sales_price) AS avg_p
+FROM store_sales, date_dim dt, item
+WHERE ss_sold_date_sk = dt.d_date_sk
+  AND ss_item_sk = i_item_sk
+  AND dt.d_year = 2001
+GROUP BY i_category_id
+ORDER BY i_category_id
+""",
+    "q96": """
+SELECT /*+ UNIQUE(store, date_dim) */ COUNT(*) AS cnt
+FROM store_sales, store, date_dim
+WHERE ss_quantity BETWEEN 40 AND 60
+  AND ss_store_sk = s_store_sk
+  AND ss_sold_date_sk = d_date_sk
+  AND d_qoy = 2
+""",
+    "q97": """
+SELECT COUNT(*) AS n_pairs
+FROM (SELECT /*+ UNIQUE(date_dim) */ ss_customer_sk
+      FROM store_sales, date_dim
+      WHERE ss_sold_date_sk = d_date_sk AND d_moy <= 6) h1
+LEFT SEMI JOIN
+     (SELECT /*+ UNIQUE(date_dim) */ ss_customer_sk AS c2
+      FROM store_sales, date_dim
+      WHERE ss_sold_date_sk = d_date_sk AND d_moy > 6) h2
+ON h1.ss_customer_sk = h2.c2
+""",
+    "q_like": """
+SELECT /*+ UNIQUE(item, store) */ s_state,
+       SUM(ss_net_profit) AS profit
+FROM store_sales, item, store
+WHERE ss_item_sk = i_item_sk
+  AND ss_store_sk = s_store_sk
+  AND i_category LIKE '%o%s%'
+GROUP BY s_state
+ORDER BY s_state
+""",
+    "q_percentile": """
+SELECT /*+ UNIQUE(store) */ s_state,
+       APPROX_PERCENTILE(ss_sales_price, 0.5) AS p50
+FROM store_sales, store
+WHERE ss_store_sk = s_store_sk
+GROUP BY s_state
+ORDER BY s_state
+""",
+    "q_pivot": """
+SELECT /*+ UNIQUE(date_dim) */ d_year,
+       SUM(CASE WHEN d_qoy = 1 THEN ss_ext_sales_price END) AS "1",
+       SUM(CASE WHEN d_qoy = 2 THEN ss_ext_sales_price END) AS "2",
+       SUM(CASE WHEN d_qoy = 3 THEN ss_ext_sales_price END) AS "3",
+       SUM(CASE WHEN d_qoy = 4 THEN ss_ext_sales_price END) AS "4"
+FROM store_sales, date_dim
+WHERE ss_sold_date_sk = d_date_sk
+GROUP BY d_year
+ORDER BY d_year
+""",
+    "q_customer_age": """
+SELECT /*+ UNIQUE(cust) */ decade, SUM(ss_net_profit) AS profit,
+       COUNT(*) AS n
+FROM store_sales,
+     (SELECT c_customer_sk,
+             CAST(c_birth_year AS BIGINT) DIV 10 * 10 AS decade
+      FROM customer) cust
+WHERE ss_customer_sk = c_customer_sk
+GROUP BY decade
+ORDER BY decade
+""",
+    "q_topn": """
+SELECT /*+ UNIQUE(date_dim) */ ss_item_sk,
+       SUM(ss_net_profit) AS profit
+FROM store_sales, date_dim
+WHERE ss_sold_date_sk = d_date_sk AND d_qoy = 4
+GROUP BY ss_item_sk
+ORDER BY profit DESC, ss_item_sk
+LIMIT 25
+""",
+    "q_price_band": """
+SELECT /*+ UNIQUE(item) */
+       CASE WHEN i_current_price < 10.0 THEN 'low'
+            WHEN i_current_price < 100.0 THEN 'mid'
+            ELSE 'high' END AS band,
+       SUM(ss_ext_sales_price) AS rev
+FROM store_sales, item
+WHERE ss_item_sk = i_item_sk
+GROUP BY band
+ORDER BY band
+""",
+    "q_rank": """
+SELECT i_category, i_brand_id, rev, rk
+FROM (SELECT i_category, i_brand_id, rev,
+             RANK() OVER (PARTITION BY i_category
+                          ORDER BY rev DESC, i_brand_id) AS rk
+      FROM (SELECT /*+ UNIQUE(item) */ i_category, i_brand_id,
+                   SUM(ss_ext_sales_price) AS rev
+            FROM store_sales, item
+            WHERE ss_item_sk = i_item_sk
+            GROUP BY i_category, i_brand_id) brand_rev) ranked
+WHERE rk <= 3
+ORDER BY i_category, rk, i_brand_id
+""",
+    "q_rolling": """
+SELECT ss_store_sk, ss_sold_date_sk, rev,
+       AVG(rev) OVER (PARTITION BY ss_store_sk ORDER BY d32
+                      RANGE BETWEEN 6 PRECEDING AND CURRENT ROW)
+       AS avg7
+FROM (SELECT ss_store_sk, ss_sold_date_sk,
+             SUM(ss_ext_sales_price) AS rev,
+             CAST(ss_sold_date_sk AS INT) AS d32
+      FROM store_sales
+      GROUP BY ss_store_sk, ss_sold_date_sk) daily
+ORDER BY ss_store_sk, ss_sold_date_sk
+""",
+    "q52": """
+SELECT /*+ UNIQUE(dt, item) */ dt.d_year, item.i_brand_id,
+       SUM(ss_ext_sales_price) AS ext_price
+FROM date_dim dt, store_sales, item
+WHERE dt.d_date_sk = ss_sold_date_sk
+  AND ss_item_sk = item.i_item_sk
+  AND dt.d_moy = 12 AND dt.d_year = 2001
+GROUP BY dt.d_year, item.i_brand_id
+ORDER BY dt.d_year, ext_price DESC, i_brand_id
+LIMIT 10
+""",
+    "q_cte": """
+WITH year_rev AS (
+  SELECT /*+ UNIQUE(date_dim) */ d_year,
+         SUM(ss_ext_sales_price) AS rev
+  FROM store_sales, date_dim
+  WHERE ss_sold_date_sk = d_date_sk
+  GROUP BY d_year)
+SELECT a.d_year, a.rev, b.rev AS prev_rev
+FROM year_rev a JOIN year_rev b ON a.d_year = b.d_year + 1
+ORDER BY a.d_year
+""",
+    "q_union": """
+SELECT /*+ UNIQUE(date_dim, store) */ 1 AS qtr, s_state,
+       SUM(ss_net_profit) AS profit
+FROM store_sales, date_dim, store
+WHERE ss_sold_date_sk = d_date_sk AND ss_store_sk = s_store_sk
+  AND d_qoy = 1
+GROUP BY s_state
+UNION ALL
+SELECT /*+ UNIQUE(date_dim, store) */ 2 AS qtr, s_state,
+       SUM(ss_net_profit) AS profit
+FROM store_sales, date_dim, store
+WHERE ss_sold_date_sk = d_date_sk AND ss_store_sk = s_store_sk
+  AND d_qoy = 2
+GROUP BY s_state
+ORDER BY qtr, s_state
+""",
+    "q_having": """
+SELECT /*+ UNIQUE(item) */ i_brand_id, COUNT(*) AS n,
+       SUM(ss_ext_sales_price) AS rev
+FROM store_sales, item
+WHERE ss_item_sk = i_item_sk
+GROUP BY i_brand_id
+HAVING COUNT(*) > 250
+ORDER BY i_brand_id
+""",
+    "q_in_between": """
+SELECT /*+ UNIQUE(date_dim, item) */ i_category,
+       SUM(ss_ext_sales_price) AS rev
+FROM store_sales, date_dim, item
+WHERE ss_sold_date_sk = d_date_sk
+  AND ss_item_sk = i_item_sk
+  AND ss_quantity BETWEEN 20 AND 40
+  AND i_category IN ('Books', 'Music', 'Sports')
+  AND d_year = 2000
+GROUP BY i_category
+ORDER BY i_category
+""",
+    "q_agg_expr": """
+SELECT /*+ UNIQUE(store) */ s_state,
+       SUM(CASE WHEN ss_quantity >= 50 THEN ss_ext_sales_price
+                ELSE 0.0 END) / SUM(ss_ext_sales_price)
+       AS bulk_share
+FROM store_sales, store
+WHERE ss_store_sk = s_store_sk
+GROUP BY s_state
+ORDER BY s_state
+""",
+    "q_rownum": """
+SELECT i_category, i_item_sk, rev
+FROM (SELECT i_category, i_item_sk, rev,
+             ROW_NUMBER() OVER (PARTITION BY i_category
+                                ORDER BY rev DESC, i_item_sk) AS rn
+      FROM (SELECT /*+ UNIQUE(item) */ i_category, i_item_sk,
+                   SUM(ss_ext_sales_price) AS rev
+            FROM store_sales, item
+            WHERE ss_item_sk = i_item_sk
+            GROUP BY i_category, i_item_sk) t) ranked
+WHERE rn = 1
+ORDER BY i_category
+""",
+    "q_not_or": """
+SELECT /*+ UNIQUE(date_dim) */ d_year, COUNT(*) AS n
+FROM store_sales, date_dim
+WHERE ss_sold_date_sk = d_date_sk
+  AND NOT (d_moy = 1 OR d_moy = 12) AND ss_net_profit > 0.0
+GROUP BY d_year
+ORDER BY d_year
+""",
 }
 
 
 def build_query(name: str, session, tables):
     return QUERIES[name][0](session, tables)
+
+
+def build_query_sql(name: str, session, tables):
+    """The SQL-text route to the same query: registers the corpus
+    frames as temp views and compiles SQL_QUERIES[name] through
+    ``session.sql`` — the path bench.py drives by default."""
+    _frames(session, tables)
+    return session.sql(SQL_QUERIES[name])
 
 
 def pandas_frames(tables):

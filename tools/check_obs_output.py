@@ -23,6 +23,11 @@ Two validators and one driver:
   query still returns oracle-correct rows via exactly one classified
   fetch failure + map-stage rerun, validated through the event log and
   the incident bundle — the shuffle-durability CI gate.
+- ``--sql-smoke DIR``  parse + compile + plan-verify the FULL NDS SQL
+  corpus (zero parse failures, zero unexpected fallbacks), run one SQL
+  query end to end on a 2-worker process cluster against the pandas
+  oracle, and assert a broken statement leaves a ``sql_parse_error``
+  event-log line — the SQL-frontend CI gate.
 
 Exit status 0 = all checks passed; failures are listed on stderr.
 """
@@ -443,6 +448,71 @@ def run_scan_smoke(out_dir, mixed=False):
     return prom_path
 
 
+def run_sql_smoke(out_dir):
+    """SQL-frontend CI gate: (1) parse + compile + plan-verify the FULL
+    SQL corpus (tools/nds.py SQL_QUERIES) — zero parse failures, zero
+    unexpected CPU fallbacks, verifier on; (2) run one SQL query end to
+    end on a 2-worker process cluster against the pandas oracle;
+    (3) a broken statement must leave a sql_parse_error event-log
+    line."""
+    from spark_rapids_tpu.cluster import TpuProcessCluster
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.planner import TpuOverrides
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.sql import SqlParseError
+    from spark_rapids_tpu.tools.event_log import read_event_logs
+    from spark_rapids_tpu.tools.nds import (SQL_QUERIES,
+                                            build_query_sql,
+                                            gen_tables, pandas_oracle)
+    tables = gen_tables(n_sales=1 << 13)
+    s = TpuSession()
+    plans = {}
+    for name in sorted(SQL_QUERIES):
+        df = build_query_sql(name, s, tables)  # parse + analyze
+        pp = TpuOverrides(s.conf).apply(df._node)  # verifier is on
+        fb = pp.fallback_nodes()
+        assert not fb, f"{name}: unexpected CPU fallback {fb}"
+        plans[name] = df
+    print(f"sql corpus: {len(plans)} queries parsed, compiled and "
+          "plan-verified clean")
+
+    # one SQL query end to end across OS worker processes; one shuffle
+    # partition so the plan's global sort+limit stays global (the
+    # cluster applies the final stage per reduce partition)
+    log_dir = os.path.join(out_dir, "events")
+    s1 = TpuSession(conf={"spark.sql.shuffle.partitions": "1"})
+    cdf = build_query_sql("q3", s1, tables)
+    conf = RapidsConf({"spark.rapids.eventLog.dir": log_dir})
+    with TpuProcessCluster(n_workers=2, conf=conf) as c:
+        got = c.run_query(cdf._node).to_pandas()
+    want = pandas_oracle("q3", tables).reset_index(drop=True)
+    assert len(got) == len(want), (len(got), len(want))
+    for ci, col_name in enumerate(want.columns):
+        w = want[col_name].to_numpy()
+        g = got.iloc[:, ci].to_numpy()
+        import numpy as np
+        if np.issubdtype(w.dtype, np.floating):
+            assert np.allclose(g.astype(float), w, rtol=1e-6,
+                               atol=1e-6), col_name
+        else:
+            assert (g == w).all(), col_name
+    print("sql q3 end-to-end on the process cluster: rows match "
+          "the oracle")
+
+    # failure evidence: one sql_parse_error event line
+    s2 = TpuSession(conf={"spark.rapids.eventLog.dir": log_dir})
+    try:
+        s2.sql("SELEKT broken FROM nowhere")
+    except SqlParseError:
+        pass
+    else:
+        raise AssertionError("broken SQL did not raise SqlParseError")
+    evs = [e for e in read_event_logs(log_dir)
+           if e.get("type") == "sql_parse_error"]
+    assert len(evs) == 1 and evs[0]["line"] == 1, evs
+    print("sql_parse_error event logged with line/col evidence")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--trace", help="Chrome trace JSON to validate")
@@ -467,6 +537,11 @@ def main(argv=None):
                     help="run a cluster shuffle query with injected "
                          "post-commit corruption, assert oracle rows "
                          "via exactly one map-stage rerun")
+    ap.add_argument("--sql-smoke", metavar="DIR", dest="sql_smoke",
+                    help="parse + compile + plan-verify the full SQL "
+                         "corpus (zero parse failures / fallbacks) and "
+                         "run one SQL query end to end on the process "
+                         "cluster")
     args = ap.parse_args(argv)
     errors = []
     trace, prom = args.trace, args.prom
@@ -492,9 +567,15 @@ def main(argv=None):
         bundle = run_shuffle_smoke(args.shuffle_smoke)
         flights.append(bundle)
         print(f"shuffle smoke output: {bundle}")
-    if not trace and not prom and not flights:
+    ran_sql = False
+    if args.sql_smoke:
+        os.makedirs(args.sql_smoke, exist_ok=True)
+        run_sql_smoke(args.sql_smoke)
+        ran_sql = True
+    if not trace and not prom and not flights and not ran_sql:
         ap.error("nothing to do: pass --trace/--prom/--smoke/"
-                 "--scan-smoke/--flight/--flight-smoke/--shuffle-smoke")
+                 "--scan-smoke/--flight/--flight-smoke/--shuffle-smoke/"
+                 "--sql-smoke")
     if trace:
         errors += [f"[trace] {e}" for e in check_trace(trace)]
     for fl in flights:
