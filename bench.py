@@ -714,8 +714,14 @@ def main():
     from spark_rapids_tpu.config import RapidsConf as _RC
     import tempfile as _tempfile
     obs_trace_dir = _tempfile.mkdtemp(prefix="bench_obs_trace_")
-    ctx_obs_off = ExecCtx(_RC({"spark.rapids.flight.enabled": "false"}))
+    # opmetrics rides the A/B too: the always-on per-operator
+    # accounting (rows/batches/bytes shims, obs/opmetrics.py) must fit
+    # inside the same <=5% overhead envelope as the recorder + tracing
+    ctx_obs_off = ExecCtx(_RC({"spark.rapids.flight.enabled": "false",
+                               "spark.rapids.metrics.op.enabled":
+                               "false"}))
     ctx_obs_on = ExecCtx(_RC({"spark.rapids.flight.enabled": "true",
+                              "spark.rapids.metrics.op.enabled": "true",
                               "spark.rapids.trace.dir": obs_trace_dir}))
 
     def _time_obs(c):

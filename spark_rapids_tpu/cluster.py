@@ -198,7 +198,8 @@ class ProcessShuffleReadExec(LeafExec):
 
 # --- worker-side task execution (one function per task kind) ---------------
 
-def _run_map_task(payload: Dict, tracer=NULL_TRACER) -> None:
+def _run_map_task(payload: Dict, tracer=NULL_TRACER,
+                  obs_sink: Optional[Dict] = None) -> None:
     """Execute a map plan slice and write its partitions as Arrow IPC
     files into an attempt-private staging dir, then commit atomically
     (HostShuffleTransport is the writer; batch i of this slice is map id
@@ -217,6 +218,10 @@ def _run_map_task(payload: Dict, tracer=NULL_TRACER) -> None:
     staging = transport.begin_task_attempt(sid, task_key, attempt)
     ctx = ExecCtx(conf)
     ctx.tracer = tracer  # join the driver's trace, not a fresh one
+    if obs_sink is not None:
+        # exposed BEFORE execution so a failed attempt's partial
+        # per-operator snapshot can still flush next to its .err
+        obs_sink["ctx"] = ctx
     base = payload["map_id_base"]
     try:
         for i, batch in enumerate(plan.execute(ctx)):
@@ -234,7 +239,8 @@ def _run_map_task(payload: Dict, tracer=NULL_TRACER) -> None:
         transport.commit_task_attempt(sid, task_key, attempt)
 
 
-def _run_collect_task(payload: Dict, tracer=NULL_TRACER) -> None:
+def _run_collect_task(payload: Dict, tracer=NULL_TRACER,
+                      obs_sink: Optional[Dict] = None) -> None:
     """Execute a (reduce/final) plan slice on this worker's device and
     publish the result as one Arrow IPC file; the final hard link is the
     commit — first attempt to link wins, a later (speculative/zombie)
@@ -244,6 +250,8 @@ def _run_collect_task(payload: Dict, tracer=NULL_TRACER) -> None:
     plan: TpuExec = payload["plan"]
     ctx = ExecCtx(conf)
     ctx.tracer = tracer
+    if obs_sink is not None:
+        obs_sink["ctx"] = ctx
     rbs = [device_to_arrow(b) for b in plan.execute(ctx)]
     target = arrow_schema(plan.output_schema)
     out = payload["out"]
@@ -298,11 +306,13 @@ def _flush_task_flight(root: str, worker_id: int, task_path: str,
 
 
 def _flush_task_obs(root: str, worker_id: int, task_path: str, tracer,
-                    settings: Dict) -> None:
-    """Commit this attempt's spans next to its task file (BEFORE the
-    .ok/.err marker, so the driver's harvest pass finds them) and
-    rewrite the worker's metrics snapshot in the rendezvous. Best
-    effort: observability failures must never fail the task."""
+                    settings: Dict, ctx=None, task_id: str = "?",
+                    attempt: int = 0) -> None:
+    """Commit this attempt's spans and per-operator metric snapshot
+    next to its task file (BEFORE the .ok/.err marker, so the driver's
+    harvest pass finds them) and rewrite the worker's metrics snapshot
+    in the rendezvous. Best effort: observability failures must never
+    fail the task."""
     try:
         if tracer.enabled:
             tmp = task_path + ".spans.tmp"
@@ -312,6 +322,11 @@ def _flush_task_obs(root: str, worker_id: int, task_path: str, tracer,
                 json.dump({"spans": tracer.drain(),
                            "dropped": tracer.dropped}, f)
             os.replace(tmp, task_path + ".spans")
+        if ctx is not None:
+            # per-(op_id, task) snapshot: the driver folds the winning
+            # attempts' files into per-operator totals + max/skew
+            from .obs.opmetrics import flush_task_opmetrics
+            flush_task_opmetrics(task_path, ctx, task_id, attempt)
         from .config import _to_bool
         if _to_bool(settings.get(METRICS_ENABLED.key, False)):
             flush_worker_metrics(root, worker_id)
@@ -406,6 +421,7 @@ def worker_main(root: str, worker_id: int, poll_s: float = 0.02,
             settings = payload.get("conf", {}) or {}
             task_id = payload.get("task_id", "?")
             attempt = payload.get("attempt", 0)
+            obs_sink: Dict = {}  # task fns expose their ExecCtx here
             # the flight recorder is always-on: record the claim and
             # flush the incarnation ring to disk BEFORE the chaos hook
             # / user code runs, so even an os._exit crash leaves the
@@ -439,7 +455,7 @@ def worker_main(root: str, worker_id: int, poll_s: float = 0.02,
                         f"a{payload.get('attempt', 0)}", cat="task",
                         parent_id=tctx["parent"] if tctx else None,
                         args={"kind": kind, "worker": worker_id}):
-                    _TASK_KINDS[kind](payload, tracer)
+                    _TASK_KINDS[kind](payload, tracer, obs_sink)
                 if kind == "map":
                     # shuffle-durability chaos (corrupt/drop/eio) fires
                     # AFTER the atomic commit: the map task reports
@@ -451,7 +467,9 @@ def worker_main(root: str, worker_id: int, poll_s: float = 0.02,
                         os.path.join(payload["shuffle_root"],
                                      f"s{payload['shuffle_id']}",
                                      f"{task_id}.mapout"))
-                _flush_task_obs(root, worker_id, path, tracer, settings)
+                _flush_task_obs(root, worker_id, path, tracer, settings,
+                                ctx=obs_sink.get("ctx"),
+                                task_id=task_id, attempt=attempt)
                 RECORDER.record("task", ev="ok", task=task_id,
                                 attempt=attempt, worker=worker_id)
                 _flush_task_flight(root, worker_id, path, task_id,
@@ -461,7 +479,9 @@ def worker_main(root: str, worker_id: int, poll_s: float = 0.02,
                 os.replace(done + ".tmp", done)
             except BaseException as exc:
                 tb = traceback.format_exc()
-                _flush_task_obs(root, worker_id, path, tracer, settings)
+                _flush_task_obs(root, worker_id, path, tracer, settings,
+                                ctx=obs_sink.get("ctx"),
+                                task_id=task_id, attempt=attempt)
                 RECORDER.record("task", ev="err", task=task_id,
                                 attempt=attempt, worker=worker_id,
                                 error=tb.strip().splitlines()[-1][:200])
@@ -663,6 +683,9 @@ class TpuProcessCluster:
         self.last_scheduler: Optional[TaskScheduler] = None
         self.last_trace_path: Optional[str] = None
         self.last_incident_path: Optional[str] = None
+        self.last_plan: Optional[TpuExec] = None
+        self.last_opmetrics: Dict = {}
+        self.last_profile_path: Optional[str] = None
         # the /metrics port belongs to the driver; the cluster driver
         # never builds an ExecCtx, so bind it here rather than lazily
         maybe_start_http_server(self.conf)
@@ -702,6 +725,14 @@ class TpuProcessCluster:
         # handle), so strip it here — the process cluster IS the
         # exchange (ADVICE round 5)
         plan = _strip_aqe_reads(plan)
+        # stable operator-instance ids ride the task pickles: every
+        # worker's per-(op, task) snapshot folds back under the same
+        # label (planner-built plans arrive already stamped; raw exec
+        # trees get stamped here)
+        from .obs.opmetrics import assign_op_ids
+        assign_op_ids(plan)
+        self.last_plan = plan
+        self.last_opmetrics = {}
         self._query_seq += 1
         qid = self._query_seq
         tracer = tracer_from_conf(conf)
@@ -714,14 +745,17 @@ class TpuProcessCluster:
         # duration below runs on monotonic so a clock step can't skew it
         t0 = time.time()
         t0_mono = time.monotonic()
+        ok = False
         try:
             args = None
             if tracer.enabled:  # tree-walk + sha1 only when traced
                 from .tools.event_log import plan_fingerprint
                 args = {"fingerprint": plan_fingerprint(plan)}
             with tracer.span(f"query q{qid}", cat="query", args=args):
-                return self._run_query_stages(plan, conf, settings, qid,
-                                              sched)
+                result = self._run_query_stages(plan, conf, settings,
+                                                qid, sched)
+            ok = True
+            return result
         finally:
             # failed queries are exactly the ones whose attempt
             # timeline and trace the profiler needs — emit
@@ -733,9 +767,27 @@ class TpuProcessCluster:
                         name=f"trace-{tracer.trace_id}-q{qid}.json")
                 except OSError:
                     pass  # observability must never fail the query
+            wall_s = time.monotonic() - t0_mono
+            self.last_wall_s = wall_s
+            # fold the winning attempts' per-operator snapshots (torn/
+            # missing files tolerated — a crashed worker leaves partial
+            # attribution); top sinks ride the scheduler event line
+            from .obs.opmetrics import top_op_sinks
+            try:
+                self.last_opmetrics = self._fold_opmetrics(sched)
+            except Exception:  # noqa: BLE001 — attribution is
+                self.last_opmetrics = {}  # best-effort, never fatal
             from .tools.event_log import log_scheduler_events
-            log_scheduler_events(conf, f"q{qid}", sched,
-                                 time.monotonic() - t0_mono)
+            log_scheduler_events(conf, f"q{qid}", sched, wall_s,
+                                 op_sinks=top_op_sinks(
+                                     self.last_opmetrics))
+            if ok:
+                from .obs.metrics import QUERY_DURATION
+                from .obs.opmetrics import plan_source
+                QUERY_DURATION.labels(plan_source(plan),
+                                      "process").observe(wall_s)
+                self._write_profile(plan, conf, qid, tracer, sched,
+                                    wall_s)
             # flight recorder: when anything anomalous happened this
             # query (failed attempts, worker deaths, stragglers, or a
             # worker committed a flight dump), harvest every process's
@@ -774,6 +826,64 @@ class TpuProcessCluster:
         except Exception:  # noqa: BLE001 — forensics must never mask
             pass           # the rejection itself
         raise PlanVerificationError(report)
+
+    # --- per-operator metrics: fold / profile / EXPLAIN ANALYZE -----------
+
+    def _fold_opmetrics(self, sched: TaskScheduler) -> Dict:
+        """Fold the committed (winning) attempts' ``<task>.opm.json``
+        snapshots into per-operator totals + per-task max/skew. Losing
+        speculative/zombie attempts are excluded so rows are counted
+        exactly once; missing or torn files (crashed workers,
+        opmetrics disabled) just mean partial attribution."""
+        from .obs.opmetrics import fold_snapshots, read_task_opmetrics
+        winners = [(e["task"], e["attempt"], e["worker"])
+                   for e in sched.events if e["event"] == "task_ok"]
+        snaps = read_task_opmetrics(os.path.join(self.root, "tasks"),
+                                    winners)
+        return fold_snapshots(snaps)
+
+    def _write_profile(self, plan: TpuExec, conf: RapidsConf, qid: int,
+                       tracer, sched: TaskScheduler,
+                       wall_s: float) -> None:
+        """Persist one query-profile JSON (spark.rapids.history.dir)
+        with the cross-worker folded per-operator metrics."""
+        from .obs.opmetrics import (HISTORY_DIR, build_profile,
+                                    plan_source, write_profile)
+        if not conf.get(HISTORY_DIR):
+            return  # don't pay the fingerprint when history is off
+        try:
+            tid = tracer.trace_id \
+                if getattr(tracer, "enabled", False) else None
+            doc = build_profile(
+                plan, self.last_opmetrics, wall_s, query=f"q{qid}",
+                source=plan_source(plan), cluster="process",
+                trace_id=tid, conf=conf,
+                extra={"scheduler": sched.summary(),
+                       "n_workers": self.n_workers})
+            self.last_profile_path = write_profile(conf, doc)
+        except Exception:  # noqa: BLE001 — history must never fail
+            pass           # the query it records
+
+    def last_analyzed(self, formatted: bool = False) -> str:
+        """EXPLAIN ANALYZE text for the last run_query(): the executed
+        plan with per-operator rows/time folded ACROSS the worker
+        processes (tasks + per-task max + skew per node)."""
+        if self.last_plan is None:
+            raise RuntimeError("no query has run on this cluster")
+        from .obs.opmetrics import render_analyzed
+        return render_analyzed(self.last_plan, self.last_opmetrics,
+                               wall_s=getattr(self, "last_wall_s", None),
+                               formatted=formatted, cluster="process")
+
+    def explain_analyze(self, plan: TpuExec,
+                        conf: Optional[RapidsConf] = None,
+                        formatted: bool = False) -> str:
+        """Execute ``plan`` across the workers, then return the
+        metrics-annotated plan text (the process-cluster EXPLAIN
+        ANALYZE path; ``TpuSession.sql('EXPLAIN ANALYZE ...')`` routes
+        here when a cluster is attached)."""
+        self.run_query(plan, conf)
+        return self.last_analyzed(formatted=formatted)
 
     def _maybe_write_incident(self, conf: RapidsConf, qid: int,
                               sched: TaskScheduler, tracer,
@@ -935,6 +1045,10 @@ class TpuProcessCluster:
                 shuffle_root, sid, list(range(n)),
                 exch.child.output_schema,
                 expected_mapouts=[s.task_id for s in specs])
+            # the read REPLACES the exchange in the reduce stage: give
+            # it the exchange's stable op id so its reduce-side rows
+            # fold under the exchange node in EXPLAIN ANALYZE/profiles
+            read._op_id = getattr(exch, "_op_id", None)
             plan = _replace_node(plan, exch, read)
         # final stage: split the partition ranges of every shuffle read
         outs = []

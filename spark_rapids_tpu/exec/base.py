@@ -129,6 +129,11 @@ class ExecCtx:
         # append whether or not tracing is enabled
         from ..obs.recorder import RECORDER
         RECORDER.configure(self.conf)
+        # always-on per-operator accounting (rows/batches/bytes via the
+        # execute() shims below); deferred device row counts fold in at
+        # the query's natural sync point (obs/opmetrics.py)
+        from ..obs.opmetrics import OpMetricsCollector
+        self.opm = OpMetricsCollector(self.conf)
 
     def metric(self, node: "TpuExec", name: str) -> TpuMetric:
         m = self.metrics.setdefault(node.node_label(), {})
@@ -189,6 +194,76 @@ class ExecCtx:
                 "deferred device checks failed:\n  " + "\n  ".join(bad))
 
 
+def _count_execute(fn):
+    """Wrap an operator's ``execute`` with the always-on per-operator
+    accounting shim (obs/opmetrics.py): rows / batches / outputBytes
+    accumulate into the per-query metric store under the node's stable
+    label. Per batch this is two integer adds and a host-side byte sum;
+    batches whose live row count is device-resident defer the tiny
+    scalar to the collector's ONE fused readback at the query's natural
+    sync point — no extra host syncs on any path."""
+    if getattr(fn, "_opm_wrapped", False):
+        return fn
+
+    def execute(self, ctx):
+        opm = getattr(ctx, "opm", None)
+        # opm.enter: a subclass execute that delegates to a wrapped
+        # super().execute (conditionless cross joins) must count each
+        # batch once — the inner frame passes through
+        if opm is None or not opm.enabled or not opm.enter(self):
+            yield from fn(self, ctx)
+            return
+        rows_m = ctx.metric(self, "rows")
+        batches_m = ctx.metric(self, "batches")
+        bytes_m = ctx.metric(self, "outputBytes")
+        try:
+            for b in fn(self, ctx):
+                batches_m.value += 1
+                opm.count_rows(rows_m, b)
+                try:
+                    bytes_m.value += b.device_size_bytes()
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+                yield b
+        finally:
+            opm.exit(self)
+
+    execute._opm_wrapped = True
+    execute.__wrapped__ = fn
+    execute.__doc__ = fn.__doc__
+    return execute
+
+
+def _count_execute_cpu(fn):
+    """The CPU-island twin of ``_count_execute``: rows/batches count
+    from the Arrow batches (free — host values), and the node is
+    flagged ``cpuFallback`` so EXPLAIN ANALYZE and profiles show where
+    a query left the device."""
+    if getattr(fn, "_opm_wrapped", False):
+        return fn
+
+    def execute_cpu(self, ctx):
+        opm = getattr(ctx, "opm", None)
+        if opm is None or not opm.enabled or not opm.enter(self):
+            yield from fn(self, ctx)
+            return
+        rows_m = ctx.metric(self, "rows")
+        batches_m = ctx.metric(self, "batches")
+        ctx.metric(self, "cpuFallback").set(1)
+        try:
+            for rb in fn(self, ctx):
+                batches_m.value += 1
+                rows_m.value += rb.num_rows
+                yield rb
+        finally:
+            opm.exit(self)
+
+    execute_cpu._opm_wrapped = True
+    execute_cpu.__wrapped__ = fn
+    execute_cpu.__doc__ = fn.__doc__
+    return execute_cpu
+
+
 class TpuExec:
     """Base physical operator."""
 
@@ -200,6 +275,17 @@ class TpuExec:
         TpuExec._label_counter += 1
         self._label_id = TpuExec._label_counter
 
+    def __init_subclass__(cls, **kw):
+        # every subclass that defines its own execute/execute_cpu gets
+        # the per-operator accounting shims — metric plumbing for ALL
+        # operators without touching each one
+        super().__init_subclass__(**kw)
+        if "execute" in cls.__dict__:
+            cls.execute = _count_execute(cls.__dict__["execute"])
+        if "execute_cpu" in cls.__dict__:
+            cls.execute_cpu = _count_execute_cpu(
+                cls.__dict__["execute_cpu"])
+
     # --- static metadata --------------------------------------------------
     @property
     def output_schema(self) -> dt.Schema:
@@ -210,6 +296,14 @@ class TpuExec:
         return n[3:] if n.startswith("Tpu") else n
 
     def node_label(self) -> str:
+        """Metric/trace label. ``#op<N>`` when the planner stamped a
+        stable per-plan instance id (obs/opmetrics.assign_op_ids —
+        survives pickles, deep copies, and AQE reuse, so metrics fold
+        across workers and runs); otherwise the process-local
+        construction counter."""
+        oid = getattr(self, "_op_id", None)
+        if oid is not None:
+            return f"{self.pretty_name()}#op{oid}"
         return f"{self.pretty_name()}#{self._label_id}"
 
     # --- planner hooks ----------------------------------------------------
@@ -475,14 +569,19 @@ def collect_arrow(plan: TpuExec, ctx: Optional[ExecCtx] = None) -> pa.Table:
     """Run the TPU path and download results as one Arrow table."""
     ctx = ctx or ExecCtx()
     try:
+        t0 = time.perf_counter()
         with ctx.mm.task_slot():  # admission control (GpuSemaphore analog)
+            ctx.metric(plan, "ledgerWaitTime").value += \
+                time.perf_counter() - t0
             batches = [device_to_arrow(b) for b in plan.execute(ctx)]
     except BaseException:
         ctx.discard_deferred()  # a reused ctx must not report dead flags
+        ctx.opm.discard()
         raise
     finally:
         ctx.run_cleanups()
     ctx.check_deferred()  # the download was the natural sync point
+    ctx.opm.finalize()    # ... and satisfied the deferred row counts
     from ..columnar.arrow_bridge import arrow_schema
     return pa.Table.from_batches(batches, schema=arrow_schema(
         plan.output_schema))

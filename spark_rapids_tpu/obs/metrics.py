@@ -271,6 +271,17 @@ class MetricsRegistry:
 
 REGISTRY = MetricsRegistry()
 
+# Per-query end-to-end latency, the p50/p99 surface a load gate reads
+# (ROADMAP item 3): observed by PhysicalPlan.collect (cluster=local)
+# and TpuProcessCluster.run_query (cluster=process); source says how
+# the plan was built (the SQL frontend vs hand-built exec trees).
+QUERY_DURATION = REGISTRY.histogram(
+    "rapids_query_duration_seconds",
+    "End-to-end query wall time from plan execution start to the "
+    "collected result, by plan source (sql|plan) and execution tier "
+    "(local|process).",
+    ("source", "cluster"))
+
 
 # --- Prometheus text exposition --------------------------------------------
 

@@ -124,12 +124,15 @@ class PhysicalPlan:
     """Planner output: the rebuilt tree + placement + explain report."""
 
     def __init__(self, root: TpuExec, root_on_device: bool,
-                 meta: NodeMeta, conf: RapidsConf):
+                 meta: NodeMeta, conf: RapidsConf,
+                 source: str = "plan"):
         self.root = root
         self.root_on_device = root_on_device
         self.meta = meta
         self.conf = conf
+        self.source = source  # "sql" | "plan": how the tree was built
         self.last_ctx: Optional[ExecCtx] = None  # metrics of last collect
+        self.last_profile_path: Optional[str] = None
 
     @property
     def output_schema(self):
@@ -179,11 +182,18 @@ class PhysicalPlan:
             with tracer, qspan:
                 if self.root_on_device:
                     try:
+                        _ts = _time.perf_counter()
                         with ctx.mm.task_slot():  # GpuSemaphore admission
+                            # blocking happened at entry: charge the
+                            # admission wait to the root operator (the
+                            # semaphoreWaitTime analog)
+                            ctx.metric(self.root, "ledgerWaitTime") \
+                                .value += _time.perf_counter() - _ts
                             rbs = [device_to_arrow(b)
                                    for b in self.root.execute(ctx)]
                     except BaseException:
                         ctx.discard_deferred()  # dead query's flags
+                        ctx.opm.discard()
                         raise
                     finally:
                         ctx.run_cleanups()
@@ -196,6 +206,7 @@ class PhysicalPlan:
                         rbs = list(self.root.execute_cpu(ctx))
                     except BaseException:
                         ctx.discard_deferred()
+                        ctx.opm.discard()
                         raise
                     finally:
                         ctx.run_cleanups()
@@ -209,9 +220,51 @@ class PhysicalPlan:
                     ctx.tracer.write_chrome(self.conf.get(TRACE_DIR))
                 except OSError:
                     pass
+        wall_s = _time.perf_counter() - _t0
+        self.last_wall_s = wall_s
+        # fold the deferred row counts in now — the downloads above were
+        # the natural sync point, so this readback is already satisfied
+        ctx.opm.finalize()
+        from .obs.metrics import QUERY_DURATION
+        QUERY_DURATION.labels(self.source, "local").observe(wall_s)
         from .tools.event_log import log_query_event
-        log_query_event(self, ctx, _time.perf_counter() - _t0)
+        log_query_event(self, ctx, wall_s)
+        self._write_profile(ctx, wall_s)
         return pa.Table.from_batches(rbs, schema=schema)
+
+    def _write_profile(self, ctx: ExecCtx, wall_s: float) -> None:
+        """Persist one query-profile JSON (spark.rapids.history.dir) —
+        the record `profiling history`/`compare` mine."""
+        from .obs.opmetrics import (HISTORY_DIR, build_profile, fold_ctx,
+                                    write_profile)
+        if not self.conf.get(HISTORY_DIR):
+            return  # don't pay the fold/fingerprint when history is off
+        try:
+            tr = getattr(ctx, "tracer", None)
+            tid = tr.trace_id if tr is not None \
+                and getattr(tr, "enabled", False) else None
+            doc = build_profile(
+                self.root, fold_ctx(ctx), wall_s, source=self.source,
+                cluster="local", trace_id=tid, conf=self.conf,
+                extra={"fallbacks": self.fallback_nodes()})
+            self.last_profile_path = write_profile(self.conf, doc)
+        except Exception:  # noqa: BLE001 — history must never fail
+            pass           # the query it records
+
+    def explain_analyze(self, formatted: bool = False) -> str:
+        """The EXPLAIN ANALYZE text for the last collect(): the
+        executed tree with per-operator rows / batches / time / spill /
+        decode-coverage annotations (obs/opmetrics.py). Requires a
+        prior collect() on this plan."""
+        from .obs.opmetrics import fold_ctx, render_analyzed
+        ctx = self.last_ctx
+        if ctx is None:
+            return self.explain("ALL") + \
+                "\n(no metrics: run collect() first)"
+        ctx.opm.finalize()
+        return render_analyzed(self.root, fold_ctx(ctx),
+                               wall_s=getattr(self, "last_wall_s", None),
+                               formatted=formatted, cluster="local")
 
     def metrics_report(self, ctx: Optional[ExecCtx] = None) -> str:
         """Explain-style tree annotated with the metrics the last
@@ -345,7 +398,15 @@ class TpuOverrides:
         self._tag(meta)
         root = self._convert(meta)
         self._verify(root)
-        pp = PhysicalPlan(root, meta.on_device, meta, self.conf)
+        # stable per-plan operator-instance ids: metric labels survive
+        # pickles, deep copies, AQE reuse and worker processes, so
+        # EXPLAIN ANALYZE / profiles fold per INSTANCE instead of the
+        # old name-based dedup across AQE-duplicated labels
+        from .obs.opmetrics import assign_op_ids
+        assign_op_ids(root, force=True)
+        source = "sql" if getattr(plan, "_sql_origin", False) else "plan"
+        pp = PhysicalPlan(root, meta.on_device, meta, self.conf,
+                          source=source)
         # flight-recorder tap: an incident bundle wants to know what
         # fell back to CPU and why without re-planning — one bounded
         # event per planned query in the always-on ring

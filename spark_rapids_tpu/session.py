@@ -380,6 +380,14 @@ class TpuSession:
             conf = RapidsConf(conf)
         self.conf = conf or RapidsConf()
         self._tables: Dict[str, DataFrame] = {}
+        self._cluster = None  # set_cluster: EXPLAIN ANALYZE target
+
+    def set_cluster(self, cluster) -> None:
+        """Attach a TpuProcessCluster: ``EXPLAIN ANALYZE`` statements
+        then execute across its worker processes and annotate the plan
+        with cross-worker folded per-operator metrics (None detaches —
+        back to in-process execution)."""
+        self._cluster = cluster
 
     # --- SQL frontend -----------------------------------------------------
     def register_table(self, name: str, df: Union["DataFrame",
@@ -411,9 +419,14 @@ class TpuSession:
         """Compile a SQL query into a DataFrame over the same planner
         path DataFrames use. ``EXPLAIN <query>`` returns the
         placement-annotated plan text instead (``EXPLAIN FORMATTED``
-        the full operator tree) without executing. Parse/analysis
-        failures raise SqlParseError / SqlAnalysisError and leave one
-        event-log line (type = the error slug) when
+        the full operator tree) without executing; ``EXPLAIN ANALYZE
+        [FORMATTED] <query>`` EXECUTES the query — in process, or
+        across an attached cluster's workers (``set_cluster``) — and
+        returns the plan annotated with per-operator runtime metrics
+        (rows, batches, time, spill, decode coverage; cross-worker
+        aggregated with per-task max/skew on the cluster path).
+        Parse/analysis failures raise SqlParseError / SqlAnalysisError
+        and leave one event-log line (type = the error slug) when
         ``spark.rapids.eventLog.dir`` is set."""
         from .sql import SqlError, sql_to_plan
         from .tools.event_log import log_sql_error
@@ -425,6 +438,12 @@ class TpuSession:
         if stmt.explain:
             from .planner import TpuOverrides
             pp = TpuOverrides(self.conf).apply(node)
+            if stmt.analyze:
+                if self._cluster is not None:
+                    return self._cluster.explain_analyze(
+                        pp.root, formatted=stmt.formatted)
+                pp.collect()
+                return pp.explain_analyze(formatted=stmt.formatted)
             if stmt.formatted:
                 return pp.root.tree_string()
             return pp.explain("ALL")

@@ -41,6 +41,9 @@ def sql_to_plan(text: str, session):
     from .compiler import SqlCompiler
     stmt = parse_statement(text)
     rel = SqlCompiler(session, text).compile_query(stmt.query, {})
+    # origin mark: the query-duration histogram and query profiles
+    # label SQL-compiled plans source=sql (obs/opmetrics.plan_source)
+    rel.node._sql_origin = True
     return rel.node, stmt
 
 
@@ -49,7 +52,9 @@ def sql_to_plan(text: str, session):
 DIALECT = {
     "statements": [
         "SELECT [DISTINCT] with expressions and aliases",
-        "EXPLAIN [FORMATTED] <query> (returns plan text)",
+        "EXPLAIN [FORMATTED] <query> (returns plan text) and "
+        "EXPLAIN ANALYZE [FORMATTED] <query> (executes, returns the "
+        "plan annotated with per-operator runtime metrics)",
         "WITH-clause CTEs (scoped, shadowing, multi-reference)",
         "UNION ALL (position-wise, numeric widening)",
     ],

@@ -222,6 +222,7 @@ class Query(Node):
 class Statement(Node):
     query: Query = None
     explain: bool = False
+    analyze: bool = False   # EXPLAIN ANALYZE: execute, then annotate
     formatted: bool = False
     loc: Tuple[int, int] = _loc()
 

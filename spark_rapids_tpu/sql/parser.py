@@ -114,16 +114,20 @@ class _Parser:
     # --- statement --------------------------------------------------------
     def parse_statement(self) -> A.Statement:
         loc = self.cur.loc
-        explain = formatted = False
+        explain = analyze = formatted = False
         if self.take_kw("EXPLAIN"):
             explain = True
+            # EXPLAIN ANALYZE executes the query and annotates every
+            # operator with its runtime metrics; plain EXPLAIN only
+            # plans. FORMATTED widens either form.
+            analyze = self.take_kw("ANALYZE")
             formatted = self.take_kw("FORMATTED")
         q = self.parse_query()
         if self.cur.kind != "eof":
             raise self.err(f"unexpected {self._describe(self.cur)} "
                            "after end of statement")
-        return A.Statement(query=q, explain=explain, formatted=formatted,
-                           loc=loc)
+        return A.Statement(query=q, explain=explain, analyze=analyze,
+                           formatted=formatted, loc=loc)
 
     def parse_query(self) -> A.Query:
         loc = self.cur.loc

@@ -84,9 +84,22 @@ def log_query_event(pp, ctx, wall_s: float) -> None:
             rec(c, depth + 1)
 
     rec(pp.meta, 0)
+    # fold deferred device row counts in before snapshotting: every
+    # caller of this function sits at (or after) the query's natural
+    # sync point, and some (ml.py) don't finalize themselves
+    opm = getattr(ctx, "opm", None) if ctx is not None else None
+    if opm is not None:
+        opm.finalize()
     metrics = {
         label: {name: m.value for name, m in ms.items()}
         for label, ms in (ctx.metrics if ctx else {}).items()}
+    # top per-operator time sinks ride the event line itself, so the
+    # qualification/profiling tools get operator attribution without
+    # opening the query's profile file
+    op_sinks = []
+    if metrics:
+        from ..obs.opmetrics import fold_snapshots, top_op_sinks
+        op_sinks = top_op_sinks(fold_snapshots([{"ops": metrics}]))
     event = {
         "ts": time.time(),
         "fingerprint": plan_fingerprint(pp.root),
@@ -94,6 +107,7 @@ def log_query_event(pp, ctx, wall_s: float) -> None:
         "sql_enabled": pp.conf.sql_enabled,
         "nodes": nodes,
         "metrics": metrics,
+        "op_sinks": op_sinks,
         "conf": {k: str(v) for k, v in pp.conf.items().items()},
         "plan": pp.root.tree_string(),
     }
@@ -145,11 +159,14 @@ def log_sql_error(conf, err, sql_text: str) -> None:
     _prune_event_logs(conf, base)
 
 
-def log_scheduler_events(conf, query_id: str, sched, wall_s: float) -> None:
+def log_scheduler_events(conf, query_id: str, sched, wall_s: float,
+                         op_sinks: Optional[List[Dict]] = None) -> None:
     """Append one scheduler event per cluster query: the attempt
     timeline (submit/ok/failed/lost/speculative, worker deaths,
     respawns, blacklists) plus a rollup — what the profiler mines for
-    retry overhead. No-op unless spark.rapids.eventLog.dir is set."""
+    retry overhead — and the query's top per-operator time sinks
+    (cross-worker folded opmetrics). No-op unless
+    spark.rapids.eventLog.dir is set."""
     base = conf.get(EVENT_LOG_DIR)
     if not base:
         return
@@ -160,6 +177,7 @@ def log_scheduler_events(conf, query_id: str, sched, wall_s: float) -> None:
         "wall_s": round(wall_s, 6),
         "summary": sched.summary(),
         "attempts": sched.events,
+        "op_sinks": op_sinks or [],
     }
     tr = getattr(sched, "tracer", None)
     if tr is not None and getattr(tr, "enabled", False):

@@ -9,10 +9,12 @@ the annotated plan plus ranked hotspots and recommendations.
 """
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional
 
 __all__ = ["profile_report", "profile_event_logs", "critical_path",
-           "profile_trace", "triage_report"]
+           "profile_trace", "triage_report", "history_report",
+           "compare_report"]
 
 
 def profile_report(pp, ctx=None) -> str:
@@ -24,20 +26,20 @@ def profile_report(pp, ctx=None) -> str:
         lines.append("(no metrics: run collect() first)")
         return "\n".join(lines)
 
-    # ranked hotspots by opTime, merged across instance labels: AQE
-    # re-planning deep-copies re-used sub-plans (a reused exchange gets
-    # a fresh #id per use), which showed as duplicate rows — merge
-    # same-operator instances before ranking
-    merged: Dict[str, List[float]] = {}
-    for label, ms in ctx.metrics.items():
-        t = ms.get("opTime")
-        if t is not None and t.value:
-            agg = merged.setdefault(label.split("#", 1)[0], [0.0, 0])
-            agg[0] += t.value
-            agg[1] += 1
-    hot = [(v[0], f"{op} (x{v[1]})" if v[1] > 1 else op)
-           for op, v in merged.items()]
-    hot.sort(reverse=True)
+    # ranked hotspots by opTime, keyed on the stable operator-INSTANCE
+    # id the planner stamps (obs/opmetrics.assign_op_ids): AQE
+    # re-planning deep-copies reused sub-plans WITH their ids, so
+    # duplicated instances accumulate into one metric row at the store
+    # itself — the old name-based dedup across fresh #ids is gone, and
+    # two distinct instances of the same operator class now rank
+    # separately (per-instance attribution, like the reference UI)
+    from ..obs.opmetrics import fold_snapshots
+    folded = fold_snapshots([{"ops": {
+        label: {name: m.value for name, m in ms.items()}
+        for label, ms in ctx.metrics.items()}}])
+    hot = sorted(((st["metrics"]["opTime"], st["label"])
+                  for st in folded.values()
+                  if st["metrics"].get("opTime")), reverse=True)
     if hot:
         lines.append("hotspots:")
         total = sum(t for t, _ in hot) or 1.0
@@ -489,12 +491,173 @@ def triage_report(bundle) -> str:
     return "\n".join(lines)
 
 
+# --- query-profile history + cross-run comparison ----------------------------
+# The persisted profile-<id>.json files (spark.rapids.history.dir,
+# written by PhysicalPlan.collect and TpuProcessCluster.run_query via
+# obs/opmetrics.py) are the offline record of per-operator runtime:
+# `history` lists/inspects them, `compare` diffs two runs per OPERATOR
+# so a BENCH-level regression (one opaque number) decomposes into
+# "which node ate it". `compare` also accepts two BENCH_r0x.json files.
+
+def history_report(path: str, profile_id: Optional[str] = None) -> str:
+    """List the profiles under a history dir, or inspect one (by
+    profile id, filename, or unique prefix): the annotated plan plus
+    the per-operator aggregate table."""
+    from ..obs.opmetrics import read_profiles
+    profs = read_profiles(path)
+    if not profs:
+        return f"(no query profiles under {path})"
+    if profile_id:
+        matches = [(fp, doc) for fp, doc in profs
+                   if profile_id in (doc.get("profile_id", ""),
+                                     os.path.basename(fp))
+                   or doc.get("profile_id", "").startswith(profile_id)]
+        if not matches:
+            return f"(no profile matching {profile_id!r} under {path})"
+        return "\n\n".join(_render_profile(doc) for _, doc in matches)
+    lines = [f"=== query-profile history ({path}) ===",
+             f"{len(profs)} profiles (oldest first):"]
+    for fp, doc in profs:
+        sinks = sorted(doc.get("ops", {}).values(),
+                       key=lambda st: -st.get("metrics", {})
+                       .get("opTime", 0.0))
+        top = "-"
+        if sinks and sinks[0].get("metrics", {}).get("opTime"):
+            top = sinks[0].get("label", "?")
+        lines.append(
+            f"  {doc.get('profile_id', os.path.basename(fp)):<28} "
+            f"{doc.get('query', '') or '-':<6} "
+            f"{doc.get('cluster', '?'):<8} {doc.get('source', '?'):<5} "
+            f"{doc.get('wall_s', 0.0) * 1e3:9.1f}ms  top: {top}")
+    return "\n".join(lines)
+
+
+def _render_profile(doc: dict) -> str:
+    lines = [f"=== {doc.get('profile_id', '?')} "
+             f"(query {doc.get('query', '') or '-'}, "
+             f"{doc.get('cluster', '?')}/{doc.get('source', '?')}, "
+             f"{doc.get('wall_s', 0.0) * 1e3:.1f}ms, "
+             f"fingerprint {doc.get('fingerprint', '?')}) ==="]
+    from ..obs.opmetrics import _fold_key
+    ops = doc.get("ops", {})
+    by_label = {st.get("label", k): (k, st) for k, st in ops.items()}
+    for n in doc.get("nodes", []):
+        pad = "  " * int(n.get("depth", 0))
+        st = by_label.get(n.get("label"), (None, None))[1]
+        if st is None:
+            st = ops.get(_fold_key(n.get("label", "")))
+        ann = ""
+        if st:
+            m = st.get("metrics", {})
+            bits = [f"rows={int(m.get('rows', 0))}",
+                    f"opTime={m.get('opTime', 0.0) * 1e3:.2f}ms"]
+            if st.get("tasks", 1) > 1:
+                bits.append(f"tasks={st['tasks']} "
+                            f"skew={st.get('skew', 1.0)}")
+            ann = "  [" + ", ".join(bits) + "]"
+        lines.append(f"{pad}{n.get('describe', n.get('op', '?'))}{ann}")
+    return "\n".join(lines)
+
+
+def _load_compare_doc(path: str) -> dict:
+    import json
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]  # BENCH_r0x.json wrapper
+    return doc if isinstance(doc, dict) else {}
+
+
+def compare_report(a_path: str, b_path: str,
+                   threshold: float = 1.5) -> str:
+    """Per-operator time/rows deltas between two query profiles (A =
+    baseline, B = candidate); operators whose opTime grew by at least
+    ``threshold``x (above a 1ms floor) are flagged REGRESSED. Two
+    BENCH json files compare their shared scalar metrics instead."""
+    a, b = _load_compare_doc(a_path), _load_compare_doc(b_path)
+    if not (isinstance(a.get("ops"), dict)
+            and isinstance(b.get("ops"), dict)):
+        return _compare_bench(a, b, a_path, b_path, threshold)
+    lines = [f"=== profile compare (A={a.get('profile_id', a_path)}, "
+             f"B={b.get('profile_id', b_path)}, "
+             f"threshold {threshold}x) ==="]
+    wa, wb = a.get("wall_s", 0.0), b.get("wall_s", 0.0)
+    ratio = f"{wb / wa:.2f}x" if wa > 0 else "n/a"
+    lines.append(f"wall: {wa * 1e3:.1f}ms -> {wb * 1e3:.1f}ms ({ratio})")
+    if a.get("fingerprint") != b.get("fingerprint"):
+        lines.append("NOTE: plan fingerprints differ — operator ids "
+                     "may not describe the same plan shape")
+    aops, bops = a["ops"], b["ops"]
+    rows_out = []
+    regressions = 0
+    for key in sorted(set(aops) | set(bops),
+                      key=lambda k: -(bops.get(k, aops.get(k, {}))
+                                      .get("metrics", {})
+                                      .get("opTime", 0.0))):
+        sa, sb = aops.get(key), bops.get(key)
+        label = (sb or sa).get("label", key)
+        if sa is None:
+            rows_out.append(f"  {label:<36} only in B")
+            continue
+        if sb is None:
+            rows_out.append(f"  {label:<36} only in A")
+            continue
+        ta = sa.get("metrics", {}).get("opTime", 0.0)
+        tb = sb.get("metrics", {}).get("opTime", 0.0)
+        ra = int(sa.get("metrics", {}).get("rows", 0))
+        rb = int(sb.get("metrics", {}).get("rows", 0))
+        flag = ""
+        if tb > max(ta * threshold, ta + 1e-3):
+            flag = f"  <-- REGRESSED ({tb / ta:.1f}x)" if ta > 0 \
+                else "  <-- REGRESSED (new time)"
+            regressions += 1
+        drows = f" rows {ra}->{rb}" if ra != rb else f" rows {ra}"
+        rows_out.append(f"  {label:<36} {ta * 1e3:9.2f}ms -> "
+                        f"{tb * 1e3:9.2f}ms{drows}{flag}")
+    lines.append(f"per-operator opTime (A -> B), {regressions} "
+                 f"regression(s):")
+    lines.extend(rows_out)
+    return "\n".join(lines)
+
+
+def _compare_bench(a: dict, b: dict, a_path: str, b_path: str,
+                   threshold: float) -> str:
+    """Scalar diff of two BENCH json documents (shared numeric keys,
+    ratio-sorted); changes beyond the threshold in either direction
+    are flagged."""
+    lines = [f"=== bench compare (A={a_path}, B={b_path}) ==="]
+    keys = [k for k in a if k in b
+            and isinstance(a[k], (int, float))
+            and isinstance(b[k], (int, float))
+            and not isinstance(a[k], bool)]
+    if not keys:
+        return "\n".join(lines + ["(no shared numeric metrics)"])
+
+    def _ratio(k):
+        return (b[k] / a[k]) if a[k] else float("inf")
+    import math
+    keys.sort(key=lambda k: -abs(math.log(max(_ratio(k), 1e-12)))
+              if _ratio(k) not in (0, float("inf")) else float("-inf"))
+    for k in keys:
+        r = _ratio(k)
+        flag = ""
+        if r and r != float("inf") \
+                and (r >= threshold or r <= 1.0 / threshold):
+            flag = f"  <-- CHANGED ({r:.2f}x)"
+        rtxt = f"{r:.3f}x" if r not in (0, float("inf")) else "n/a"
+        lines.append(f"  {k:<40} {a[k]:>12} -> {b[k]:>12}  "
+                     f"{rtxt}{flag}")
+    return "\n".join(lines)
+
+
 def _main(argv):
     import sys
+    usage = ("usage: python -m spark_rapids_tpu.tools.profiling "
+             "<event-log dir | trace-*.json | triage <incident.json> | "
+             "history <dir> [profile-id] | "
+             "compare <a.json> <b.json> [--threshold X]>")
     if not argv:
-        print("usage: python -m spark_rapids_tpu.tools.profiling "
-              "<event-log dir | trace-*.json | triage <incident.json>>",
-              file=sys.stderr)
+        print(usage, file=sys.stderr)
         return 2
     if argv[0] == "triage":
         if len(argv) < 2:
@@ -502,6 +665,27 @@ def _main(argv):
                   file=sys.stderr)
             return 2
         print(triage_report(argv[1]))
+    elif argv[0] == "history":
+        if len(argv) < 2:
+            print("usage: profiling history <dir> [profile-id]",
+                  file=sys.stderr)
+            return 2
+        print(history_report(argv[1],
+                             argv[2] if len(argv) > 2 else None))
+    elif argv[0] == "compare":
+        rest = [a for a in argv[1:] if not a.startswith("--")]
+        threshold = 1.5
+        for i, a in enumerate(argv):
+            if a == "--threshold" and i + 1 < len(argv):
+                threshold = float(argv[i + 1])
+                rest = [x for x in rest if x != argv[i + 1]]
+            elif a.startswith("--threshold="):
+                threshold = float(a.split("=", 1)[1])
+        if len(rest) != 2:
+            print("usage: profiling compare <a.json> <b.json> "
+                  "[--threshold X]", file=sys.stderr)
+            return 2
+        print(compare_report(rest[0], rest[1], threshold=threshold))
     elif argv[0].endswith(".json"):
         print(profile_trace(argv[0]))
     else:

@@ -300,9 +300,14 @@ def test_critical_path_empty_and_orphans():
     assert [p["name"] for p in critical_path(spans)] == ["a"]
 
 
-# --- hotspot dedup (satellite) ---------------------------------------------
+# --- hotspot keying on stable instance ids (satellite) ----------------------
+# The old name-based dedup across AQE-duplicated instance labels is
+# GONE: planner-assigned #op<N> ids make AQE deep copies of a reused
+# sub-plan accumulate into one metric row at the store itself, while
+# two genuinely distinct instances of the same operator class rank as
+# separate hotspots (per-instance attribution).
 
-def test_profile_report_merges_duplicate_instance_labels():
+def test_profile_report_keys_hotspots_on_stable_instance_ids():
     from spark_rapids_tpu.exec.base import TpuMetric
     from spark_rapids_tpu.exec import HostBatchSourceExec, TpuProjectExec
     from spark_rapids_tpu.expr import Alias, UnresolvedColumn as col
@@ -313,17 +318,18 @@ def test_profile_report_merges_duplicate_instance_labels():
                    RapidsConf())
     pp.collect()
     ctx = pp.last_ctx
-    # simulate an AQE re-used exchange: same operator class, two
-    # instance labels — must merge into one ranked row
-    for label, v in (("ShuffleExchangeExec#90", 0.5),
-                     ("ShuffleExchangeExec#91", 0.25)):
+    # an AQE-reused exchange keeps ONE stable label, so both uses hit
+    # the same store entry; a second exchange instance keeps its own
+    for label, v in (("ShuffleExchangeExec#op90", 0.75),
+                     ("ShuffleExchangeExec#op91", 0.25)):
         m = TpuMetric("opTime")
         m.value = v
         ctx.metrics[label] = {"opTime": m}
     rep = profile_report(pp)
-    assert "ShuffleExchangeExec (x2)" in rep
-    assert rep.count("ShuffleExchangeExec") == 1
-    assert "750.00ms" in rep
+    assert "ShuffleExchangeExec#op90" in rep
+    assert "ShuffleExchangeExec#op91" in rep
+    assert "(x2)" not in rep  # the merge hack is gone
+    assert "750.00ms" in rep and "250.00ms" in rep
 
 
 # --- event-log reader guarantees (satellite) --------------------------------

@@ -28,6 +28,14 @@ Two validators and one driver:
   query end to end on a 2-worker process cluster against the pandas
   oracle, and assert a broken statement leaves a ``sql_parse_error``
   event-log line — the SQL-frontend CI gate.
+- ``--profile FILE``  validate a query-profile JSON
+  (``spark.rapids.history.dir`` output: required keys, non-empty plan
+  record + per-operator aggregate, coherent totals/maxima).
+- ``--analyze-smoke DIR``  run ``EXPLAIN ANALYZE`` on NDS q3 FROM SQL
+  over a 2-worker process cluster: every scan/join/agg node must show
+  nonzero cross-worker rows, the run must persist a valid profile
+  json, and ``profiling compare`` across two runs must render — the
+  operator-metrics CI gate.
 
 Exit status 0 = all checks passed; failures are listed on stderr.
 """
@@ -329,6 +337,107 @@ def run_shuffle_smoke(out_dir):
     return bundle
 
 
+_PROFILE_KEYS = ("version", "profile_id", "ts", "query", "source",
+                 "cluster", "wall_s", "fingerprint", "nodes", "ops")
+
+
+def check_profile(path):
+    """Query-profile schema: required keys, a non-empty plan node list,
+    a non-empty per-operator aggregate with coherent totals (rows and
+    opTime non-negative, per-task max <= total, tasks >= 1)."""
+    errors = []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"profile unreadable: {e}"]
+    if not isinstance(doc, dict):
+        return ["profile is not a JSON object"]
+    for k in _PROFILE_KEYS:
+        if k not in doc:
+            errors.append(f"missing key {k}")
+    if errors:
+        return errors
+    if not str(doc["profile_id"]).startswith("profile-"):
+        errors.append(f"profile_id malformed: {doc['profile_id']!r}")
+    if doc["source"] not in ("sql", "plan"):
+        errors.append(f"bad source {doc['source']!r}")
+    if doc["cluster"] not in ("local", "process"):
+        errors.append(f"bad cluster {doc['cluster']!r}")
+    if not isinstance(doc["nodes"], list) or not doc["nodes"]:
+        errors.append("nodes (plan record) empty")
+    ops = doc["ops"]
+    if not isinstance(ops, dict) or not ops:
+        errors.append("ops (per-operator aggregate) empty")
+        return errors
+    for key, st in ops.items():
+        m = st.get("metrics", {})
+        if st.get("tasks", 0) < 1:
+            errors.append(f"{key}: tasks < 1")
+        for name in ("rows", "opTime"):
+            if m.get(name, 0) < 0:
+                errors.append(f"{key}: negative {name}")
+            mx = st.get("max", {}).get(name)
+            if mx is not None and mx > m.get(name, 0) + 1e-9:
+                errors.append(f"{key}: max {name} {mx} exceeds "
+                              f"total {m.get(name, 0)}")
+    return errors
+
+
+def run_analyze_smoke(out_dir):
+    """EXPLAIN ANALYZE CI gate: run NDS q3 FROM SQL over a 2-worker
+    process cluster via ``session.sql('EXPLAIN ANALYZE ...')``; the
+    returned text must annotate every source/join/aggregate node with
+    nonzero rows, the run must persist a valid query-profile JSON
+    under spark.rapids.history.dir, and a second run must compare
+    cleanly through `profiling compare`. Returns the profile path."""
+    import re as _re
+
+    from spark_rapids_tpu.cluster import TpuProcessCluster
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.tools.nds import (SQL_QUERIES, build_query_sql,
+                                            gen_tables)
+    from spark_rapids_tpu.tools.profiling import compare_report
+    history_dir = os.path.join(out_dir, "history")
+    tables = gen_tables(n_sales=1 << 12)
+    s = TpuSession(conf={"spark.sql.shuffle.partitions": "1"})
+    build_query_sql("q3", s, tables)  # registers the corpus views
+    conf = RapidsConf({"spark.rapids.history.dir": history_dir})
+    with TpuProcessCluster(n_workers=2, conf=conf) as c:
+        s.set_cluster(c)
+        text = s.sql("EXPLAIN ANALYZE " + SQL_QUERIES["q3"])
+        first_profile = c.last_profile_path
+        s.sql("EXPLAIN ANALYZE " + SQL_QUERIES["q3"])  # second run
+        second_profile = c.last_profile_path
+    print(text)
+    # every operator id appears exactly once
+    ids = _re.findall(r"\(op(\d+)\)", text)
+    assert ids and len(ids) == len(set(ids)), \
+        f"operator ids not unique in EXPLAIN ANALYZE text: {ids}"
+    # nonzero rows at every scan/join/agg node
+    checked = 0
+    for line in text.splitlines():
+        if not any(op in line for op in
+                   ("HostBatchSourceExec", "FileScanExec",
+                    "ShuffledHashJoinExec", "HashAggregateExec")):
+            continue
+        m = _re.search(r"rows=(\d+)", line)
+        assert m and int(m.group(1)) > 0, \
+            f"scan/join/agg node without nonzero rows: {line!r}"
+        checked += 1
+    assert checked >= 4, f"too few scan/join/agg nodes checked: {text}"
+    assert first_profile and os.path.exists(first_profile), \
+        "no query profile written"
+    assert second_profile and second_profile != first_profile, \
+        "second run did not write its own profile"
+    cmp_text = compare_report(first_profile, second_profile)
+    assert "per-operator opTime" in cmp_text, cmp_text
+    print(f"analyze smoke: {checked} scan/join/agg nodes with nonzero "
+          f"rows; compare across 2 runs OK")
+    return first_profile
+
+
 def run_smoke(out_dir):
     """One tiny query with tracing + metrics on; returns (trace_path,
     prom_path)."""
@@ -542,6 +651,13 @@ def main(argv=None):
                          "corpus (zero parse failures / fallbacks) and "
                          "run one SQL query end to end on the process "
                          "cluster")
+    ap.add_argument("--profile", help="query-profile JSON to validate")
+    ap.add_argument("--analyze-smoke", metavar="DIR",
+                    dest="analyze_smoke",
+                    help="EXPLAIN ANALYZE q3 from SQL on a 2-worker "
+                         "process cluster: nonzero rows at every "
+                         "scan/join/agg node, a valid profile json, "
+                         "and a clean profiling compare of two runs")
     args = ap.parse_args(argv)
     errors = []
     trace, prom = args.trace, args.prom
@@ -572,14 +688,22 @@ def main(argv=None):
         os.makedirs(args.sql_smoke, exist_ok=True)
         run_sql_smoke(args.sql_smoke)
         ran_sql = True
-    if not trace and not prom and not flights and not ran_sql:
+    profiles = [args.profile] if args.profile else []
+    if args.analyze_smoke:
+        os.makedirs(args.analyze_smoke, exist_ok=True)
+        profiles.append(run_analyze_smoke(args.analyze_smoke))
+        print(f"analyze smoke output: {profiles[-1]}")
+    if not trace and not prom and not flights and not ran_sql \
+            and not profiles:
         ap.error("nothing to do: pass --trace/--prom/--smoke/"
                  "--scan-smoke/--flight/--flight-smoke/--shuffle-smoke/"
-                 "--sql-smoke")
+                 "--sql-smoke/--profile/--analyze-smoke")
     if trace:
         errors += [f"[trace] {e}" for e in check_trace(trace)]
     for fl in flights:
         errors += [f"[flight] {e}" for e in check_flight(fl)]
+    for pf in profiles:
+        errors += [f"[profile] {e}" for e in check_profile(pf)]
     if prom:
         try:
             with open(prom) as f:

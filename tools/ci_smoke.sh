@@ -32,46 +32,53 @@
 #      fallbacks), one SQL query runs end to end on the process
 #      cluster against the pandas oracle, and a broken statement
 #      leaves a sql_parse_error event-log line
+#  11. operator-metrics smoke: EXPLAIN ANALYZE q3 from SQL on a
+#      2-worker process cluster yields nonzero cross-worker rows at
+#      every scan/join/agg node, persists a schema-valid query-profile
+#      JSON, and `profiling compare` renders across two runs
 #
 # Pass --full to also run the tier-1 suite (see ROADMAP.md), bounded to
 # 870s like the driver's own gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/10 compileall =="
+echo "== 1/11 compileall =="
 python -m compileall -q spark_rapids_tpu tests
 
-echo "== 2/10 package import =="
+echo "== 2/11 package import =="
 JAX_PLATFORMS=cpu python -c "import spark_rapids_tpu; print('import ok:', spark_rapids_tpu.__name__)"
 
-echo "== 3/10 pytest collection =="
+echo "== 3/11 pytest collection =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q --collect-only -m 'not slow' \
     -p no:cacheprovider 2>&1 | tail -3
 
-echo "== 4/10 observability smoke =="
+echo "== 4/11 observability smoke =="
 OBS_TMP="$(mktemp -d)"
 trap 'rm -rf "$OBS_TMP"' EXIT
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --smoke "$OBS_TMP"
 
-echo "== 5/10 device-decode scan smoke =="
+echo "== 5/11 device-decode scan smoke =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --scan-smoke "$OBS_TMP/scan"
 
-echo "== 6/10 flight-recorder smoke =="
+echo "== 6/11 flight-recorder smoke =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --flight-smoke "$OBS_TMP/flight"
 
-echo "== 7/10 shuffle-durability smoke =="
+echo "== 7/11 shuffle-durability smoke =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --shuffle-smoke "$OBS_TMP/shuffle"
 
-echo "== 8/10 static analysis (tpu-lint + plan verifier) =="
+echo "== 8/11 static analysis (tpu-lint + plan verifier) =="
 JAX_PLATFORMS=cpu python tools/tpu_lint.py --json
 JAX_PLATFORMS=cpu python tools/tpu_lint.py --check-docs
 JAX_PLATFORMS=cpu python -m spark_rapids_tpu.analysis.plan_verifier --smoke
 
-echo "== 9/10 widened-envelope scan smoke (mixed encodings) =="
+echo "== 9/11 widened-envelope scan smoke (mixed encodings) =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --scan-smoke "$OBS_TMP/scan-envelope" --mixed-encodings
 
-echo "== 10/10 SQL frontend smoke (full corpus + cluster run) =="
+echo "== 10/11 SQL frontend smoke (full corpus + cluster run) =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --sql-smoke "$OBS_TMP/sql"
+
+echo "== 11/11 operator-metrics smoke (EXPLAIN ANALYZE + profile) =="
+JAX_PLATFORMS=cpu python tools/check_obs_output.py --analyze-smoke "$OBS_TMP/analyze"
 
 if [[ "${1:-}" == "--full" ]]; then
     echo "== tier-1 (full) =="
