@@ -1,6 +1,9 @@
 """Static analysis for the runtime: pre-execution plan verification
-(plan_verifier.py) and the tpu-lint AST rule engine over the package
-itself (lint.py). See also tools/tpu_lint.py for the CLI.
+(plan_verifier.py), the tpu-lint rule engine (lint.py — statement
+rules plus the interprocedural dataflow analyses in dataflow.py /
+locks.py / ledger.py / jit_taint.py), and the runtime lock-order
+watchdog (lockwatch.py), which verifies the declared lock hierarchy
+against real executions. See also tools/tpu_lint.py for the CLI.
 
 Re-exports are lazy so ``python -m
 spark_rapids_tpu.analysis.plan_verifier`` does not import the
@@ -8,7 +11,7 @@ submodule twice (runpy warns when the package eagerly imports what -m
 is about to execute)."""
 
 __all__ = ["PlanVerificationError", "PlanVerifier", "VerifyReport",
-           "verify_plan", "lint_package", "lint_paths"]
+           "verify_plan", "lint_package", "lint_paths", "lockwatch"]
 
 
 def __getattr__(name):
@@ -19,4 +22,9 @@ def __getattr__(name):
     if name in ("lint_package", "lint_paths"):
         from . import lint
         return getattr(lint, name)
+    if name == "lockwatch":
+        # importlib, not `from . import`: the fromlist probe would
+        # re-enter this __getattr__ before the submodule finishes
+        import importlib
+        return importlib.import_module(".lockwatch", __name__)
     raise AttributeError(name)

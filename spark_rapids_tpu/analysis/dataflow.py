@@ -1,0 +1,805 @@
+"""Intraprocedural-CFG + call-graph dataflow engine for tpu-lint 2.0.
+
+PR 6's lint rules are single-statement pattern matches; every bug class
+the runtime has actually shipped since (unreleased ledger reservations
+on *error paths*, blocking calls while a lock is held *across helper
+calls*, host syncs reachable from a jit region *through the call
+graph*) is a property of paths and calls, not statements. This module
+is the shared machinery the path-sensitive analyses (locks.py,
+ledger.py, jit_taint.py) plug into:
+
+- ``CFG``: basic blocks over the Python AST of one function, with
+  branch/loop edges, ``with`` enter/exit markers, try/except/finally
+  structure, and **exception edges** — every potentially-raising block
+  has an edge to the innermost handler (or the function's exceptional
+  exit), so a fact that escapes on a raise path is visible. ``finally``
+  bodies are rebuilt per path (normal / exceptional / abrupt
+  return-break-continue), so a release in a finally counts on every
+  path it really runs on.
+- ``solve``: a forward worklist solver over a pluggable
+  :class:`Analysis` (transfer per statement, join at merges, separate
+  exception-edge transfer); facts must be hashable values with
+  structural equality.
+- ``Project``: package-wide function index + call graph. Resolution is
+  deliberately modest — ``self.m()`` to the same class, bare names to
+  the same module (including nested defs), attribute calls through a
+  small attr→class type map built from ``__init__`` assignments and
+  parameter annotations, then a unique-name fallback — and analyses
+  propagate facts through it with bounded-fixpoint **call summaries**
+  (:func:`fixpoint_summaries`), so one level of helper indirection
+  (and, at fixpoint, N levels) cannot hide a fact.
+
+The engine is ``ast``-exact like lint.py: no regex over source, no
+imports of the analyzed code.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import (Callable, Dict, Iterable, List, Optional, Sequence,
+                    Set, Tuple)
+
+__all__ = ["CFG", "Block", "WithEnter", "WithExit", "ExceptEnter",
+           "LoopIter", "BranchTest", "Analysis", "solve", "Project",
+           "FuncInfo", "fixpoint_summaries", "call_name", "stmt_calls"]
+
+
+# --- synthetic statements ----------------------------------------------------
+#
+# Compound statements are decomposed into blocks; the parts a transfer
+# function needs to see (entering/leaving a `with`, binding an except,
+# advancing a loop iterator) become synthetic statements carrying the
+# original AST node and line.
+
+class _Synth:
+    __slots__ = ("node", "lineno")
+
+    def __init__(self, node, lineno: int):
+        self.node = node
+        self.lineno = lineno
+
+    def __repr__(self):  # pragma: no cover - debug only
+        return f"{type(self).__name__}@{self.lineno}"
+
+
+class WithEnter(_Synth):
+    """Context-manager entry for ONE withitem (`node` is the withitem)."""
+
+
+class WithExit(_Synth):
+    """Context-manager exit for ONE withitem — present on normal,
+    exceptional, and abrupt (return/break/continue) paths alike."""
+
+
+class ExceptEnter(_Synth):
+    """Entry into an except handler (`node` is the ExceptHandler)."""
+
+
+class LoopIter(_Synth):
+    """One advance of a `for` loop's iterator (`node` is the For).
+    Raising iterators take this block's exception edge."""
+
+
+class BranchTest(_Synth):
+    """An if/while test (`node` is the test expression). The block's
+    "true"/"false" successor edges carry facts refined through
+    :meth:`Analysis.transfer_branch`."""
+
+
+@dataclasses.dataclass
+class Block:
+    bid: int
+    stmts: List[object] = dataclasses.field(default_factory=list)
+    # (target block id, kind); kinds: "normal", "true", "false", "iter",
+    # "exhaust", "exc", "back"
+    succs: List[Tuple[int, str]] = dataclasses.field(default_factory=list)
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted tail of a call target ('time.time', 'self._mgr._lock.acquire')."""
+    parts = []
+    f = node.func
+    while isinstance(f, ast.Attribute):
+        parts.append(f.attr)
+        f = f.value
+    if isinstance(f, ast.Name):
+        parts.append(f.id)
+    return ".".join(reversed(parts))
+
+
+def stmt_calls(stmt) -> List[ast.Call]:
+    """Every Call inside a (possibly synthetic) statement, excluding
+    bodies of nested function/class definitions (their calls run at
+    *their* call time, not here)."""
+    node = stmt.node if isinstance(stmt, _Synth) else stmt
+    out: List[ast.Call] = []
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)) and n is not node:
+            continue
+        if isinstance(n, ast.Call):
+            out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _may_raise(stmt) -> bool:
+    """Conservative: a statement gets an exception edge iff it contains
+    a call / subscript / raise / assert (the raise sites that matter to
+    the analyses). Plain name/attr loads and stores do not."""
+    if isinstance(stmt, (WithExit, ExceptEnter)):
+        return False
+    if isinstance(stmt, LoopIter):
+        return True  # the iterator's __next__ can raise
+    node = stmt.node if isinstance(stmt, _Synth) else stmt
+    if isinstance(node, (ast.Raise, ast.Assert)):
+        return True
+    for n in ast.walk(node):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)) and n is not node:
+            continue
+        # Subscript (KeyError/IndexError) deliberately does NOT raise
+        # here: the `closed[0]` / `d[k]` idioms are pervasive and the
+        # exception-edge noise outweighs the rare real leak across a
+        # failing lookup
+        if isinstance(n, (ast.Call, ast.Await)):
+            return True
+    return False
+
+
+class CFG:
+    """Control-flow graph of one function: basic blocks of (synthetic)
+    statements, entry/exit/raise_exit block ids."""
+
+    def __init__(self, func: ast.AST):
+        self.func = func
+        self.blocks: List[Block] = []
+        self.entry = self._new()
+        self.exit = self._new()        # normal returns / fallthrough
+        self.raise_exit = self._new()  # uncaught exceptions
+        b = _Builder(self)
+        b.build(func.body, self.entry)
+
+    def _new(self) -> int:
+        blk = Block(len(self.blocks))
+        self.blocks.append(blk)
+        return blk.bid
+
+    def block(self, bid: int) -> Block:
+        return self.blocks[bid]
+
+    def preds(self) -> Dict[int, List[Tuple[int, str]]]:
+        out: Dict[int, List[Tuple[int, str]]] = {
+            b.bid: [] for b in self.blocks}
+        for b in self.blocks:
+            for t, kind in b.succs:
+                out[t].append((b.bid, kind))
+        return out
+
+
+class _Builder:
+    """Recursive CFG construction. A block is closed at every statement
+    that may raise (so exception-edge facts are exact up to the raising
+    statement) and at every control construct."""
+
+    def __init__(self, cfg: CFG):
+        self.cfg = cfg
+        # cleanup stack entries, innermost last:
+        #   ("with", withitem) | ("finally", body)
+        self.cleanup: List[Tuple[str, object]] = []
+        # loop stack: (break_target, continue_target, cleanup_depth)
+        self.loops: List[Tuple[int, int, int]] = []
+        self.exc = cfg.raise_exit
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _edge(self, frm: int, to: int, kind: str = "normal"):
+        self.cfg.block(frm).succs.append((to, kind))
+
+    def _emit(self, cur: int, stmt) -> int:
+        """Append one statement; if it may raise, close the block with
+        an exception edge and continue in a fresh one."""
+        self.cfg.block(cur).stmts.append(stmt)
+        if _may_raise(stmt):
+            nxt = self.cfg._new()
+            self._edge(cur, nxt)
+            self._edge(cur, self.exc, "exc")
+            return nxt
+        return cur
+
+    # -- abrupt exits -----------------------------------------------------
+
+    def _unwind(self, cur: Optional[int],
+                depth: int) -> Optional[int]:
+        """Run the cleanup stack down to `depth` inline (with-exits are
+        markers; finally bodies are rebuilt on this path)."""
+        for i in range(len(self.cleanup) - 1, depth - 1, -1):
+            if cur is None:
+                return None
+            kind, payload = self.cleanup[i]
+            if kind == "with":
+                cur = self._emit(cur, WithExit(
+                    payload, getattr(payload.context_expr, "lineno", 0)))
+            else:
+                # slice the stack below this finally while rebuilding it,
+                # so a return inside the finally body terminates
+                saved = self.cleanup
+                self.cleanup = self.cleanup[:i]
+                cur = self._seq(payload, cur)
+                self.cleanup = saved
+        return cur
+
+    # -- construction -----------------------------------------------------
+
+    def build(self, body: Sequence[ast.stmt], entry: int):
+        end = self._seq(body, entry)
+        if end is not None:
+            self._edge(end, self.cfg.exit)
+
+    def _seq(self, body: Sequence[ast.stmt],
+             cur: Optional[int]) -> Optional[int]:
+        for stmt in body:
+            if cur is None:
+                return None  # unreachable code after return/raise/...
+            cur = self._stmt(stmt, cur)
+        return cur
+
+    def _stmt(self, stmt: ast.stmt, cur: int) -> Optional[int]:
+        # compound statements start in a fresh block so their own
+        # exception edges (a raising if/while test, a raising iterator)
+        # carry the state AFTER every preceding simple statement
+        if isinstance(stmt, (ast.If, ast.While, ast.For, ast.AsyncFor,
+                             ast.With, ast.AsyncWith, ast.Try,
+                             ast.Match)) \
+                and self.cfg.block(cur).stmts:
+            nxt = self.cfg._new()
+            self._edge(cur, nxt)
+            cur = nxt
+        if isinstance(stmt, ast.If):
+            self.cfg.block(cur).stmts.append(
+                BranchTest(stmt.test, stmt.lineno))
+            after = self.cfg._new()
+            t = self.cfg._new()
+            self._edge(cur, t, "true")
+            t_end = self._seq(stmt.body, t)
+            if t_end is not None:
+                self._edge(t_end, after)
+            f = self.cfg._new()
+            self._edge(cur, f, "false")
+            f_end = self._seq(stmt.orelse, f)
+            if f_end is not None:
+                self._edge(f_end, after)
+            # the test itself can raise
+            if _may_raise(stmt.test):
+                self._edge(cur, self.exc, "exc")
+            return after
+
+        if isinstance(stmt, ast.While):
+            head = self.cfg._new()
+            self._edge(cur, head)
+            self.cfg.block(head).stmts.append(
+                BranchTest(stmt.test, stmt.lineno))
+            after = self.cfg._new()
+            body = self.cfg._new()
+            self._edge(head, body, "true")
+            is_true_const = (isinstance(stmt.test, ast.Constant)
+                             and stmt.test.value is True)
+            if _may_raise(stmt.test):
+                self._edge(head, self.exc, "exc")
+            self.loops.append((after, head, len(self.cleanup)))
+            b_end = self._seq(stmt.body, body)
+            self.loops.pop()
+            if b_end is not None:
+                self._edge(b_end, head, "back")
+            if not is_true_const:
+                if stmt.orelse:
+                    o = self.cfg._new()
+                    self._edge(head, o, "false")
+                    o_end = self._seq(stmt.orelse, o)
+                    if o_end is not None:
+                        self._edge(o_end, after)
+                else:
+                    self._edge(head, after, "false")
+            return after
+
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            # the iterator advance lives in the loop HEAD so its
+            # transfer re-runs on every back edge (and its exception
+            # edge models a raising source iterator)
+            head = self.cfg._new()
+            self._edge(cur, head)
+            self.cfg.block(head).stmts.append(
+                LoopIter(stmt, stmt.lineno))
+            after = self.cfg._new()
+            body = self.cfg._new()
+            self._edge(head, body, "iter")
+            self._edge(head, after, "exhaust")
+            self._edge(head, self.exc, "exc")
+            self.loops.append((after, head, len(self.cleanup)))
+            b_end = self._seq(stmt.body, body)
+            self.loops.pop()
+            if b_end is not None:
+                self._edge(b_end, head, "back")
+            if stmt.orelse:
+                return self._seq(stmt.orelse, after)
+            return after
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                cur = self._emit(cur, WithEnter(item, stmt.lineno))
+                self.cleanup.append(("with", item))
+            saved_exc = self.exc
+            # an exception in the body runs __exit__ then propagates;
+            # the continuation edge is "normal" — the WithExit effects
+            # in this chain must apply to the propagated fact
+            exc_blk = self.cfg._new()
+            e = exc_blk
+            for item in reversed(stmt.items):
+                e = self._emit(e, WithExit(
+                    item, getattr(item.context_expr, "lineno",
+                                  stmt.lineno)))
+            self._edge(e, saved_exc)
+            self.exc = exc_blk
+            end = self._seq(stmt.body, cur)
+            self.exc = saved_exc
+            for item in reversed(stmt.items):
+                self.cleanup.pop()
+                if end is not None:
+                    end = self._emit(end, WithExit(
+                        item, getattr(item.context_expr, "lineno",
+                                      stmt.lineno)))
+            return end
+
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, cur)
+
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None and _may_raise(stmt.value):
+                cur = self._emit(cur, stmt)
+            else:
+                self.cfg.block(cur).stmts.append(stmt)
+            cur = self._unwind(cur, 0)
+            self._edge(cur, self.cfg.exit)
+            return None
+
+        if isinstance(stmt, ast.Raise):
+            self.cfg.block(cur).stmts.append(stmt)
+            self._edge(cur, self.exc, "exc")
+            return None
+
+        if isinstance(stmt, ast.Break):
+            target, _, depth = self.loops[-1] if self.loops \
+                else (self.cfg.exit, self.cfg.exit, 0)
+            cur = self._unwind(cur, depth)
+            self._edge(cur, target)
+            return None
+
+        if isinstance(stmt, ast.Continue):
+            _, target, depth = self.loops[-1] if self.loops \
+                else (self.cfg.exit, self.cfg.exit, 0)
+            cur = self._unwind(cur, depth)
+            self._edge(cur, target, "back")
+            return None
+
+        if isinstance(stmt, ast.Match):
+            after = self.cfg._new()
+            for case in stmt.cases:
+                c = self.cfg._new()
+                self._edge(cur, c, "true")
+                c_end = self._seq(case.body, c)
+                if c_end is not None:
+                    self._edge(c_end, after)
+            self._edge(cur, after, "false")  # no case matched
+            return after
+
+        # simple statement (incl. nested defs, which are not descended)
+        return self._emit(cur, stmt)
+
+    def _try(self, stmt: ast.Try, cur: int) -> Optional[int]:
+        after = self.cfg._new()
+        has_finally = bool(stmt.finalbody)
+
+        def run_finally(frm: Optional[int]) -> Optional[int]:
+            if frm is None or not has_finally:
+                return frm
+            return self._seq(stmt.finalbody, frm)
+
+        # exceptional continuation: handlers, else finally -> outer exc
+        saved_exc = self.exc
+        if stmt.handlers or has_finally:
+            dispatch = self.cfg._new()
+            self.exc = dispatch
+        else:
+            dispatch = saved_exc
+        # a bare / BaseException / Exception handler catches (for this
+        # engine's purposes) everything: no unmatched-exception edge
+        catches_all = any(
+            h.type is None
+            or (isinstance(h.type, ast.Name)
+                and h.type.id in ("BaseException", "Exception"))
+            for h in stmt.handlers)
+
+        if has_finally:
+            self.cleanup.append(("finally", stmt.finalbody))
+
+        body_end = self._seq(stmt.body, cur)
+        self.exc = saved_exc
+
+        # handlers: run with exceptions escalating through finally
+        handler_exc = self.cfg._new() if has_finally else saved_exc
+        if has_finally:
+            h_end = self._seq(stmt.finalbody, handler_exc)
+            if h_end is not None:
+                # "normal": the rebuilt finally's effects must reach
+                # the outer handler with the propagated fact
+                self._edge(h_end, saved_exc)
+        for h in stmt.handlers:
+            hb = self.cfg._new()
+            self._edge(dispatch, hb, "exc")
+            self.exc = handler_exc
+            hb = self._emit(hb, ExceptEnter(h, h.lineno))
+            hb_end = self._seq(h.body, hb)
+            self.exc = saved_exc
+            hb_end = run_finally(hb_end)
+            if hb_end is not None:
+                self._edge(hb_end, after)
+        # unmatched exception: finally then outer exc
+        if (stmt.handlers or has_finally) and not catches_all:
+            if has_finally:
+                self._edge(dispatch, handler_exc, "exc")
+            else:
+                self._edge(dispatch, saved_exc, "exc")
+
+        # normal completion: else (whose exceptions this try does NOT
+        # catch, but its finally still runs on), then finally
+        if body_end is not None and stmt.orelse:
+            self.exc = handler_exc if has_finally else saved_exc
+            body_end = self._seq(stmt.orelse, body_end)
+            self.exc = saved_exc
+        body_end = run_finally(body_end)
+        if has_finally:
+            self.cleanup.pop()
+        if body_end is not None:
+            self._edge(body_end, after)
+        return after
+
+
+# --- worklist solver ---------------------------------------------------------
+
+class Analysis:
+    """Forward dataflow analysis protocol. Facts must support == and
+    join; keep them immutable (frozenset/tuple)."""
+
+    def initial(self):
+        raise NotImplementedError
+
+    def join(self, a, b):
+        raise NotImplementedError
+
+    def transfer(self, stmt, fact):
+        """Fact after `stmt` executes normally."""
+        raise NotImplementedError
+
+    def transfer_exc(self, stmt, fact):
+        """Fact on `stmt`'s exception edge (default: state before it —
+        the raise preempted the statement's effect)."""
+        return fact
+
+    def transfer_branch(self, test, kind, fact):
+        """Refine the fact along a "true"/"false" edge out of a
+        BranchTest (`test` is the test expression). Default: no
+        refinement."""
+        return fact
+
+
+def solve(cfg: CFG, analysis: Analysis,
+          max_iter: int = 10000) -> Dict[int, object]:
+    """Run `analysis` to fixpoint; returns block-entry facts. The facts
+    at `cfg.exit` / `cfg.raise_exit` are the function's normal and
+    exceptional exit states."""
+    facts: Dict[int, object] = {cfg.entry: analysis.initial()}
+    work = [cfg.entry]
+    iters = 0
+    while work:
+        iters += 1
+        if iters > max_iter:  # pragma: no cover - safety valve
+            raise RuntimeError("dataflow solver failed to converge")
+        bid = work.pop()
+        blk = cfg.block(bid)
+        fact = facts[bid]
+        # normal flow through the block; the (single, last) raising
+        # statement contributes the exception-edge fact
+        exc_fact = fact
+        branch_test = None
+        for stmt in blk.stmts:
+            exc_fact = analysis.transfer_exc(stmt, fact)
+            fact = analysis.transfer(stmt, fact)
+            if isinstance(stmt, BranchTest):
+                branch_test = stmt.node
+        for target, kind in blk.succs:
+            if kind == "exc":
+                out = exc_fact
+            elif kind in ("true", "false") and branch_test is not None:
+                out = analysis.transfer_branch(branch_test, kind, fact)
+            else:
+                out = fact
+            old = facts.get(target)
+            new = out if old is None else analysis.join(old, out)
+            if old is None or new != old:
+                facts[target] = new
+                work.append(target)
+    return facts
+
+
+# --- project: function index + call graph ------------------------------------
+
+@dataclasses.dataclass
+class FuncInfo:
+    key: str                       # "rel/path.py::Qual"
+    path: str                      # absolute path
+    rel: str                       # path relative to project root
+    name: str                      # bare name
+    qual: str                      # Class.method / func / outer.<locals>.f
+    cls: Optional[str]             # enclosing class name, if a method
+    node: object                   # FunctionDef / AsyncFunctionDef
+
+    def __hash__(self):
+        return hash(self.key)
+
+
+_CTOR_TAILS = ("Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore")
+
+
+class Project:
+    """Package-wide index: functions (incl. methods and nested defs),
+    classes with a small attr→class type map, and call resolution."""
+
+    def __init__(self, parsed: Iterable[Tuple[str, ast.AST]],
+                 root: Optional[str] = None):
+        self.parsed = list(parsed)
+        self.root = root or (os.path.commonpath(
+            [os.path.dirname(p) for p, _ in self.parsed])
+            if self.parsed else "")
+        self.functions: Dict[str, FuncInfo] = {}
+        self.by_name: Dict[str, List[FuncInfo]] = {}
+        self.classes: Dict[str, Set[str]] = {}   # ClassName -> methods
+        # ClassName -> {attr: ClassName} inferred from __init__
+        # assignments and parameter annotations
+        self.attr_types: Dict[str, Dict[str, str]] = {}
+        self._cfg_cache: Dict[str, CFG] = {}
+        for path, tree in self.parsed:
+            self._index_module(path, tree)
+        for path, tree in self.parsed:
+            self._infer_attr_types(tree)
+
+    # -- indexing ---------------------------------------------------------
+
+    def _rel(self, path: str) -> str:
+        try:
+            return os.path.relpath(path, self.root)
+        except ValueError:  # pragma: no cover - windows drives
+            return path
+
+    def _index_module(self, path: str, tree: ast.AST):
+        rel = self._rel(path)
+
+        def add(node, qual: str, cls: Optional[str]):
+            info = FuncInfo(key=f"{rel}::{qual}", path=path, rel=rel,
+                            name=node.name, qual=qual, cls=cls,
+                            node=node)
+            self.functions[info.key] = info
+            self.by_name.setdefault(node.name, []).append(info)
+            for sub in node.body:
+                walk(sub, qual + ".<locals>", cls)
+
+        def walk(node, prefix: str, cls: Optional[str]):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                add(node, f"{prefix}.{node.name}" if prefix
+                    else node.name, cls)
+            elif isinstance(node, ast.ClassDef):
+                self.classes.setdefault(node.name, set())
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        self.classes[node.name].add(sub.name)
+                        add(sub, f"{node.name}.{sub.name}", node.name)
+                    else:
+                        walk(sub, f"{node.name}", node.name)
+            else:
+                for sub in ast.iter_child_nodes(node):
+                    walk(sub, prefix, cls)
+
+        for node in tree.body:
+            walk(node, "", None)
+
+    @staticmethod
+    def _ann_class(ann) -> Optional[str]:
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            return ann.value.strip().strip('"').split(".")[-1] or None
+        if isinstance(ann, ast.Name):
+            return ann.id
+        if isinstance(ann, ast.Attribute):
+            return ann.attr
+        if isinstance(ann, ast.Subscript):  # Optional["X"] / Optional[X]
+            s = ann.slice
+            return Project._ann_class(s)
+        return None
+
+    def _infer_attr_types(self, tree: ast.AST):
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            amap = self.attr_types.setdefault(cls.name, {})
+            for m in cls.body:
+                if not isinstance(m, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                ann: Dict[str, str] = {}
+                for a in (list(m.args.posonlyargs) + list(m.args.args)
+                          + list(m.args.kwonlyargs)):
+                    c = self._ann_class(a.annotation)
+                    if c and c in self.classes:
+                        ann[a.arg] = c
+                for node in ast.walk(m):
+                    tgt = None
+                    if isinstance(node, ast.Assign) \
+                            and len(node.targets) == 1:
+                        tgt, val = node.targets[0], node.value
+                    elif isinstance(node, ast.AnnAssign):
+                        tgt, val = node.target, node.value
+                    else:
+                        continue
+                    if not (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        continue
+                    if isinstance(node, ast.AnnAssign):
+                        c = self._ann_class(node.annotation)
+                        if c and c in self.classes:
+                            amap[tgt.attr] = c
+                            continue
+                    if isinstance(val, ast.Call):
+                        cn = call_name(val).rsplit(".", 1)[-1]
+                        head = call_name(val).split(".")[0]
+                        if cn in self.classes:
+                            amap[tgt.attr] = cn
+                        elif head in self.classes:
+                            # factory-classmethod idiom:
+                            # DeviceMemoryManager.shared(conf)
+                            amap[tgt.attr] = head
+                    elif isinstance(val, ast.Name) and val.id in ann:
+                        amap[tgt.attr] = ann[val.id]
+
+    # -- CFGs -------------------------------------------------------------
+
+    def cfg(self, info: FuncInfo) -> CFG:
+        c = self._cfg_cache.get(info.key)
+        if c is None:
+            c = CFG(info.node)
+            self._cfg_cache[info.key] = c
+        return c
+
+    # -- call resolution --------------------------------------------------
+
+    #: method names too generic for the unique-name fallback — on an
+    #: unresolved receiver they are overwhelmingly dict/set/file/etc.
+    #: methods, and resolving them to whichever project class happens
+    #: to define the name smears that class's summary everywhere
+    _GENERIC = frozenset((
+        "get", "set", "add", "pop", "clear", "update", "append",
+        "extend", "remove", "discard", "copy", "items", "keys",
+        "values", "close", "open", "read", "write", "flush", "run",
+        "start", "stop", "send", "put", "join", "wait", "result",
+        "acquire", "release", "submit", "cancel", "count", "index",
+        "next", "reset", "name", "describe", "children", "execute"))
+
+    def resolve_call(self, call: ast.Call,
+                     caller: FuncInfo) -> List[FuncInfo]:
+        """Project functions this call may target (possibly empty —
+        stdlib and unresolvable receivers resolve to nothing)."""
+        name = call_name(call)
+        if not name:
+            return []
+        parts = name.split(".")
+        tail = parts[-1]
+        # constructors: ClassName(...) -> __init__; cls(...) inside a
+        # classmethod -> the caller's own class
+        ctor = tail if tail in self.classes else \
+            (caller.cls if parts == ["cls"] else None)
+        if ctor is not None:
+            for info in self.by_name.get("__init__", []):
+                if info.cls == ctor:
+                    return [info]
+            return []
+        if len(parts) == 1:
+            # bare call: nested def in the same function, else a
+            # same-module function
+            nested = f"{caller.rel}::{caller.qual}.<locals>.{tail}"
+            if nested in self.functions:
+                return [self.functions[nested]]
+            same_mod = [f for f in self.by_name.get(tail, [])
+                        if f.rel == caller.rel and f.cls is None]
+            if same_mod:
+                return same_mod
+            return self._unique(tail)
+        recv_cls = self._receiver_class(call.func, caller)
+        if recv_cls is not None:
+            return [f for f in self.by_name.get(tail, [])
+                    if f.cls == recv_cls]
+        # unknown receiver: only a package-wide UNIQUE, non-generic
+        # name may resolve (anything looser smears summaries)
+        return self._unique(tail)
+
+    def _unique(self, tail: str) -> List[FuncInfo]:
+        if tail in self._GENERIC:
+            return []
+        cands = self.by_name.get(tail, [])
+        return list(cands) if len(cands) == 1 else []
+
+    def _receiver_class(self, func: ast.Attribute,
+                        caller: FuncInfo) -> Optional[str]:
+        recv = func.value
+        if isinstance(recv, ast.Name):
+            if recv.id == "self" and caller.cls:
+                return caller.cls
+            # local assigned from ClassName(...): cheap single-pass scan
+            cls = self._local_ctor_class(recv.id, caller)
+            if cls:
+                return cls
+            return None
+        if isinstance(recv, ast.Attribute) \
+                and isinstance(recv.value, ast.Name) \
+                and recv.value.id == "self" and caller.cls:
+            return self.attr_types.get(caller.cls, {}).get(recv.attr)
+        return None
+
+    def _local_ctor_class(self, var: str,
+                          caller: FuncInfo) -> Optional[str]:
+        for node in ast.walk(caller.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == var \
+                    and isinstance(node.value, ast.Call):
+                cn = call_name(node.value).rsplit(".", 1)[-1]
+                if cn in self.classes:
+                    return cn
+                # DeviceMemoryManager.shared(conf) idiom
+                head = call_name(node.value).split(".")[0]
+                if head in self.classes:
+                    return head
+        # annotated parameters
+        fn = caller.node
+        for a in (list(fn.args.posonlyargs) + list(fn.args.args)
+                  + list(fn.args.kwonlyargs)):
+            if a.arg == var:
+                c = self._ann_class(a.annotation)
+                if c and c in self.classes:
+                    return c
+        return None
+
+
+def fixpoint_summaries(project: Project,
+                       funcs: Sequence[FuncInfo],
+                       compute: Callable[[FuncInfo, Dict], object],
+                       initial: Callable[[], object],
+                       max_rounds: int = 8) -> Dict[str, object]:
+    """Bounded-fixpoint call-graph summary pass: repeatedly recompute
+    each function's summary (seeing the current summaries of its
+    callees) until nothing changes. One round = the one-level helper
+    pass; the fixpoint extends it through deeper helper chains and
+    tolerates recursion (summaries only grow, rounds are bounded)."""
+    summaries: Dict[str, object] = {f.key: initial() for f in funcs}
+    for _ in range(max_rounds):
+        changed = False
+        for f in funcs:
+            new = compute(f, summaries)
+            if new != summaries.get(f.key):
+                summaries[f.key] = new
+                changed = True
+        if not changed:
+            break
+    return summaries

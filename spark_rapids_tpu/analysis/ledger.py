@@ -1,0 +1,413 @@
+"""Device-memory-ledger resource-leak analysis (tpu-lint 2.0).
+
+The bug class PR 4/5 satellites kept patching by hand: a
+``DeviceMemoryManager.register(...)`` reservation (or a
+``transient_reservation`` context) that escapes a function on *some*
+CFG path — usually an exception edge — without being released, handed
+to a consumer, or stored somewhere with a cleanup obligation. A leaked
+catalog entry charges HBM forever (pinned ones can never even spill),
+so these are silent budget shrinkage, not crashes — exactly what
+static analysis is for.
+
+Tracked facts (a frozenset over the CFG, exception edges included):
+
+- ``sb = mm.register(b)`` / ``sbs.append(mm.register(b))`` create a
+  token bound to the variable (or accumulator list).
+- ``sb.release()`` kills it; ``for sb in sbs: ... sb.release()`` kills
+  the list's tokens at the loop.
+- Ownership transfers kill too: returning/yielding the variable,
+  storing it into an attribute/subscript, passing it as a call
+  argument (``inflight.add(sb)``, ``weakref.finalize(..., sb)``), or
+  capturing it in a nested ``def`` (the generator-handoff idiom).
+  Transfers apply on a raising statement's exception edge *before* the
+  raise — the callee owns the value once it was handed over.
+- A token still live at the normal or exceptional exit is a
+  ``ledger-leak-path`` finding.
+
+Two flow-free shapes are flagged directly:
+
+- a reservation created inside a list/set/generator comprehension —
+  a raising element leaks every earlier element's reservation, and no
+  CFG can see inside the comprehension (``ledger-leak-path``,
+  comprehension variant);
+- ``transient_reservation(...)`` whose context object is never entered
+  with ``with`` (the charge would never release).
+
+Functions whose *call* returns a fresh reservation (``_build_right``
+→ ``_acquire_build`` → caller) are summarized through the call graph
+as **allocators**; at their call sites the rule is deliberately weaker
+— flagged only when no path releases the result at all — because
+conditional-ownership protocols (``rsb, owned = ...``) are
+path-insensitive noise otherwise.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .dataflow import (Analysis, FuncInfo, LoopIter, Project, WithEnter,
+                       WithExit, call_name, fixpoint_summaries, solve,
+                       stmt_calls)
+
+__all__ = ["analyze_ledger"]
+
+_RESERVE_TAILS = ("register",)
+_CTX_TAILS = ("transient_reservation",)
+_RECV_HINTS = ("mm", "mgr", "manager", "ledger", "catalog")
+
+
+def _is_reserving_call(call: ast.Call, project: Project,
+                       caller: FuncInfo) -> Optional[str]:
+    """'register' | 'ctx' when this call creates a ledger obligation."""
+    tail = call_name(call).rsplit(".", 1)[-1]
+    if tail in _CTX_TAILS:
+        return "ctx"
+    if tail not in _RESERVE_TAILS:
+        return None
+    if not isinstance(call.func, ast.Attribute):
+        return None  # bare register(...) is the conf registry
+    # receiver resolves to the manager class, or is named like one
+    for callee in project.resolve_call(call, caller):
+        if callee.cls == "DeviceMemoryManager":
+            return "register"
+    recv = call.func.value
+    recv_name = ""
+    if isinstance(recv, ast.Name):
+        recv_name = recv.id
+    elif isinstance(recv, ast.Attribute):
+        recv_name = recv.attr
+    return "register" if recv_name in _RECV_HINTS else None
+
+
+@dataclasses.dataclass(frozen=True)
+class _Token:
+    var: str
+    line: int
+    kind: str  # register | ctx | call (allocator result)
+
+
+class _LeakAnalysis(Analysis):
+    def __init__(self, func: FuncInfo, project: Project,
+                 allocators: Dict[str, bool], sink: List):
+        self.f = func
+        self.project = project
+        self.allocators = allocators
+        self.sink = sink
+        # vars that get a .release()/.unpin() SOMEWHERE: allocator-call
+        # tokens for them are trusted (see module docstring)
+        self.released_somewhere: Set[str] = set()
+        # for-loops that bulk-release their iterated list
+        self.release_loops: Set[int] = set()
+        for node in ast.walk(func.node):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "release" \
+                    and isinstance(node.func.value, ast.Name):
+                self.released_somewhere.add(node.func.value.id)
+            if isinstance(node, ast.For) \
+                    and isinstance(node.iter, ast.Name) \
+                    and isinstance(node.target, ast.Name):
+                lv = node.target.id
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) \
+                            and isinstance(sub.func, ast.Attribute) \
+                            and sub.func.attr == "release" \
+                            and isinstance(sub.func.value, ast.Name) \
+                            and sub.func.value.id == lv:
+                        self.release_loops.add(id(node))
+
+    # -- lattice ----------------------------------------------------------
+
+    def initial(self):
+        return frozenset()
+
+    def join(self, a, b):
+        return a | b
+
+    # -- helpers ----------------------------------------------------------
+
+    def _reservation_in(self, expr) -> Optional[Tuple[str, int]]:
+        """(kind, line) of a reservation call inside expr (not nested
+        defs); comprehension-wrapped ones are reported separately."""
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                kind = _is_reserving_call(node, self.project, self.f)
+                if kind:
+                    return kind, node.lineno
+        return None
+
+    def _names_in(self, expr) -> Set[str]:
+        return {n.id for n in ast.walk(expr)
+                if isinstance(n, ast.Name)}
+
+    def _kills(self, stmt, fact):
+        """Releases and ownership transfers (also applied on the
+        exception edge: a handed-over value is the callee's)."""
+        node = getattr(stmt, "node", stmt)
+        dead: Set[str] = set()
+        if isinstance(stmt, LoopIter):
+            if id(node) in self.release_loops \
+                    and isinstance(node.iter, ast.Name):
+                dead.add(node.iter.id)
+            return frozenset(t for t in fact if t.var not in dead)
+        if isinstance(stmt, WithEnter):
+            # `with charge:` consumes a transient-reservation context
+            item = stmt.node
+            dead |= self._names_in(item.context_expr)
+            return frozenset(t for t in fact
+                             if not (t.kind == "ctx"
+                                     and t.var in dead))
+        if isinstance(stmt, WithExit):
+            return fact
+        if isinstance(node, ast.Return) and node.value is not None:
+            dead |= self._names_in(node.value)
+        if isinstance(node, ast.Expr) \
+                and isinstance(node.value, (ast.Yield, ast.YieldFrom)) \
+                and node.value.value is not None:
+            dead |= self._names_in(node.value.value)
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    dead |= self._names_in(node.value)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # closure capture: the nested def owns what it references
+            body_names = set()
+            for sub in node.body:
+                body_names |= self._names_in(sub)
+            dead |= body_names
+        for call in stmt_calls(node):
+            fn = call.func
+            if isinstance(fn, ast.Attribute) \
+                    and isinstance(fn.value, ast.Name):
+                if fn.attr == "release":
+                    dead.add(fn.value.id)
+                    continue
+                # receiver of a method call is not an escape
+                # (sb.get(), sb.pin()), but arguments are
+            for a in list(call.args) + [k.value for k in call.keywords]:
+                dead |= self._names_in(a)
+        return frozenset(t for t in fact if t.var not in dead)
+
+    # -- transfer ---------------------------------------------------------
+
+    def transfer_exc(self, stmt, fact):
+        return self._kills(stmt, fact)
+
+    def transfer_branch(self, test, kind, fact):
+        """`if x is None:` — on the true branch, x holds no
+        reservation (and symmetrically for `is not None`)."""
+        if isinstance(test, ast.Compare) \
+                and isinstance(test.left, ast.Name) \
+                and len(test.ops) == 1 \
+                and isinstance(test.comparators[0], ast.Constant) \
+                and test.comparators[0].value is None:
+            none_kind = "true" if isinstance(test.ops[0], ast.Is) \
+                else ("false" if isinstance(test.ops[0], ast.IsNot)
+                      else None)
+            if none_kind == kind:
+                return frozenset(t for t in fact
+                                 if t.var != test.left.id)
+        return fact
+
+    def transfer(self, stmt, fact):
+        fact = self._kills(stmt, fact)
+        node = getattr(stmt, "node", stmt)
+        if not isinstance(node, ast.stmt):
+            return fact  # BranchTest and friends
+        if isinstance(stmt, (WithEnter, WithExit, LoopIter)):
+            return fact
+        if isinstance(node, ast.Assign):
+            # rebinding a tracked name to anything else drops the old
+            # token (the reservation moved or the protocol re-used the
+            # variable); the new value may mint a new one
+            rebound = set()
+            for t in node.targets:
+                for n in ([t] if isinstance(t, ast.Name)
+                          else getattr(t, "elts", [])):
+                    if isinstance(n, ast.Name):
+                        rebound.add(n.id)
+            fact = frozenset(x for x in fact if x.var not in rebound)
+            res = self._reservation_in(node.value) \
+                if not isinstance(node.value, (ast.ListComp,
+                                               ast.SetComp,
+                                               ast.GeneratorExp)) \
+                else None
+            alloc = res is None and self._allocator_call(node.value)
+            if res or alloc:
+                kind, line = res if res else ("call", node.lineno)
+                for t in node.targets:
+                    names = [t] if isinstance(t, ast.Name) else \
+                        [e for e in getattr(t, "elts", [])
+                         if isinstance(e, ast.Name)]
+                    if kind == "call" and len(names) > 1:
+                        # `rsb, owned = alloc(...)`: by convention the
+                        # reservation is the first element
+                        names = names[:1]
+                    for n in names:
+                        if kind == "call" \
+                                and n.id in self.released_somewhere:
+                            continue  # trusted conditional protocol
+                        fact = fact | {_Token(n.id, line, kind)}
+            return fact
+        # accumulator append: lst.append(mm.register(...))
+        if isinstance(node, ast.Expr) \
+                and isinstance(node.value, ast.Call) \
+                and isinstance(node.value.func, ast.Attribute) \
+                and node.value.func.attr == "append" \
+                and isinstance(node.value.func.value, ast.Name):
+            for a in node.value.args:
+                res = self._reservation_in(a)
+                if res:
+                    kind, line = res
+                    lst = node.value.func.value.id
+                    fact = fact | {_Token(lst, line, kind)}
+            return fact
+        # a bare reservation call whose result is discarded
+        if isinstance(node, ast.Expr):
+            res = self._reservation_in(node.value)
+            if res:
+                kind, line = res
+                self.sink.append({
+                    "rule": "ledger-leak-path", "path": self.f.rel,
+                    "line": line,
+                    "message": ("transient_reservation context "
+                                "created and discarded — the charge "
+                                "never releases"
+                                if kind == "ctx" else
+                                "reservation result discarded: the "
+                                "catalog entry can never be "
+                                "released")})
+        return fact
+
+    def _allocator_call(self, expr) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                for callee in self.project.resolve_call(node, self.f):
+                    if self.allocators.get(callee.key):
+                        return True
+        return False
+
+
+def _allocator_summaries(project: Project,
+                         funcs: Sequence[FuncInfo]) -> Dict[str, bool]:
+    """True for functions whose return value carries a fresh
+    reservation (directly or through one more call level)."""
+    def compute(f: FuncInfo, summaries) -> bool:
+        res_vars: Set[str] = set()
+        for node in ast.walk(f.node):
+            if isinstance(node, ast.Assign):
+                reserving = any(
+                    _is_reserving_call(c, project, f) == "register"
+                    or any(summaries.get(cal.key)
+                           for cal in project.resolve_call(c, f))
+                    for c in ast.walk(node.value)
+                    if isinstance(c, ast.Call))
+                if reserving:
+                    for t in node.targets:
+                        for n in ([t] if isinstance(t, ast.Name)
+                                  else getattr(t, "elts", [])):
+                            if isinstance(n, ast.Name):
+                                res_vars.add(n.id)
+        for node in ast.walk(f.node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                for c in ast.walk(node.value):
+                    if isinstance(c, ast.Call) \
+                            and (_is_reserving_call(c, project, f)
+                                 == "register"
+                                 or any(summaries.get(cal.key)
+                                        for cal in
+                                        project.resolve_call(c, f))):
+                        return True
+                names = {n.id for n in ast.walk(node.value)
+                         if isinstance(n, ast.Name)}
+                if names & res_vars:
+                    return True
+        return False
+
+    return fixpoint_summaries(project, funcs, compute,
+                              initial=lambda: False)
+
+
+def _comprehension_findings(project: Project,
+                            funcs: Sequence[FuncInfo]) -> List[Dict]:
+    out = []
+    for f in funcs:
+        # nested defs are their own FuncInfo: walk without descending
+        stack = list(ast.iter_child_nodes(f.node))
+        nodes = []
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            nodes.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        for node in nodes:
+            if not isinstance(node, (ast.ListComp, ast.SetComp,
+                                     ast.GeneratorExp, ast.DictComp)):
+                continue
+            for c in ast.walk(node):
+                if isinstance(c, ast.Call) \
+                        and _is_reserving_call(c, project, f) \
+                        == "register":
+                    out.append({
+                        "rule": "ledger-leak-path", "path": f.rel,
+                        "line": c.lineno,
+                        "message": "reservation created inside a "
+                                   "comprehension: a raising element "
+                                   "leaks every earlier element's "
+                                   "registration (build the list in "
+                                   "a loop with an except that "
+                                   "releases the partial result)"})
+    return out
+
+
+def analyze_ledger(project: Project) -> List[Dict]:
+    funcs = list(project.functions.values())
+    allocators = _allocator_summaries(project, funcs)
+    # only functions that touch the ledger — directly or through an
+    # allocator helper — pay the dataflow solve
+    touching = []
+    for f in funcs:
+        hit = False
+        for node in ast.walk(f.node):
+            if isinstance(node, ast.Call) \
+                    and (_is_reserving_call(node, project, f)
+                         or any(allocators.get(c.key)
+                                for c in project.resolve_call(node,
+                                                              f))):
+                hit = True
+                break
+        if hit:
+            touching.append(f)
+    findings: List[Dict] = list(
+        _comprehension_findings(project, touching))
+    for f in touching:
+        sink: List[Dict] = []
+        ana = _LeakAnalysis(f, project, allocators, sink)
+        cfg = project.cfg(f)
+        facts = solve(cfg, ana)
+        findings.extend(sink)
+        seen: Set[Tuple] = set()
+        for exit_bid, how in ((cfg.exit, "a normal path"),
+                              (cfg.raise_exit, "an exception path")):
+            fact = facts.get(exit_bid)
+            if not fact:
+                continue
+            for tok in sorted(fact, key=lambda t: (t.line, t.var)):
+                key = (tok.var, tok.line, how)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append({
+                    "rule": "ledger-leak-path", "path": f.rel,
+                    "line": tok.line,
+                    "message": f"reservation {tok.var!r} (created "
+                               f"here) escapes {f.qual} on {how} "
+                               "without release or ownership "
+                               "transfer"})
+    return findings

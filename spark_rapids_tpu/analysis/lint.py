@@ -1,15 +1,16 @@
-"""tpu-lint: an AST rule engine over the package itself.
+"""tpu-lint 2.0: AST rules + interprocedural dataflow analyses.
 
 Every rule is distilled from a bug class this repo has actually
-shipped (see CHANGES.md PR 1-2 satellites: the window.py f-string
-SyntaxError, `time.time()` duration math, dead conf keys) or from the
-invariants its threaded runtime depends on. The engine is `ast`-exact —
-no regex over source text — and reports file:line findings with a
-machine-readable JSON form (`tools/tpu_lint.py --json`); CI gates on
-zero unallowlisted violations (ci_smoke.sh step 8).
+shipped (see CHANGES.md: the window.py f-string SyntaxError,
+`time.time()` duration math, dead conf keys, the ledger leaks PR 4/5
+satellites patched by hand) or from the invariants its threaded
+runtime depends on. The engine is `ast`-exact — no regex over source
+text — and reports file:line findings with a machine-readable JSON
+form (`tools/tpu_lint.py --json`, ``schema: 2``); CI gates on zero
+unallowlisted, unbaselined violations (ci_smoke.sh steps 8 and 12).
 
-Rules
------
+Statement rules (this module)
+-----------------------------
 - ``wallclock-duration``      — ``time.time()`` (directly or via a
   local assigned from it) used in a subtraction: durations must use
   ``time.monotonic()`` so an NTP step cannot produce negative or
@@ -24,19 +25,38 @@ Rules
   modules (`cluster.py`, `pipeline.py`, `shuffle/host.py`): an
   unbounded block on a worker/feeder thread is how the runtime wedges
   with no heartbeat to blame.
-- ``host-sync-in-jit``        — ``np.asarray`` / ``np.array`` /
-  ``jax.device_get`` / ``.block_until_ready()`` / ``.item()`` inside a
-  function the same module passes to ``jax.jit`` (decorator or call):
-  host syncs inside fused-decode/jit regions permanently degrade
-  tunneled devices to synchronous dispatch (scoped to
-  `io/parquet_device.py` and `ops/`).
-- ``unlocked-shared-mutation`` — a class that creates ``self._lock``
-  in ``__init__`` and mutates an attribute under ``with self._lock``
-  in one method must not assign that same attribute outside the lock
-  elsewhere (scheduler/ledger/transport shared state).
 - ``exit-without-flush``      — ``os._exit(...)`` in a function with
   no preceding flush call: the flight recorder's crash-forensics
   guarantee depends on the ring reaching disk before the process dies.
+
+Dataflow analyses (analysis/dataflow.py engine; path-sensitive over a
+basic-block CFG with exception edges, interprocedural via call-graph
+summaries)
+----------
+- ``lock-order-cycle`` / ``lock-order-inversion`` /
+  ``blocking-under-lock`` — analysis/locks.py: the package lock-
+  ordering graph (locks held across helper calls included), checked
+  for cycles and against the declared hierarchy
+  (`locks.LOCK_HIERARCHY`, which the runtime watchdog in
+  analysis/lockwatch.py verifies against real executions), plus
+  blocking calls (sleep / unbounded result()/join()/wait() / file I/O
+  / device syncs) while any lock is held.
+- ``ledger-leak-path``        — analysis/ledger.py: every
+  ``DeviceMemoryManager.register`` / ``transient_reservation`` site
+  must release, hand off, or store its reservation on ALL CFG paths
+  including exception edges (the PR 4/5 hand-patched bug class).
+- ``host-sync-in-jit``        — analysis/jit_taint.py: taint
+  propagation from every ``jax.jit``-ed callable through the call
+  graph; any reachable function performing ``np.asarray`` /
+  ``jax.device_get`` / ``.item()`` / ``.block_until_ready()`` is
+  flagged wherever it lives (replaces the old two-module file-list
+  heuristic).
+- ``unlocked-shared-mutation`` — ported onto the lock dataflow: an
+  attribute mutated with a lock held somewhere in its class must not
+  be mutated (plain or augmented assignment) on a path holding no
+  lock. The old AST-pattern rule only saw ``with self._lock:`` blocks,
+  so ``acquire()``-style critical sections (SpillableBatch) never
+  guarded anything and ``self.x += 1`` outside them was invisible.
 
 Allowlist syntax
 ----------------
@@ -49,17 +69,41 @@ or the line directly above::
 after the bracket is the REQUIRED reason (an empty reason keeps the
 violation fatal). Allowlisted findings stay in the JSON report with
 ``allowlisted: true`` so the suppression surface is auditable.
+
+Baseline ratchet
+----------------
+``tools/tpu_lint.py --baseline tools/tpu_lint_baseline.json`` marks
+findings whose fingerprint (rule + path + digit-normalized message —
+stable across line drift) appears in the checked-in baseline as
+``baselined: true`` and fails only on NEW findings. Regenerate with
+``--write-baseline`` after deliberately accepting a finding.
 """
 from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import os
 import re
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-__all__ = ["LintFinding", "lint_paths", "lint_package", "conf_key_report",
-           "registered_conf_keys", "package_dir"]
+__all__ = ["LintFinding", "lint_paths", "lint_package",
+           "conf_key_report", "registered_conf_keys", "package_dir",
+           "LINT_SCHEMA", "ALL_RULES", "finding_fingerprint",
+           "load_baseline", "default_baseline_path"]
+
+#: JSON report schema version (`tools/check_obs_output.py
+#: --lint-report` validates against it). v1 = PR 6 statement rules;
+#: v2 = dataflow rules + baseline/fingerprint fields.
+LINT_SCHEMA = 2
+
+ALL_RULES = (
+    "wallclock-duration", "unregistered-conf-key",
+    "blocking-call-in-thread", "exit-without-flush",
+    "lock-order-cycle", "lock-order-inversion", "blocking-under-lock",
+    "ledger-leak-path", "host-sync-in-jit", "unlocked-shared-mutation",
+    "syntax-error",
+)
 
 
 @dataclasses.dataclass
@@ -70,9 +114,39 @@ class LintFinding:
     message: str
     allowlisted: bool = False
     allow_reason: str = ""
+    baselined: bool = False
+    fingerprint: str = ""
 
     def to_dict(self) -> Dict:
         return dataclasses.asdict(self)
+
+
+def finding_fingerprint(rule: str, path: str, message: str) -> str:
+    """Stable id for the baseline ratchet: line numbers drift with
+    every edit, so the message is digit-normalized and the line is
+    excluded."""
+    norm = re.sub(r"\d+", "N", message)
+    return hashlib.sha1(
+        f"{rule}|{path}|{norm}".encode()).hexdigest()[:12]
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(package_dir()), "tools",
+                        "tpu_lint_baseline.json")
+
+
+def load_baseline(path: Optional[str] = None) -> Dict[str, int]:
+    """{fingerprint: accepted count} from a baseline file; empty when
+    the file is missing (nothing is baselined then)."""
+    import json
+    path = path or default_baseline_path()
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return {fp: int(meta.get("count", 1))
+            for fp, meta in (doc.get("findings") or {}).items()}
 
 
 def package_dir() -> str:
@@ -187,142 +261,6 @@ def _rule_blocking_call(tree, path, add):
             add("blocking-call-in-thread", node.lineno,
                 f"unbounded .{tail}() blocks this thread forever if "
                 "the other side wedged; pass a timeout and handle it")
-
-
-_HOST_SYNC_CALLS = {"np.asarray", "np.array", "jax.device_get"}
-_HOST_SYNC_METHODS = {"block_until_ready", "item"}
-
-
-def _jitted_names(tree) -> Set[str]:
-    """Function names this module hands to jax.jit (decorator,
-    functools.partial decorator, or a jax.jit(fn) call on a plain
-    name/attribute)."""
-    out: Set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for d in node.decorator_list:
-                dn = d
-                if isinstance(dn, ast.Call):
-                    if _call_name(dn) in ("jax.jit", "jit", "partial",
-                                          "functools.partial"):
-                        args = [a for a in dn.args]
-                        if _call_name(dn) in ("jax.jit", "jit") or any(
-                                isinstance(a, (ast.Name, ast.Attribute))
-                                and _last_seg(a) in ("jit",)
-                                for a in args):
-                            out.add(node.name)
-                elif isinstance(dn, (ast.Name, ast.Attribute)) \
-                        and _last_seg(dn) == "jit":
-                    out.add(node.name)
-        elif isinstance(node, ast.Call) \
-                and _call_name(node) in ("jax.jit", "jit"):
-            for a in node.args[:1]:
-                if isinstance(a, (ast.Name, ast.Attribute)):
-                    out.add(_last_seg(a))
-    return out
-
-
-def _last_seg(node) -> str:
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    if isinstance(node, ast.Name):
-        return node.id
-    return ""
-
-
-def _rule_host_sync_in_jit(tree, path, add):
-    if not (path.endswith("parquet_device.py")
-            or (os.sep + "ops" + os.sep) in path):
-        return
-    jitted = _jitted_names(tree)
-    if not jitted:
-        return
-
-    def scan(fn: ast.AST, fn_name: str):
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Call):
-                continue
-            name = _call_name(node)
-            tail = name.rsplit(".", 1)[-1]
-            if name in _HOST_SYNC_CALLS or tail in _HOST_SYNC_METHODS:
-                add("host-sync-in-jit", node.lineno,
-                    f"{name or tail} inside jitted function "
-                    f"{fn_name!r}: a host sync in a jit region "
-                    "permanently degrades tunneled devices to "
-                    "synchronous dispatch")
-
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                and node.name in jitted:
-            scan(node, node.name)
-
-
-def _self_attr_target(t) -> Optional[str]:
-    if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
-            and t.value.id == "self":
-        return t.attr
-    return None
-
-
-def _rule_unlocked_shared_mutation(tree, path, add):
-    """Attributes a class mutates under `with self._lock` must not be
-    assigned outside it in other methods."""
-    for cls in ast.walk(tree):
-        if not isinstance(cls, ast.ClassDef):
-            continue
-        methods = [n for n in cls.body
-                   if isinstance(n, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef))]
-        has_lock = any(
-            _self_attr_target(t) in ("_lock",)
-            and isinstance(m, ast.FunctionDef) and m.name == "__init__"
-            for m in methods for st in ast.walk(m)
-            if isinstance(st, ast.Assign) for t in st.targets)
-        if not has_lock:
-            continue
-
-        def lock_blocks(m):
-            for node in ast.walk(m):
-                if isinstance(node, ast.With) and any(
-                        isinstance(it.context_expr, ast.Attribute)
-                        and it.context_expr.attr == "_lock"
-                        for it in node.items):
-                    yield node
-
-        guarded: Set[str] = set()
-        locked_lines: Set[int] = set()
-        for m in methods:
-            for w in lock_blocks(m):
-                for node in ast.walk(w):
-                    locked_lines.add(getattr(node, "lineno", -1))
-                    targets = []
-                    if isinstance(node, ast.Assign):
-                        targets = node.targets
-                    elif isinstance(node, ast.AugAssign):
-                        targets = [node.target]
-                    for t in targets:
-                        a = _self_attr_target(t)
-                        if a and a != "_lock":
-                            guarded.add(a)
-        if not guarded:
-            continue
-        for m in methods:
-            if m.name == "__init__":
-                continue
-            for node in ast.walk(m):
-                targets = []
-                if isinstance(node, ast.Assign):
-                    targets = node.targets
-                elif isinstance(node, ast.AugAssign):
-                    targets = [node.target]
-                for t in targets:
-                    a = _self_attr_target(t)
-                    if a in guarded \
-                            and node.lineno not in locked_lines:
-                        add("unlocked-shared-mutation", node.lineno,
-                            f"self.{a} is mutated under self._lock "
-                            f"elsewhere in {cls.name} but assigned "
-                            f"here without it")
 
 
 def _rule_exit_without_flush(tree, path, add):
@@ -473,21 +411,28 @@ def conf_key_report(pkg: Optional[str] = None) -> Dict[str, List[str]]:
 
 # --- engine -------------------------------------------------------------------
 
-def lint_paths(paths: Optional[List[str]] = None) -> Dict:
-    """Run every rule over `paths` (default: the installed package).
-    Returns {"findings": [...], "violations": N} with allowlisted
-    findings included but not counted."""
+def lint_paths(paths: Optional[List[str]] = None,
+               baseline: Optional[Dict[str, int]] = None) -> Dict:
+    """Run every rule — the statement rules above plus the dataflow
+    analyses (locks / ledger / jit taint) — over `paths` (default: the
+    installed package). Returns {"schema": 2, "findings": [...],
+    "violations": N, ...} with allowlisted and baselined findings
+    included but not counted as violations."""
     pkg = package_dir()
     files = _iter_py_files(paths or [pkg])
     findings: List[LintFinding] = []
     parsed: List[Tuple[str, ast.AST, str]] = []
+    lines_by_rel: Dict[str, List[str]] = {}
     for path in files:
         try:
             src = open(path).read()
             parsed.append((path, ast.parse(src), src))
         except SyntaxError as e:
             findings.append(LintFinding(
-                "syntax-error", path, e.lineno or 0, str(e)))
+                "syntax-error",
+                os.path.relpath(path, pkg)
+                if path.startswith(pkg + os.sep) else path,
+                e.lineno or 0, str(e)))
     # when the lint target IS the package, its parse also serves the
     # conf-key registry sweep (no second ast.parse over ~80 files);
     # arbitrary targets still check against the package registry
@@ -496,30 +441,61 @@ def lint_paths(paths: Optional[List[str]] = None) -> Dict:
             [(p, t) for p, t, _ in parsed])
     else:
         registered = registered_conf_keys()
-    for path, tree, src in parsed:
-        lines = src.splitlines()
-        rel = os.path.relpath(path, pkg) if path.startswith(pkg) else path
 
+    def mk_add(rel, lines):
         def add(rule, lineno, message):
             allows = _allow_for(lines, lineno)
             reason = allows.get(rule, "")
             findings.append(LintFinding(
                 rule, rel, lineno, message,
                 allowlisted=bool(reason), allow_reason=reason))
+        return add
 
+    # display paths: package files report relative to the package
+    # (stable fingerprints); out-of-tree targets keep the path as
+    # given (absolute), like v1 did — a machine-dependent relpath
+    # would both read badly and break fingerprint sharing
+    display = {}
+    for path, tree, src in parsed:
+        lines = src.splitlines()
+        disp = os.path.relpath(path, pkg) \
+            if path.startswith(pkg + os.sep) else path
+        display[os.path.relpath(path, pkg)] = (disp, lines)
+        lines_by_rel[disp] = lines
+        add = mk_add(disp, lines)
         _rule_wallclock_duration(tree, path, add)
         _rule_unregistered_conf_key(tree, path, add, registered)
         _rule_blocking_call(tree, path, add)
-        _rule_host_sync_in_jit(tree, path, add)
-        _rule_unlocked_shared_mutation(tree, path, add)
         _rule_exit_without_flush(tree, path, add)
+
+    # package-level dataflow analyses over the same parsed trees
+    from .dataflow import Project
+    from .jit_taint import analyze_jit_taint
+    from .ledger import analyze_ledger
+    from .locks import analyze_locks
+    project = Project([(p, t) for p, t, _ in parsed], root=pkg)
+    for f in (analyze_locks(project) + analyze_ledger(project)
+              + analyze_jit_taint(project)):
+        disp, lines = display.get(f["path"], (f["path"], []))
+        mk_add(disp, lines)(f["rule"], f["line"], f["message"])
+
+    baseline = dict(baseline or {})
+    for f in findings:
+        f.fingerprint = finding_fingerprint(f.rule, f.path, f.message)
+        if not f.allowlisted and baseline.get(f.fingerprint, 0) > 0:
+            baseline[f.fingerprint] -= 1
+            f.baselined = True
     return {
+        "schema": LINT_SCHEMA,
+        "rules": list(ALL_RULES),
         "findings": [f.to_dict() for f in findings],
-        "violations": sum(1 for f in findings if not f.allowlisted),
+        "violations": sum(1 for f in findings
+                          if not f.allowlisted and not f.baselined),
         "allowlisted": sum(1 for f in findings if f.allowlisted),
+        "baselined": sum(1 for f in findings if f.baselined),
         "files": len(files),
     }
 
 
-def lint_package() -> Dict:
-    return lint_paths([package_dir()])
+def lint_package(baseline: Optional[Dict[str, int]] = None) -> Dict:
+    return lint_paths([package_dir()], baseline=baseline)

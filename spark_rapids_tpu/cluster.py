@@ -1245,8 +1245,27 @@ def _main(argv: Sequence[str]) -> None:
         os.environ["JAX_PLATFORMS"] = plat
         import jax
         jax.config.update("jax_platforms", plat)
-    worker_main(args.root, args.worker,
-                heartbeat_interval=args.heartbeat)
+    # lock-order watchdog rides the inherited env into every worker:
+    # chaos/tier-1 runs under RAPIDS_TPU_LOCKWATCH=1 verify the
+    # declared hierarchy against REAL worker-side acquisition orders.
+    # Installed after module import, so worker-side coverage starts
+    # with runtime-created locks (transports/batches/windows) — the
+    # import-time singletons are covered by the driver-side conftest
+    # bootstrap, which installs before the package imports. Reports
+    # flush at clean shutdown only (an os._exit chaos crash loses its
+    # report; the driver-side run still covers shared paths).
+    from .analysis import lockwatch
+    if lockwatch.env_enabled():
+        lockwatch.install()
+    try:
+        worker_main(args.root, args.worker,
+                    heartbeat_interval=args.heartbeat)
+    finally:
+        if lockwatch.installed():
+            out = os.environ.get(lockwatch.ENV_OUT)
+            if out:
+                lockwatch.write_report(
+                    f"{out}.w{args.worker}-{os.getpid()}")
 
 
 if __name__ == "__main__":

@@ -393,28 +393,47 @@ class TpuHashAggregateExec(UnaryExec):
         sbs, total = [], 0
         over = False
         stream = fused_batches(self, ctx)
-        for b in stream:
-            total += b.device_size_bytes()
-            sbs.append(ctx.mm.register(b))
-            if total > ctx.mm.budget // 2:
-                over = True
-                break
-        if over:
-            def downloaded():
+        batches = []
+        try:
+            for b in stream:
+                total += b.device_size_bytes()
+                sbs.append(ctx.mm.register(b))
+                if total > ctx.mm.budget // 2:
+                    over = True
+                    break
+            if over:
+                # ownership transfers to the reroute generator HERE,
+                # inside the guard: its finally releases whatever the
+                # CPU path never consumed [ledger-leak-path]
+                def downloaded():
+                    pending = list(sbs)
+                    try:
+                        while pending:
+                            rb = pending[0].get_host()
+                            pending.pop(0).release()
+                            yield rb
+                        for b in stream:  # same device stream, cont'd
+                            yield device_to_arrow(b)
+                    finally:
+                        for sb in pending:
+                            sb.release()
+            else:
+                t0 = time.perf_counter()
                 for sb in sbs:
-                    rb = sb.get_host()
+                    batches.append(sb.get())
                     sb.release()
-                    yield rb
-                for b in stream:  # continue the same device stream
-                    yield device_to_arrow(b)
+        except BaseException:
+            # a raising child stream (or failed re-upload) must not
+            # strand the accumulated input in the process-shared
+            # catalog; release() is idempotent, so already-consumed
+            # entries are fine [ledger-leak-path]
+            for sb in sbs:
+                sb.release()
+            raise
+        if over:
             for rb in self._cpu_aggregate(downloaded(), ctx):
                 yield arrow_to_device(rb, self._schema)
             return
-        t0 = time.perf_counter()
-        batches = []
-        for sb in sbs:
-            batches.append(sb.get())
-            sb.release()
         if not batches:
             if self.group_exprs:
                 return

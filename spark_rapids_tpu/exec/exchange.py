@@ -220,7 +220,12 @@ class TpuShuffleExchangeExec(UnaryExec):
                 self._shared_handle = handle
 
                 def cleanup():
-                    self._shared_handle = None
+                    # under the same lock as the install: a late
+                    # consumer in materialize_shared must never observe
+                    # (and re-read from) a handle whose store is being
+                    # torn down [unlocked-shared-mutation]
+                    with self._shared_lock:
+                        self._shared_handle = None
                     handle.close()
                 ctx.register_cleanup(cleanup)
             else:
@@ -272,16 +277,31 @@ class TpuShuffleExchangeExec(UnaryExec):
         # (the sample downloads the prefix before the batch can be
         # evicted; replay re-uploads on demand) — ADVICE r3 #3
         sbs, samples = [], []
-        for b in self.child.execute(ctx):
-            samples.append(prefix_sample(b))
-            sbs.append(ctx.mm.register(b))
-        self.partitioning.compute_bounds(samples, ctx.eval_ctx)
+        try:
+            for b in self.child.execute(ctx):
+                samples.append(prefix_sample(b))
+                sbs.append(ctx.mm.register(b))
+            self.partitioning.compute_bounds(samples, ctx.eval_ctx)
+        except BaseException:
+            # a raising sample/bounds computation must not strand the
+            # registered batches in the process-shared catalog
+            # [ledger-leak-path]
+            for sb in sbs:
+                sb.release()
+            raise
 
         def replay():
-            for sb in sbs:
-                b = sb.get()
-                sb.release()
-                yield b
+            pending = list(sbs)
+            try:
+                while pending:
+                    b = pending[0].get()
+                    pending.pop(0).release()
+                    yield b
+            finally:
+                # early close / failed re-upload: release the tail the
+                # consumer never took delivery of [ledger-leak-path]
+                for sb in pending:
+                    sb.release()
         return replay()
 
     def execute_cpu(self, ctx: ExecCtx):

@@ -212,89 +212,101 @@ class TpuSortExec(UnaryExec):
         schema = self.child.output_schema
 
         runs = []
-        t0 = time.perf_counter()
-        for b in batches:
-            sb = self._jitted(b, orders, ectx)
-            sp = mm.register(sb)
-            sp.spill()
-            runs.append(sp)
-        spill_metric.value += time.perf_counter() - t0
-        hosts = [sp.get_host() for sp in runs]
-        rows = [h.num_rows for h in hosts]
-        k = len(runs)
-        bytes_per_row = max(1, batches[0].device_size_bytes()
-                            // max(1, batches[0].capacity))
-        budget_rows = max(256, (mm.budget // 2) // bytes_per_row
-                          // max(1, k))
-        chunk = max(128, bucket_rows(budget_rows) // 2)  # <= budget_rows
-        cursors = [0] * k
-        carry = None  # compacted, shrunk device batch
+        try:
+            t0 = time.perf_counter()
+            for b in batches:
+                sb = self._jitted(b, orders, ectx)
+                sp = mm.register(sb)
+                # appended BEFORE spill(): a raising spill must leave
+                # sp reachable from the finally below [ledger-leak-path]
+                runs.append(sp)
+                sp.spill()
+            spill_metric.value += time.perf_counter() - t0
+            hosts = [sp.get_host() for sp in runs]
+            rows = [h.num_rows for h in hosts]
+            k = len(runs)
+            bytes_per_row = max(1, batches[0].device_size_bytes()
+                                // max(1, batches[0].capacity))
+            budget_rows = max(256, (mm.budget // 2) // bytes_per_row
+                              // max(1, k))
+            chunk = max(128, bucket_rows(budget_rows) // 2)  # <= budget_rows
+            cursors = [0] * k
+            carry = None  # compacted, shrunk device batch
 
-        specs = tuple(o.spec for o in self.orders)
-        key_exprs = tuple(o.child for o in self.orders)
+            specs = tuple(o.spec for o in self.orders)
+            key_exprs = tuple(o.child for o in self.orders)
 
-        import jax.numpy as jnp
+            import jax.numpy as jnp
 
-        def merge_round(merged, bidx, bvalid):
-            key_cols = [e.eval_tpu(merged, ectx) for e in key_exprs]
-            live = merged.live_mask()
-            lanes = key_lanes(key_cols, specs, live)
-            idx = jnp.arange(live.shape[0], dtype=jnp.int32)
-            sorted_all = jax.lax.sort(tuple(lanes) + (idx,),
-                                      num_keys=len(lanes) + 1)
-            perm = sorted_all[-1]
-            total = jnp.sum(live.astype(jnp.int32))
-            out = gather_batch(merged, perm, total)
-            blanes = [lane[bidx] for lane in lanes]
-            bmin = lex_min_tuple(blanes, bvalid)
-            safe = lex_leq(list(sorted_all[:-1]), bmin)
-            # lane0 == 0 <=> live row (key_lanes' live-rank lane)
-            safe_count = jnp.sum((safe & (sorted_all[0] == 0))
-                                 .astype(jnp.int32))
-            return out, total, safe_count
+            def merge_round(merged, bidx, bvalid):
+                key_cols = [e.eval_tpu(merged, ectx) for e in key_exprs]
+                live = merged.live_mask()
+                lanes = key_lanes(key_cols, specs, live)
+                idx = jnp.arange(live.shape[0], dtype=jnp.int32)
+                sorted_all = jax.lax.sort(tuple(lanes) + (idx,),
+                                          num_keys=len(lanes) + 1)
+                perm = sorted_all[-1]
+                total = jnp.sum(live.astype(jnp.int32))
+                out = gather_batch(merged, perm, total)
+                blanes = [lane[bidx] for lane in lanes]
+                bmin = lex_min_tuple(blanes, bvalid)
+                safe = lex_leq(list(sorted_all[:-1]), bmin)
+                # lane0 == 0 <=> live row (key_lanes' live-rank lane)
+                safe_count = jnp.sum((safe & (sorted_all[0] == 0))
+                                     .astype(jnp.int32))
+                return out, total, safe_count
 
-        jit_round = jax.jit(merge_round)
+            jit_round = jax.jit(merge_round)
 
-        while any(cursors[i] < rows[i] for i in range(k)) \
-                or carry is not None:
-            active = [i for i in range(k) if cursors[i] < rows[i]]
-            if not active:
-                yield carry
-                return
-            parts = [] if carry is None else [carry]
-            boundary_idx = []
-            boundary_valid = []
-            base = 0 if carry is None else carry.num_rows
-            for i in active:
-                take = min(chunk, rows[i] - cursors[i])
-                rb = hosts[i].slice(cursors[i], take)
-                parts.append(arrow_to_device(rb, schema,
-                                             capacity=bucket_rows(take)))
-                cursors[i] += take
-                boundary_idx.append(base + take - 1)
-                # an exhausted run imposes no boundary
-                boundary_valid.append(cursors[i] < rows[i])
-                base += take
-            merged = concat_batches(parts)
-            if not any(boundary_valid):
-                # every run exhausted: the whole merge is final
-                out = self._jitted(merged, tuple(self.orders), ectx)
-                yield out
-                return
-            bidx = np.asarray(boundary_idx, np.int32)
-            bvalid = np.asarray(boundary_valid, np.bool_)
-            out, total, safe_count = jit_round(merged, bidx, bvalid)
-            yield TpuBatch(out.columns, schema, safe_count)
-            carry = TpuBatch(
-                out.columns, schema, total,
-                selection=jnp.arange(out.capacity,
-                                     dtype=jnp.int32) >= safe_count)
-            carry = ensure_compacted(carry)
-            carry_rows = carry.num_rows  # syncs once per round
-            if carry_rows == 0:
-                carry = None
-            else:
-                carry = shrink_batch(carry, bucket_rows(carry_rows))
+            while any(cursors[i] < rows[i] for i in range(k)) \
+                    or carry is not None:
+                active = [i for i in range(k) if cursors[i] < rows[i]]
+                if not active:
+                    yield carry
+                    return
+                parts = [] if carry is None else [carry]
+                boundary_idx = []
+                boundary_valid = []
+                base = 0 if carry is None else carry.num_rows
+                for i in active:
+                    take = min(chunk, rows[i] - cursors[i])
+                    rb = hosts[i].slice(cursors[i], take)
+                    parts.append(arrow_to_device(rb, schema,
+                                                 capacity=bucket_rows(take)))
+                    cursors[i] += take
+                    boundary_idx.append(base + take - 1)
+                    # an exhausted run imposes no boundary
+                    boundary_valid.append(cursors[i] < rows[i])
+                    base += take
+                merged = concat_batches(parts)
+                if not any(boundary_valid):
+                    # every run exhausted: the whole merge is final
+                    out = self._jitted(merged, tuple(self.orders), ectx)
+                    yield out
+                    return
+                bidx = np.asarray(boundary_idx, np.int32)
+                bvalid = np.asarray(boundary_valid, np.bool_)
+                out, total, safe_count = jit_round(merged, bidx, bvalid)
+                yield TpuBatch(out.columns, schema, safe_count)
+                carry = TpuBatch(
+                    out.columns, schema, total,
+                    selection=jnp.arange(out.capacity,
+                                         dtype=jnp.int32) >= safe_count)
+                carry = ensure_compacted(carry)
+                carry_rows = carry.num_rows  # syncs once per round
+                if carry_rows == 0:
+                    carry = None
+                else:
+                    carry = shrink_batch(carry, bucket_rows(carry_rows))
+        finally:
+            # the spilled runs are catalog entries in the PROCESS-
+            # SHARED manager: without this they outlive the sort
+            # forever (host-tier bytes stay charged, the catalog
+            # grows per query). tpu-lint 2.0 flagged the exception
+            # window between register and append; the happy path
+            # never released them either [ledger-leak-path]
+            for sp in runs:
+                sp.release()
 
     def execute_cpu(self, ctx: ExecCtx):
         rbs = list(self.child.execute_cpu(ctx))

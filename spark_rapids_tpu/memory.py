@@ -252,6 +252,7 @@ class SpillableBatch:
         with pa.OSFile(self._disk_path, "rb") as f:
             table = pa.ipc.open_file(f).read_all().combine_chunks()
         os.unlink(self._disk_path)
+        # tpu-lint: allow[unlocked-shared-mutation] private helper: only reached from get_host, which holds this batch's _state_lock
         self._disk_path = None
         rbs = table.to_batches()
         if rbs:

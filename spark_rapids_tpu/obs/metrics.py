@@ -87,6 +87,7 @@ class _Gauge:
         self.value = 0.0
 
     def set(self, v: float) -> None:
+        # tpu-lint: allow[unlocked-shared-mutation] single CPython store; gauges are last-writer-wins (inc/dec need the lock, a plain set does not)
         self.value = v
 
     def inc(self, v: float = 1.0) -> None:

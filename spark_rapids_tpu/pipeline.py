@@ -46,7 +46,13 @@ class _WeightedWindow:
     """Count + weight bounded admission: acquire blocks while the
     window holds ``window`` items OR ``max_weight`` total weight (a
     single item heavier than the whole budget admits alone — otherwise
-    it could never run). ``close()`` unblocks a parked feeder."""
+    it could never run). ``close()`` unblocks a parked feeder.
+
+    Lock order: ``_cv`` is level 30 in the declared hierarchy
+    (analysis/locks.py::LOCK_HIERARCHY) — nothing else is ever
+    acquired under it (``wait()`` releases it), and callers may hold
+    only sub-30 locks when entering. tpu-lint's lock analysis and the
+    runtime watchdog both enforce this."""
 
     def __init__(self, window: int, max_weight: Optional[int]):
         self._window = window

@@ -475,8 +475,19 @@ class HostShuffleTransport(ShuffleTransport):
                        for rb in table.combine_chunks().to_batches()
                        if rb.num_rows]
             # in-flight uploads are ledger-visible until delivered, like
-            # the scan's upload tunnel (eviction pressure must see them)
-            sbs = [mgr.register(b, pinned=True) for b in batches]
+            # the scan's upload tunnel (eviction pressure must see them).
+            # Registered one by one with a partial-release guard: a
+            # raising registration (eviction runs disk IO) must not
+            # strand the earlier, already-pinned entries in the
+            # process-shared catalog [ledger-leak-path]
+            sbs = []
+            try:
+                for b in batches:
+                    sbs.append(mgr.register(b, pinned=True))
+            except BaseException:
+                for sb in sbs:
+                    sb.release()
+                raise
             with ilock:
                 if closed[0]:
                     for sb in sbs:

@@ -36,6 +36,14 @@ Two validators and one driver:
   nonzero cross-worker rows, the run must persist a valid profile
   json, and ``profiling compare`` across two runs must render — the
   operator-metrics CI gate.
+- ``--lint-report FILE``  validate a tpu-lint 2.0 JSON report
+  (schema 2: rule names, count consistency, required allowlist
+  reasons) and gate on ZERO unallowlisted, unbaselined violations —
+  the static-analysis ratchet CI gate.
+- ``--lockwatch FILE``  validate lock-order watchdog report(s) (the
+  file plus any ``<FILE>.w*`` worker siblings): watchdog installed,
+  nonzero checked acquisitions, ZERO inversions of the declared lock
+  hierarchy — the dynamic half of the lock-order gate.
 
 Exit status 0 = all checks passed; failures are listed on stderr.
 """
@@ -622,6 +630,87 @@ def run_sql_smoke(out_dir):
     print("sql_parse_error event logged with line/col evidence")
 
 
+def check_lint_report(path):
+    """tpu-lint 2.0 JSON (schema 2): shape, rule names, count
+    consistency, required reasons on allowlists, and the CI gate —
+    zero unallowlisted, unbaselined violations."""
+    errors = []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"lint report unreadable: {e}"]
+    from spark_rapids_tpu.analysis.lint import ALL_RULES, LINT_SCHEMA
+    if doc.get("schema") != LINT_SCHEMA:
+        errors.append(f"schema {doc.get('schema')!r} != {LINT_SCHEMA}")
+    for key in ("findings", "violations", "allowlisted", "baselined",
+                "files", "rules"):
+        if key not in doc:
+            errors.append(f"missing key {key!r}")
+    if errors:
+        return errors
+    if doc["files"] <= 0:
+        errors.append("no files were linted")
+    if set(doc["rules"]) != set(ALL_RULES):
+        errors.append(f"rules list drifted: {sorted(doc['rules'])}")
+    hard = 0
+    for i, f in enumerate(doc["findings"]):
+        for key in ("rule", "path", "line", "message", "allowlisted",
+                    "allow_reason", "baselined", "fingerprint"):
+            if key not in f:
+                errors.append(f"finding {i}: missing {key!r}")
+                break
+        else:
+            if f["rule"] not in ALL_RULES:
+                errors.append(f"finding {i}: unknown rule "
+                              f"{f['rule']!r}")
+            if f["allowlisted"] and not f["allow_reason"]:
+                errors.append(f"finding {i}: allowlisted without a "
+                              "reason")
+            if not f["allowlisted"] and not f["baselined"]:
+                hard += 1
+    if hard != doc["violations"]:
+        errors.append(f"violations={doc['violations']} but {hard} "
+                      "unallowlisted+unbaselined findings")
+    if doc["violations"] != 0:
+        errors.append(f"{doc['violations']} unbaselined violation(s) "
+                      "— fix them or accept via --write-baseline")
+    return errors
+
+
+def check_lockwatch(path):
+    """Lock-order watchdog report(s): the named file plus any worker
+    sibling reports (`<path>.w*`) must show a live watchdog with real
+    acquisition traffic and ZERO inversions."""
+    import glob
+    errors = []
+    paths = [path] + sorted(glob.glob(path + ".w*"))
+    total_checked = 0
+    for p in paths:
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            errors.append(f"{os.path.basename(p)}: unreadable: {e}")
+            continue
+        if not doc.get("installed"):
+            errors.append(f"{os.path.basename(p)}: watchdog was not "
+                          "installed")
+        total_checked += (doc.get("counts") or {}).get("checked", 0)
+        for inv in doc.get("inversions", []):
+            errors.append(
+                f"{os.path.basename(p)}: INVERSION {inv.get('why')} "
+                f"at {inv.get('acquiring_site')} "
+                f"(held: {inv.get('held')})")
+    if not errors and total_checked <= 0:
+        errors.append("watchdog saw zero checked acquisitions — the "
+                      "run exercised no locks, which proves nothing")
+    if not errors:
+        print(f"lockwatch: {len(paths)} report(s), "
+              f"{total_checked} checked acquisitions, 0 inversions")
+    return errors
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--trace", help="Chrome trace JSON to validate")
@@ -658,6 +747,13 @@ def main(argv=None):
                          "process cluster: nonzero rows at every "
                          "scan/join/agg node, a valid profile json, "
                          "and a clean profiling compare of two runs")
+    ap.add_argument("--lint-report", dest="lint_report",
+                    help="tpu-lint 2.0 JSON report to schema-validate "
+                         "(and gate on zero unbaselined violations)")
+    ap.add_argument("--lockwatch",
+                    help="lock-order watchdog report JSON (plus "
+                         "worker siblings <path>.w*) to gate on zero "
+                         "inversions")
     args = ap.parse_args(argv)
     errors = []
     trace, prom = args.trace, args.prom
@@ -694,10 +790,18 @@ def main(argv=None):
         profiles.append(run_analyze_smoke(args.analyze_smoke))
         print(f"analyze smoke output: {profiles[-1]}")
     if not trace and not prom and not flights and not ran_sql \
-            and not profiles:
+            and not profiles and not args.lint_report \
+            and not args.lockwatch:
         ap.error("nothing to do: pass --trace/--prom/--smoke/"
                  "--scan-smoke/--flight/--flight-smoke/--shuffle-smoke/"
-                 "--sql-smoke/--profile/--analyze-smoke")
+                 "--sql-smoke/--profile/--analyze-smoke/--lint-report/"
+                 "--lockwatch")
+    if args.lint_report:
+        errors += [f"[lint] {e}"
+                   for e in check_lint_report(args.lint_report)]
+    if args.lockwatch:
+        errors += [f"[lockwatch] {e}"
+                   for e in check_lockwatch(args.lockwatch)]
     if trace:
         errors += [f"[trace] {e}" for e in check_trace(trace)]
     for fl in flights:

@@ -1,18 +1,38 @@
 #!/usr/bin/env python
-"""tpu-lint CLI: the package's AST rule engine + doc drift check.
+"""tpu-lint CLI: statement rules + dataflow analyses + doc drift check.
 
 Usage:
-    python tools/tpu_lint.py [paths...]   lint (default: the package)
-    python tools/tpu_lint.py --json       machine-readable report
-    python tools/tpu_lint.py --check-docs fail if SUPPORTED_OPS.md is
-                                          stale vs the live registry
-    python tools/tpu_lint.py --confs      AST-exact conf-key audit
-                                          (dead keys + unregistered
-                                          reads), JSON
+    python tools/tpu_lint.py [paths...]     lint (default: the package,
+                                            with the checked-in
+                                            baseline applied)
+    python tools/tpu_lint.py --json         machine-readable report
+                                            (schema 2; validated by
+                                            check_obs_output.py
+                                            --lint-report)
+    python tools/tpu_lint.py --baseline F   ratchet with an explicit
+                                            baseline file instead of
+                                            tools/tpu_lint_baseline.json
+                                            (which is applied by
+                                            DEFAULT; --no-baseline
+                                            shows every finding raw)
+    python tools/tpu_lint.py --write-baseline F
+                                            persist the current
+                                            unallowlisted findings as
+                                            the new baseline
+    python tools/tpu_lint.py --lock-graph   dump the package
+                                            lock-ordering graph (locks,
+                                            edges incl. through-call
+                                            edges, cycles), JSON
+    python tools/tpu_lint.py --check-docs   fail if SUPPORTED_OPS.md is
+                                            stale vs the live registry
+    python tools/tpu_lint.py --confs        AST-exact conf-key audit
+                                            (dead keys + unregistered
+                                            reads), JSON
 
-Exit codes: 0 clean, 1 unallowlisted violations / drift, 2 usage.
-Rules and the inline-allowlist syntax are documented in
-spark_rapids_tpu/analysis/lint.py and README.md ("Static analysis").
+Exit codes: 0 clean, 1 unallowlisted/unbaselined violations or drift,
+2 usage. Rules, the inline-allowlist syntax, and the baseline ratchet
+are documented in spark_rapids_tpu/analysis/lint.py and README.md
+("Static analysis").
 """
 import json
 import os
@@ -40,30 +60,105 @@ def _check_docs() -> int:
     return 0
 
 
+def _lock_graph() -> int:
+    import ast as _ast
+    from spark_rapids_tpu.analysis.dataflow import Project
+    from spark_rapids_tpu.analysis.lint import (_iter_py_files,
+                                                package_dir)
+    from spark_rapids_tpu.analysis.locks import lock_graph
+    pkg = package_dir()
+    parsed = []
+    for p in _iter_py_files([pkg]):
+        try:
+            parsed.append((p, _ast.parse(open(p).read())))
+        except SyntaxError:
+            continue
+    g = lock_graph(Project(parsed, root=pkg))
+    print(json.dumps({k: v for k, v in g.items()
+                      if not k.startswith("_")}, indent=2))
+    return 1 if g["cycles"] else 0
+
+
+def _write_baseline(out_path: str) -> int:
+    from spark_rapids_tpu.analysis.lint import LINT_SCHEMA, lint_paths
+    rep = lint_paths()
+    entries = {}
+    for f in rep["findings"]:
+        if f["allowlisted"]:
+            continue  # inline allowlists carry their own reasons
+        e = entries.setdefault(f["fingerprint"], {
+            "rule": f["rule"], "path": f["path"],
+            "message": f["message"], "count": 0})
+        e["count"] += 1
+    doc = {"schema": LINT_SCHEMA,
+           "note": "tpu-lint baseline: accepted findings, keyed by "
+                   "fingerprint (rule+path+digit-normalized message). "
+                   "CI fails only on findings NOT in this file; "
+                   "regenerate with tools/tpu_lint.py "
+                   "--write-baseline after deliberately accepting "
+                   "one.",
+           "findings": entries}
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"baseline written: {out_path} ({len(entries)} "
+          f"fingerprint(s), "
+          f"{sum(e['count'] for e in entries.values())} finding(s))")
+    return 0
+
+
+def _take_arg(argv, flag):
+    if flag not in argv:
+        return argv, None
+    i = argv.index(flag)
+    if i + 1 >= len(argv):
+        print(f"{flag} requires a file argument", file=sys.stderr)
+        sys.exit(2)
+    val = argv[i + 1]
+    return argv[:i] + argv[i + 2:], val
+
+
 def main(argv) -> int:
-    from spark_rapids_tpu.analysis.lint import conf_key_report, lint_paths
+    from spark_rapids_tpu.analysis.lint import (conf_key_report,
+                                                lint_paths,
+                                                load_baseline)
     as_json = "--json" in argv
     argv = [a for a in argv if a != "--json"]
     if "--check-docs" in argv:
         return _check_docs()
+    if "--lock-graph" in argv:
+        return _lock_graph()
     if "--confs" in argv:
         rep = conf_key_report()
         print(json.dumps(rep, indent=2))
         return 1 if rep["unused"] or rep["unregistered_reads"] else 0
+    argv, wb = _take_arg(argv, "--write-baseline")
+    if wb is not None:
+        return _write_baseline(wb)
+    argv, baseline_path = _take_arg(argv, "--baseline")
+    if "--no-baseline" in argv:
+        argv = [a for a in argv if a != "--no-baseline"]
+        baseline = None
+    else:
+        # the checked-in baseline applies by default: a clean checkout
+        # must lint clean without magic flags
+        baseline = load_baseline(baseline_path)
     paths = [a for a in argv if not a.startswith("-")] or None
-    out = lint_paths(paths)
+    out = lint_paths(paths, baseline=baseline)
     if as_json:
         print(json.dumps(out, indent=2))
     else:
         for f in out["findings"]:
-            mark = "ALLOW" if f["allowlisted"] else "FAIL "
+            mark = "ALLOW" if f["allowlisted"] else (
+                "BASE " if f["baselined"] else "FAIL ")
             print(f"{mark} [{f['rule']}] {f['path']}:{f['line']} "
                   f"{f['message']}"
                   + (f"  ({f['allow_reason']})" if f["allowlisted"]
                      else ""))
         print(f"tpu-lint: {out['files']} files, "
               f"{out['violations']} violations, "
-              f"{out['allowlisted']} allowlisted")
+              f"{out['allowlisted']} allowlisted, "
+              f"{out['baselined']} baselined")
     return 1 if out["violations"] else 0
 
 
