@@ -549,8 +549,6 @@ def main():
     pargs = (pq[:pcap], pp_[:pcap], pd_[:pcap], ps_[:pcap])
     xla_fn = jax.jit(masked_product_sum_xla)
     r_xla = xla_fn(*pargs)
-    r_pal = masked_product_sum_pallas(*pargs, False)
-    jax.block_until_ready((r_xla, r_pal))
 
     def _t(fn):
         ts = []
@@ -560,7 +558,23 @@ def main():
             ts.append(time.perf_counter() - t0)
         return sorted(ts)[3]
     t_xla = _t(xla_fn)
-    t_pal = _t(lambda *a: masked_product_sum_pallas(*a, False))
+    # hosts without a real TPU (CPU backend) can't lower pallas_call:
+    # record the rejection verbatim like the gather/sort A/Bs instead
+    # of failing the whole benchmark (same falsifiability rule)
+    try:
+        r_pal = masked_product_sum_pallas(*pargs, False)
+        jax.block_until_ready((r_xla, r_pal))
+        t_pal = _t(lambda *a: masked_product_sum_pallas(*a, False))
+        pallas_ab = {
+            "xla_ms": round(t_xla * 1e3, 3),
+            "pallas_ms": round(t_pal * 1e3, 3),
+            "pallas_over_xla": round(t_xla / t_pal, 3),
+        }
+    except Exception as e:  # noqa: BLE001 — recorded, not masked
+        r_pal = None
+        pallas_ab = {"xla_ms": round(t_xla * 1e3, 3),
+                     "status": "pallas-unavailable",
+                     "error": f"{type(e).__name__}: {str(e)[:120]}"}
 
     # gather-bound A/B (VERDICT r4 weak #10: the hard candidate). The
     # elementwise A/B above measures the kernel XLA was always going to
@@ -746,6 +760,36 @@ def main():
     # restore the process-wide recorder default for the rest of the run
     ExecCtx()
 
+    # --- timed phase 2c: query-lifecycle overhead A/B (same pipeline) ----
+    # The lifecycle layer (lifecycle.py) is default-on: every batch of
+    # every operator runs a cooperative cancellation/deadline check,
+    # and the retry scopes consult the per-query budget. Same audit
+    # pattern as obs_overhead_frac: the warm q6 from-parquet pipeline
+    # with a QueryContext threaded vs without one (the
+    # spark.rapids.lifecycle.enabled=false path), <= 5% to stay
+    # default-on.
+    from spark_rapids_tpu.lifecycle import QueryContext as _QCtx
+    ctx_lc_off = ExecCtx(_RC({"spark.rapids.lifecycle.enabled":
+                              "false"}))
+    ctx_lc_on = ExecCtx(_RC({}))
+    ctx_lc_on.qctx = _QCtx(ctx_lc_on.conf)
+
+    def _time_lc(c):
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            o = list(plan_files.execute(c))
+            jax.block_until_ready(o)
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[1]
+    lc_off_t = _time_lc(ctx_lc_off)
+    lc_on_t = _time_lc(ctx_lc_on)
+    lifecycle_overhead_frac = round(
+        max(0.0, lc_on_t / lc_off_t - 1.0), 4)
+    print(f"lifecycle overhead: on {lc_on_t*1e3:.1f} ms vs off "
+          f"{lc_off_t*1e3:.1f} ms -> {lifecycle_overhead_frac:.1%}",
+          file=sys.stderr)
+
     # --- timed phase 3: join+group-by (q97/q72 shape), STILL pipelined ---
     # zero host readbacks anywhere in this pipeline (unique-build fast
     # path + hint), so the dispatch stream stays async: this measures
@@ -818,8 +862,10 @@ def main():
     join_check(join_outs, host_join_out)
     nds_verify()
     nds_files_verify()
-    assert abs(float(r_xla) - float(r_pal)) <= \
-        1e-3 * max(1.0, abs(float(r_xla))), (float(r_xla), float(r_pal))
+    if r_pal is not None:
+        assert abs(float(r_xla) - float(r_pal)) <= \
+            1e-3 * max(1.0, abs(float(r_xla))), \
+            (float(r_xla), float(r_pal))
     join_mrows = round(join_rows / join_dev_t / 1e6, 2)
     join_vs = round(host_join_t / join_dev_t, 3)
 
@@ -882,6 +928,13 @@ def main():
         "obs_overhead_frac": obs_overhead_frac,
         "obs_on_ms": round(obs_on_t * 1e3, 1),
         "obs_off_ms": round(obs_off_t * 1e3, 1),
+        # query-lifecycle overhead audit (per-batch cancellation/
+        # deadline checks + budget-aware retry scopes, QueryContext
+        # threaded vs lifecycle off, same warm pipeline): the
+        # default-on claim requires this to stay <= 0.05
+        "lifecycle_overhead_frac": lifecycle_overhead_frac,
+        "lifecycle_on_ms": round(lc_on_t * 1e3, 1),
+        "lifecycle_off_ms": round(lc_off_t * 1e3, 1),
         "join_agg_mrows_per_sec": join_mrows,
         "join_agg_vs_host": join_vs,
         "join_agg_sync_regime_mrows_per_sec":
@@ -902,11 +955,7 @@ def main():
         # ragged gather shapes); when Mosaic rejects the kernel the
         # entry says so: on this environment the general question stays
         # OPEN for gather shapes, not answered.
-        "pallas_ab": {
-            "xla_ms": round(t_xla * 1e3, 3),
-            "pallas_ms": round(t_pal * 1e3, 3),
-            "pallas_over_xla": round(t_xla / t_pal, 3),
-        },
+        "pallas_ab": pallas_ab,
         "pallas_gather_ab": gather_ab,
         # sort A/B (ROADMAP item 4): bitonic Pallas network vs
         # jax.lax.sort — the sort shape was never Mosaic-blocked

@@ -19,7 +19,9 @@ _jax.config.update("jax_enable_x64", True)
 
 from .config import RapidsConf
 from .datatypes import Schema
+from .lifecycle import QueryCancelled, QueryContext
 
-__all__ = ["RapidsConf", "Schema", "__version__"]
+__all__ = ["RapidsConf", "Schema", "QueryCancelled", "QueryContext",
+           "__version__"]
 
 from .session import TpuSession, DataFrame  # noqa: E402  (product surface)

@@ -90,9 +90,16 @@ LOCK_HIERARCHY: Tuple[LockLevel, ...] = (
     LockLevel("IciShuffleTransport._lock", 20,
               ("ici.py", "IciShuffleTransport", None),
               "collective-transport bookkeeping"),
+    LockLevel("FairAdmissionController._cv", 28,
+              ("lifecycle.py", "FairAdmissionController", "__init__"),
+              "fair-admission queues/grants; the cancellation token's "
+              "lock (34) and the observability leaves are acquired "
+              "under it (token poll / queue-depth gauge), never the "
+              "reverse"),
     LockLevel("_WeightedWindow._cv", 30,
               ("pipeline.py", "_WeightedWindow", None),
-              "pipelined-map admission window"),
+              "pipelined-map admission window; polls the cancellation "
+              "token (34) while waiting"),
     LockLevel("*parquet_device.py::_JIT_LOCK", 30,
               ("parquet_device.py", None, "<module>"),
               "fused-decode jit arena cache"),
@@ -103,6 +110,10 @@ LOCK_HIERARCHY: Tuple[LockLevel, ...] = (
     LockLevel("*host.py::*.ilock", 30,
               ("host.py", "HostShuffleTransport", "read_partition"),
               "shuffle-read feeder in-flight set"),
+    LockLevel("CancellationToken._lock", 34,
+              ("lifecycle.py", "CancellationToken", "__init__"),
+              "classify-once cancellation flag; leaf-ish — only the "
+              "metrics/flight leaves sit below it"),
     LockLevel("SpillableBatch._state_lock", 40,
               ("memory.py", "SpillableBatch", None),
               "per-batch tier transitions; acquires the ledger lock "

@@ -53,6 +53,7 @@ from .config import (FLIGHT_ENABLED, FLIGHT_STRAGGLER_FACTOR,
                      SHUFFLE_FETCH_RETRY_WAIT_MS,
                      SHUFFLE_MAX_STAGE_RETRIES)
 from .exec.base import ExecCtx, LeafExec, TpuExec
+from .lifecycle import QueryCancelled as _QueryCancelled
 from .obs.metrics import (METRICS_ENABLED, REGISTRY,
                           flush_worker_metrics, maybe_start_http_server,
                           read_worker_metrics, render_merged_snapshots)
@@ -218,6 +219,10 @@ def _run_map_task(payload: Dict, tracer=NULL_TRACER,
     staging = transport.begin_task_attempt(sid, task_key, attempt)
     ctx = ExecCtx(conf)
     ctx.tracer = tracer  # join the driver's trace, not a fresh one
+    # lifecycle: the worker-side token polls the driver's cancel
+    # marker between batches and honors the wall deadline locally
+    from .lifecycle import QueryContext
+    ctx.qctx = QueryContext.for_worker(payload, conf)
     if obs_sink is not None:
         # exposed BEFORE execution so a failed attempt's partial
         # per-operator snapshot can still flush next to its .err
@@ -250,6 +255,8 @@ def _run_collect_task(payload: Dict, tracer=NULL_TRACER,
     plan: TpuExec = payload["plan"]
     ctx = ExecCtx(conf)
     ctx.tracer = tracer
+    from .lifecycle import QueryContext
+    ctx.qctx = QueryContext.for_worker(payload, conf)
     if obs_sink is not None:
         obs_sink["ctx"] = ctx
     rbs = [device_to_arrow(b) for b in plan.execute(ctx)]
@@ -440,6 +447,27 @@ def worker_main(root: str, worker_id: int, poll_s: float = 0.02,
                 with open(path + ".claim.tmp", "w") as f:
                     f.write(f"{worker_id} {time.time()}")
                 os.replace(path + ".claim.tmp", path + ".claim")
+                # lifecycle checkpoint AT CLAIM: a task claimed after
+                # its query was cancelled never runs — the classified
+                # error takes the normal .err path below
+                lc = payload.get("lifecycle") or {}
+                if lc.get("cancel_path") \
+                        and os.path.exists(lc["cancel_path"]):
+                    from .lifecycle import (QueryCancelled,
+                                            read_cancel_marker)
+                    r, d = read_cancel_marker(lc["cancel_path"])
+                    raise QueryCancelled(
+                        r, f"cancel marker observed at task claim: {d}",
+                        lc.get("query_id", ""))
+                # query-scoped chaos (oom_storm) rides per-task conf
+                # overrides — applied before the task builds its
+                # ExecCtx/DeviceMemoryManager
+                overrides = chaos.conf_overrides(
+                    settings.get(INJECT_FAULTS.key, ""), worker_id,
+                    task_id, attempt)
+                if overrides:
+                    payload["conf"] = dict(payload.get("conf") or {},
+                                           **overrides)
                 chaos.maybe_inject(
                     settings.get(INJECT_FAULTS.key, ""), worker_id,
                     payload.get("task_id", ""),
@@ -449,7 +477,8 @@ def worker_main(root: str, worker_id: int, poll_s: float = 0.02,
                     # seconds instead of parking the worker for minutes
                     hang_bound_s=max(
                         5.0, RapidsConf(settings).get(
-                            HEARTBEAT_TIMEOUT) * 3))
+                            HEARTBEAT_TIMEOUT) * 3),
+                    cancel_path=lc.get("cancel_path"))
                 with tracer.span(
                         f"task {payload.get('task_id', '?')} "
                         f"a{payload.get('attempt', 0)}", cat="task",
@@ -488,6 +517,17 @@ def worker_main(root: str, worker_id: int, poll_s: float = 0.02,
                 _flush_task_flight(root, worker_id, path, task_id,
                                    attempt, claim_wall, failed=True,
                                    error=tb)
+                if isinstance(exc, _QueryCancelled):
+                    # classified lifecycle stop (worker saw the cancel
+                    # marker, its wall deadline, or its budget): a
+                    # structured marker BEFORE the .err, so the driver
+                    # escalates to the classified cancel path instead
+                    # of burning retries on a dead query
+                    with open(path + ".qcancel.tmp", "w") as f:
+                        json.dump({"reason": exc.reason,
+                                   "detail": (exc.detail or "")[:400]},
+                                  f)
+                    os.replace(path + ".qcancel.tmp", path + ".qcancel")
                 if isinstance(exc, FetchFailure):
                     # structured marker BEFORE the .err it accompanies:
                     # when the driver harvests the .err, the
@@ -516,11 +556,13 @@ class _WorkerPool:
     liveness through."""
 
     def __init__(self, root: str, n: int, env: Dict[str, str],
-                 heartbeat_interval: float):
+                 heartbeat_interval: float,
+                 exit_timeout_s: float = 10.0):
         self.root = root
         self.n = n
         self._env = env
         self._hb_interval = heartbeat_interval
+        self._exit_timeout_s = exit_timeout_s
         self._procs: List[Optional[subprocess.Popen]] = [None] * n
         self._errlogs: List[Optional[Tuple[str, object]]] = [None] * n
         self._spawn_ts = [0.0] * n
@@ -576,7 +618,7 @@ class _WorkerPool:
         if p is not None and p.poll() is None:
             p.kill()
             try:
-                p.wait(timeout=10)
+                p.wait(timeout=self._exit_timeout_s)
             except subprocess.TimeoutExpired:
                 pass
 
@@ -615,7 +657,7 @@ class _WorkerPool:
             if p is None:
                 continue
             try:
-                p.wait(timeout=10)
+                p.wait(timeout=self._exit_timeout_s)
             except subprocess.TimeoutExpired:
                 p.kill()
         for log in self._errlogs:
@@ -675,12 +717,16 @@ class TpuProcessCluster:
         wenv["RAPIDS_TPU_IS_WORKER"] = "1"
         if env:
             wenv.update(env)
+        from .config import WORKER_EXIT_TIMEOUT
         self.pool = _WorkerPool(self.root, n_workers, wenv,
-                                self.conf.get(HEARTBEAT_INTERVAL))
+                                self.conf.get(HEARTBEAT_INTERVAL),
+                                self.conf.get(WORKER_EXIT_TIMEOUT))
         self._query_seq = 0
         self._sid_seq = 0
         self._quarantine_seq = 0
         self.last_scheduler: Optional[TaskScheduler] = None
+        self.last_qctx = None  # lifecycle context of the last query
+        self._running_qctx = None  # set only while run_query is live
         self.last_trace_path: Optional[str] = None
         self.last_incident_path: Optional[str] = None
         self.last_plan: Optional[TpuExec] = None
@@ -700,6 +746,17 @@ class TpuProcessCluster:
             import shutil
             shutil.rmtree(self.root, ignore_errors=True)
 
+    def cancel_running(self, detail: str = "user requested") -> bool:
+        """Cancel the in-flight ``run_query`` (thread-safe): flips the
+        query's token; the scheduler's next poll pass publishes the
+        rendezvous marker, reaps in-flight attempts, and run_query
+        raises ``QueryCancelled(reason=user)``. False when no query is
+        running or it already finished/cancelled."""
+        q = self._running_qctx
+        if q is None:
+            return False
+        return q.cancel(detail)
+
     def __enter__(self):
         return self
 
@@ -709,13 +766,23 @@ class TpuProcessCluster:
     # --- query execution --------------------------------------------------
 
     def run_query(self, plan: TpuExec,
-                  conf: Optional[RapidsConf] = None) -> pa.Table:
+                  conf: Optional[RapidsConf] = None,
+                  qctx=None) -> pa.Table:
         """Execute a physical plan across the worker processes: stages
         split at shuffle exchanges, map outputs exchanged as Arrow IPC
         files, final per-partition results concatenated here. Task
         failures, worker deaths/hangs, and stragglers are handled by the
         TaskScheduler; every attempt is recorded and forwarded to the
-        event log when `spark.rapids.eventLog.dir` is set."""
+        event log when `spark.rapids.eventLog.dir` is set.
+
+        Lifecycle (lifecycle.py, default-on): the query runs under a
+        ``QueryContext`` — fair driver-side admission against the
+        shared slot pool, a deadline/cancellation token the scheduler
+        polls every pass and fans out to workers via a rendezvous
+        ``.cancel`` marker (checked at task claim and between batches),
+        and classified ``QueryCancelled`` with event-log +
+        flight-recorder + incident-bundle evidence. ``cancel_running``
+        cancels from another thread."""
         conf = conf or self.conf
         settings = conf.items()
         plan = copy.deepcopy(plan)
@@ -735,10 +802,19 @@ class TpuProcessCluster:
         self.last_opmetrics = {}
         self._query_seq += 1
         qid = self._query_seq
+        from .lifecycle import (LIFECYCLE_ENABLED, QueryCancelled,
+                                QueryContext)
+        if qctx is None and conf.get(LIFECYCLE_ENABLED):
+            qctx = QueryContext.from_conf(conf, query_id=f"q{qid}")
+        self.last_qctx = qctx
+        # cancel_running targets only a LIVE query: cancelling after
+        # completion must be a no-op, not phantom cancel evidence
+        self._running_qctx = qctx
         tracer = tracer_from_conf(conf)
         RECORDER.configure(conf)
         sched = TaskScheduler(self.pool, os.path.join(self.root, "tasks"),
-                              conf, query_id=f"q{qid}", tracer=tracer)
+                              conf, query_id=f"q{qid}", tracer=tracer,
+                              qctx=qctx)
         self.last_scheduler = sched
         self._verify_plan(plan, conf, qid, sched)
         # wall stamp filters ring events (their ts is wall clock); the
@@ -752,11 +828,43 @@ class TpuProcessCluster:
                 from .tools.event_log import plan_fingerprint
                 args = {"fingerprint": plan_fingerprint(plan)}
             with tracer.span(f"query q{qid}", cat="query", args=args):
-                result = self._run_query_stages(plan, conf, settings,
-                                                qid, sched)
+                # driver-side fair admission: concurrent cluster
+                # queries draw from the same weighted per-tenant slot
+                # pool as local collects (one slot per query while its
+                # stages run). Lifecycle-managed queries only — with
+                # the kill switch off (qctx None), run_query must not
+                # start queueing on the device pool it never touched
+                # pre-lifecycle (the driver does no device work)
+                import contextlib
+                from .memory import DeviceMemoryManager
+                gate = DeviceMemoryManager.shared(conf).task_slot(qctx) \
+                    if qctx is not None else contextlib.nullcontext()
+                with gate:
+                    result = self._run_query_stages(
+                        plan, conf, settings, qid, sched)
             ok = True
             return result
+        except QueryCancelled as e:
+            # classified cancel: one scheduler event (the anomaly the
+            # incident harvest keys on — the scheduler emits it on ITS
+            # detection paths; admission/driver-side raises land here)
+            # plus the event-log line
+            if not any(ev["event"] == "query_cancelled"
+                       for ev in sched.events):
+                sched._event("query_cancelled",
+                             reason=f"[{e.reason}] {e.detail}"[:400])
+            from .obs.opmetrics import plan_source
+            from .tools.event_log import log_query_cancelled
+            try:
+                log_query_cancelled(conf, e,
+                                    time.monotonic() - t0_mono,
+                                    source=plan_source(plan),
+                                    cluster="process")
+            except OSError:
+                pass
+            raise
         finally:
+            self._running_qctx = None
             # failed queries are exactly the ones whose attempt
             # timeline and trace the profiler needs — emit
             # unconditionally

@@ -188,6 +188,11 @@ SHUFFLE_FETCH_RETRY_WAIT_MS = register(
     "spark.rapids.shuffle.fetch.retryWaitMs", 50,
     "Base wait between in-place shuffle fetch retries, doubling per "
     "retry.", conv=_to_float)
+SHUFFLE_CLOSE_JOIN_TIMEOUT = register(
+    "spark.rapids.shuffle.close.joinTimeout", 10.0,
+    "Seconds HostShuffleTransport.close() waits for outstanding "
+    "multithreaded writer futures before abandoning them (a wedged "
+    "codec/filesystem thread must not hang teardown forever).")
 SHUFFLE_MAX_STAGE_RETRIES = register(
     "spark.rapids.shuffle.maxStageRetries", 4,
     "Lineage-recovery budget per query: how many map-task "
@@ -344,6 +349,11 @@ MAX_WORKER_RESPAWNS = register(
     "spark.rapids.tpu.scheduler.maxWorkerRespawns", 4,
     "Total worker process respawns a query may spend recovering from "
     "dead or wedged workers before the failure is fatal.")
+WORKER_EXIT_TIMEOUT = register(
+    "spark.rapids.tpu.worker.exitTimeout", 10.0,
+    "Seconds the driver waits for a worker process to exit after a "
+    "kill or cluster shutdown before moving on (startup-time knob: "
+    "the pool reads it when the cluster spawns).", startup_only=True)
 HEARTBEAT_INTERVAL = register(
     "spark.rapids.tpu.heartbeat.interval", 0.5,
     "Seconds between worker heartbeat-file writes (startup-time knob: "
@@ -372,9 +382,14 @@ SPECULATION_MIN_RUNTIME = register(
 INJECT_FAULTS = register(
     "spark.rapids.tpu.test.injectFaults", "",
     "Testing: deterministic fault injection in cluster workers. "
-    "Semicolon-separated rules 'mode:task_glob:attempt[:seconds]' with "
-    "mode crash | hang | delay, task_glob an fnmatch pattern over task "
-    "ids (e.g. 'q1s1m0'), attempt an int or '*'. See scheduler/chaos.py.",
+    "Semicolon-separated rules 'mode:task_glob:attempt[:arg]' with "
+    "mode crash | hang | delay | corrupt | drop | eio (process/"
+    "shuffle-durability faults) or hang_query | oom_storm | "
+    "slow_admission (query-scoped lifecycle faults; slow_admission "
+    "matches the QUERY id and is applied by the driver's admission "
+    "controller), task_glob an fnmatch pattern over task ids (e.g. "
+    "'q1s1m0'), attempt an int or '*'. Unknown modes are a hard parse "
+    "error, never a silent no-op. See scheduler/chaos.py.",
     internal=True)
 
 # --- Flight recorder ------------------------------------------------------
@@ -435,6 +450,12 @@ TEST_RETRY_OOM_INJECT = register(
     "spark.rapids.sql.test.injectRetryOOM", 0,
     "Testing: force a synthetic device OOM after N allocations "
     "(0 = disabled).", internal=True)
+TEST_RETRY_OOM_STORM = register(
+    "spark.rapids.sql.test.injectRetryOOM.storm", 0,
+    "Testing: the FIRST N retry-scope executions all raise synthetic "
+    "device OOM (0 = disabled) — the sustained-pressure injection the "
+    "degradation ladder is walked with; chaos mode 'oom_storm' sets "
+    "it per cluster task.", internal=True)
 
 
 class RapidsConf:

@@ -134,6 +134,10 @@ class ExecCtx:
         # the query's natural sync point (obs/opmetrics.py)
         from ..obs.opmetrics import OpMetricsCollector
         self.opm = OpMetricsCollector(self.conf)
+        # query lifecycle (lifecycle.py): set by the collect roots /
+        # cluster task runners; when present the execute shims below
+        # run a cooperative cancellation/deadline check per batch
+        self.qctx = None
 
     def metric(self, node: "TpuExec", name: str) -> TpuMetric:
         m = self.metrics.setdefault(node.node_label(), {})
@@ -207,17 +211,28 @@ def _count_execute(fn):
 
     def execute(self, ctx):
         opm = getattr(ctx, "opm", None)
+        # cooperative cancellation point (lifecycle.py): one attribute
+        # read per batch when nothing is cancelled; raises the
+        # classified QueryCancelled between batches at EVERY operator
+        qx = getattr(ctx, "qctx", None)
         # opm.enter: a subclass execute that delegates to a wrapped
         # super().execute (conditionless cross joins) must count each
         # batch once — the inner frame passes through
         if opm is None or not opm.enabled or not opm.enter(self):
-            yield from fn(self, ctx)
+            if qx is None:
+                yield from fn(self, ctx)
+                return
+            for b in fn(self, ctx):
+                qx.check()
+                yield b
             return
         rows_m = ctx.metric(self, "rows")
         batches_m = ctx.metric(self, "batches")
         bytes_m = ctx.metric(self, "outputBytes")
         try:
             for b in fn(self, ctx):
+                if qx is not None:
+                    qx.check()
                 batches_m.value += 1
                 opm.count_rows(rows_m, b)
                 try:
@@ -244,14 +259,22 @@ def _count_execute_cpu(fn):
 
     def execute_cpu(self, ctx):
         opm = getattr(ctx, "opm", None)
+        qx = getattr(ctx, "qctx", None)
         if opm is None or not opm.enabled or not opm.enter(self):
-            yield from fn(self, ctx)
+            if qx is None:
+                yield from fn(self, ctx)
+                return
+            for rb in fn(self, ctx):
+                qx.check()
+                yield rb
             return
         rows_m = ctx.metric(self, "rows")
         batches_m = ctx.metric(self, "batches")
         ctx.metric(self, "cpuFallback").set(1)
         try:
             for rb in fn(self, ctx):
+                if qx is not None:
+                    qx.check()
                 batches_m.value += 1
                 rows_m.value += rb.num_rows
                 yield rb
@@ -457,9 +480,12 @@ def fused_batches(consumer: TpuExec, ctx: ExecCtx, tail_fn=None,
         with ctx.tracer.span(label, cat="op"):
             t0 = time.perf_counter()
             # split-and-retry on device OOM: the fused stage re-runs over
-            # batch halves (memory.py; SURVEY.md §5.3 layer 3)
+            # batch halves (memory.py; SURVEY.md §5.3 layer 3); the
+            # query context carries the per-query budget and the
+            # degradation ladder above the halving
             outs = ctx.mm.with_retry(b,
-                                     lambda bb: jitted(bb, ctx.eval_ctx))
+                                     lambda bb: jitted(bb, ctx.eval_ctx),
+                                     qctx=getattr(ctx, "qctx", None))
             if ctx.sync_metrics:
                 for out in outs:
                     out.block_until_ready()
@@ -570,7 +596,9 @@ def collect_arrow(plan: TpuExec, ctx: Optional[ExecCtx] = None) -> pa.Table:
     ctx = ctx or ExecCtx()
     try:
         t0 = time.perf_counter()
-        with ctx.mm.task_slot():  # admission control (GpuSemaphore analog)
+        # admission control (GpuSemaphore analog; fair/cancellable when
+        # the ctx carries a QueryContext)
+        with ctx.mm.task_slot(getattr(ctx, "qctx", None)):
             ctx.metric(plan, "ledgerWaitTime").value += \
                 time.perf_counter() - t0
             batches = [device_to_arrow(b) for b in plan.execute(ctx)]

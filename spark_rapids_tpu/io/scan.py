@@ -916,11 +916,13 @@ class TpuFileScanExec(LeafExec):
         # can decode to far more than a numeric group, and a count-only
         # window would pin `window` of them in HBM at once
         max_weight = window * max(target_bytes, 64 << 20)
+        qx = getattr(ctx, "qctx", None)
         gen = pipelined_map(assemble, groups, threads=up_threads,
                             window=window,
                             weigher=lambda g: sum(
                                 self._decoded_estimate(it) for it in g),
-                            max_weight=max_weight)
+                            max_weight=max_weight,
+                            token=qx.token if qx is not None else None)
         try:
             while True:
                 t0 = time.perf_counter()
@@ -994,8 +996,10 @@ class TpuFileScanExec(LeafExec):
         # batch N. The window bounds device residency of not-yet-
         # consumed uploads; depth <= 0 degrades to the serial path.
         depth = ctx.conf.get(SCAN_PREFETCH_BATCHES)
+        qx = getattr(ctx, "qctx", None)
         gen = pipelined_map(upload, timed_source(), threads=1,
-                            window=max(depth, 0))
+                            window=max(depth, 0),
+                            token=qx.token if qx is not None else None)
         try:
             while True:
                 t0 = time.perf_counter()

@@ -28,12 +28,14 @@ from typing import Callable, List, Optional
 
 from .config import (ALLOC_FRACTION, CONCURRENT_TPU_TASKS, OOM_MAX_SPLITS,
                      OOM_RETRY_BLOCKING, OOM_RETRY_ENABLED, RapidsConf,
-                     TEST_RETRY_OOM_INJECT, register, _bytes_conv)
+                     TEST_RETRY_OOM_INJECT, TEST_RETRY_OOM_STORM,
+                     register, _bytes_conv)
+from .lifecycle import FairAdmissionController, LADDER_EXCLUSIVE_TIMEOUT
 from .obs.metrics import REGISTRY as _METRICS
 from .obs.recorder import RECORDER as _FLIGHT
 
 __all__ = ["DeviceMemoryManager", "SpillableBatch", "TpuRetryOOM",
-           "resolve_device_budget", "split_batch"]
+           "QueryBudgetExceeded", "resolve_device_budget", "split_batch"]
 
 DEVICE_BUDGET = register(
     "spark.rapids.memory.device.budgetBytes", 0,
@@ -68,7 +70,22 @@ _MEM_OOM_RETRIES = _METRICS.counter(
 
 
 class TpuRetryOOM(RuntimeError):
-    """Device OOM surfaced to the retry framework (GpuRetryOOM analog)."""
+    """Device OOM surfaced to the retry framework (GpuRetryOOM analog).
+
+    ``ladder_exhausted`` marks the classified terminal form: the
+    degradation ladder walked halve -> spill -> width1 and still hit
+    OOM — the collect root answers it with the per-operator CPU
+    fallback rung instead of failing the query."""
+
+    ladder_exhausted = False
+
+
+class QueryBudgetExceeded(TpuRetryOOM):
+    """A per-query memory budget (spark.rapids.query.memoryBudgetBytes)
+    would be exceeded — a query-local OOM: it feeds the same
+    split-and-retry/degradation ladder as a real RESOURCE_EXHAUSTED,
+    but its terminal rung is QueryCancelled(reason=budget), not CPU
+    fallback."""
 
 
 def resolve_device_budget(conf: Optional[RapidsConf] = None) -> int:
@@ -334,15 +351,23 @@ class DeviceMemoryManager:
         share one instance). OOM-injection confs always get a fresh
         instance — the injection counter is per-test state."""
         conf = conf or RapidsConf()
-        if conf.get(TEST_RETRY_OOM_INJECT):
+        if conf.get(TEST_RETRY_OOM_INJECT) \
+                or conf.get(TEST_RETRY_OOM_STORM):
             return cls(conf)
-        from .config import (HOST_SPILL_LIMIT, LEAK_DEBUG, MEM_DEBUG,
-                             SPILL_DIR)
+        from .config import (HOST_SPILL_LIMIT, INJECT_FAULTS, LEAK_DEBUG,
+                             MEM_DEBUG, SPILL_DIR)
+        from .lifecycle import (ADMISSION_MAX_QUEUE, ADMISSION_TIMEOUT,
+                                ADMISSION_WEIGHTS)
         key = (conf.get(DEVICE_BUDGET), conf.get(ALLOC_FRACTION),
                conf.get(CONCURRENT_TPU_TASKS), conf.get(OOM_RETRY_ENABLED),
                conf.get(OOM_MAX_SPLITS), conf.get(OOM_RETRY_BLOCKING),
                conf.get(HOST_SPILL_LIMIT), conf.get(SPILL_DIR),
-               conf.get(MEM_DEBUG), conf.get(LEAK_DEBUG))
+               conf.get(MEM_DEBUG), conf.get(LEAK_DEBUG),
+               # admission policy rides the manager (the controller is
+               # its slot owner); chaos specs fragment managers only in
+               # tests that set them
+               conf.get(ADMISSION_TIMEOUT), conf.get(ADMISSION_MAX_QUEUE),
+               conf.get(ADMISSION_WEIGHTS), conf.get(INJECT_FAULTS))
         with cls._shared_lock:
             mgr = cls._shared.get(key)
             if mgr is None:
@@ -363,12 +388,17 @@ class DeviceMemoryManager:
         self.disk_spill_bytes = 0    # total bytes ever tiered to disk
         self.host_limit = self.conf.get(HOST_SPILL_LIMIT)
         self.spill_dir = self.conf.get(SPILL_DIR)
-        self.semaphore = threading.BoundedSemaphore(
-            self.conf.get(CONCURRENT_TPU_TASKS))
+        # fair admission over the GpuSemaphore seats (lifecycle.py):
+        # bounded per-tenant queues + weighted grants + queue-time
+        # deadline; legacy task_slot() callers get the old FIFO
+        # semantics through the default tenant
+        self.admission = FairAdmissionController(
+            self.conf.get(CONCURRENT_TPU_TASKS), self.conf)
         self._retry_enabled = self.conf.get(OOM_RETRY_ENABLED)
         self._retry_blocking = self.conf.get(OOM_RETRY_BLOCKING)
         self.max_splits = self.conf.get(OOM_MAX_SPLITS)
         self._inject_after = self.conf.get(TEST_RETRY_OOM_INJECT)
+        self._inject_storm = self.conf.get(TEST_RETRY_OOM_STORM)
         self._op_count = 0
         from .config import LEAK_DEBUG, MEM_DEBUG
         self._mem_debug = self.conf.get(MEM_DEBUG) == "STDOUT"
@@ -583,28 +613,83 @@ class DeviceMemoryManager:
             else:
                 self._pin_counts[id(sb)] = c
 
-    # --- semaphore --------------------------------------------------------
+    # --- admission --------------------------------------------------------
 
-    def task_slot(self):
-        """Context manager gating concurrent device work (GpuSemaphore)."""
-        return self.semaphore
+    def task_slot(self, qctx=None):
+        """Context manager gating concurrent device work — the
+        GpuSemaphore seat behind the fair admission controller. With a
+        ``QueryContext`` the wait is tenant-queued, weighted,
+        deadline-bounded, and cancellable; without one it degrades to
+        the legacy FIFO semantics."""
+        return self.admission.slot(qctx)
+
+    # --- forced spill (degradation-ladder `spill` rung) -------------------
+
+    def spill_all_unpinned(self) -> int:
+        """Spill every unpinned device-resident catalog entry to host
+        (cascading host->disk), regardless of budget headroom — the
+        ladder's pressure-relief rung. Returns bytes spilled. Victim
+        state locks are only try-acquired (same hold-and-wait shield
+        as eviction); busy batches are skipped."""
+        with self._lock:
+            victims = [sb for key, sb in self._catalog.items()
+                       if sb.on_device
+                       and self._pin_counts.get(key, 0) <= 0]
+        freed = 0
+        for sb in victims:
+            before = sb.on_device
+            sb.spill(cascade=False, best_effort=True)
+            if before and not sb.on_device:
+                freed += sb.nbytes
+        self._evict_host_to_disk()
+        self._flight_mem("forced_spill", freed)
+        return freed
 
     # --- OOM retry --------------------------------------------------------
 
     def _maybe_inject_oom(self):
-        if self._inject_after:
+        if self._inject_after or self._inject_storm:
             with self._lock:
                 self._op_count += 1
-                if self._op_count == self._inject_after:
-                    raise TpuRetryOOM(
-                        f"injected OOM at op {self._op_count} "
-                        "(spark.rapids.sql.test.injectRetryOOM)")
+                n = self._op_count
+            if self._inject_after and n == self._inject_after:
+                raise TpuRetryOOM(
+                    f"injected OOM at op {n} "
+                    "(spark.rapids.sql.test.injectRetryOOM)")
+            if self._inject_storm and n <= self._inject_storm:
+                raise TpuRetryOOM(
+                    f"injected OOM storm op {n}/{self._inject_storm} "
+                    "(spark.rapids.sql.test.injectRetryOOM.storm)")
 
-    def with_retry(self, batch, fn: Callable, depth: int = 0) -> List:
+    def _check_query_budget(self, batch, qctx) -> None:
+        """Per-query budget gate (lifecycle.py): the HBM occupancy this
+        query is driving (process ledger + the batch in hand — per-query
+        byte attribution doesn't exist below the ledger) must fit its
+        budget. action=cancel classifies immediately; action=degrade
+        raises the budget-flavored OOM into the ladder."""
+        if qctx is None or not qctx.budget_bytes:
+            return
+        occupancy = self.device_bytes + batch.device_size_bytes()
+        if occupancy <= qctx.budget_bytes:
+            return
+        detail = (f"query memory budget exceeded: {occupancy} > "
+                  f"{qctx.budget_bytes} bytes")
+        if qctx.budget_action == "cancel":
+            qctx.token.cancel("budget", detail)
+            raise qctx.token.error()
+        raise QueryBudgetExceeded(detail)
+
+    def with_retry(self, batch, fn: Callable, depth: int = 0,
+                   qctx=None) -> List:
         """Run ``fn(batch) -> result`` with split-and-retry on device OOM:
         on failure the batch is halved and both halves processed
         sequentially (results concatenated as a list), recursively up to
-        ``maxSplits`` (RmmRapidsRetryIterator.withRetry analog).
+        ``maxSplits`` (RmmRapidsRetryIterator.withRetry analog). With a
+        ``QueryContext`` the per-query memory budget is enforced here
+        and, once the halving budget is spent, the degradation ladder
+        escalates: forced spill -> width-1 admission -> classified
+        terminal (CPU-fallback OOM, or QueryCancelled(reason=budget)
+        when the pressure was budget-driven).
 
         When ``oomRetry.blocking`` is on (default) the result is forced to
         completion inside the try: dispatch is async, so otherwise a real
@@ -617,6 +702,7 @@ class DeviceMemoryManager:
         budget the sync is cheap insurance."""
         try:
             self._maybe_inject_oom()
+            self._check_query_budget(batch, qctx)
             out = fn(batch)
             if self._retry_enabled and self._retry_blocking \
                     and (self.device_bytes + batch.device_size_bytes()
@@ -625,13 +711,54 @@ class DeviceMemoryManager:
                 jax.block_until_ready(out)
             return [out]
         except Exception as e:  # noqa: BLE001 — filtered below
-            if not self._retry_enabled or depth >= self.max_splits \
-                    or not _is_oom_error(e):
+            if not self._retry_enabled or not _is_oom_error(e):
                 raise
-            _MEM_OOM_RETRIES.inc()
-            self._flight_mem("oom_retry", batch.device_size_bytes(),
-                             depth=depth)
-            b1, b2 = split_batch(batch)
-            out = self.with_retry(b1, fn, depth + 1)
-            out.extend(self.with_retry(b2, fn, depth + 1))
-            return out
+            ladder = qctx.ladder if qctx is not None else None
+            if depth < self.max_splits and batch.capacity >= 2:
+                _MEM_OOM_RETRIES.inc()
+                self._flight_mem("oom_retry", batch.device_size_bytes(),
+                                 depth=depth)
+                if ladder is not None:
+                    ladder.note_halve()
+                b1, b2 = split_batch(batch)
+                out = self.with_retry(b1, fn, depth + 1, qctx)
+                out.extend(self.with_retry(b2, fn, depth + 1, qctx))
+                return out
+            if ladder is None:
+                # ladder-less contexts (cluster workers) still owe the
+                # budget its classification: splits were this side's
+                # whole ladder, so exhaustion under a budget-driven OOM
+                # is QueryCancelled(budget) — the worker's .qcancel
+                # marker carries it to the driver. Real device OOM
+                # stays a retryable task failure.
+                if isinstance(e, QueryBudgetExceeded) \
+                        and qctx is not None:
+                    qctx.token.cancel("budget", str(e))
+                    raise qctx.token.error() from e
+                raise
+            return self._climb_ladder(batch, fn, depth, qctx, e)
+
+    def _climb_ladder(self, batch, fn: Callable, depth: int, qctx,
+                      cause: BaseException) -> List:
+        """Halving budget spent: enter the next rung and retry (the
+        retry's own failure re-enters here one rung higher — the walk
+        terminates at ``cpu``)."""
+        rung = qctx.ladder.escalate()
+        if rung == "spill":
+            self.spill_all_unpinned()
+            return self.with_retry(batch, fn, depth, qctx)
+        if rung == "width1":
+            self.admission.await_exclusive(
+                qctx, self.conf.get(LADDER_EXCLUSIVE_TIMEOUT))
+            return self.with_retry(batch, fn, depth, qctx)
+        # terminal rung: budget-driven pressure is a classified cancel
+        # (CPU fallback can't honor a device budget that small any
+        # better than the device path the user asked to bound)
+        if isinstance(cause, QueryBudgetExceeded):
+            qctx.token.cancel("budget", str(cause))
+            raise qctx.token.error() from cause
+        exc = TpuRetryOOM(
+            "degradation ladder exhausted (halve -> spill -> width1): "
+            + str(cause))
+        exc.ladder_exhausted = True
+        raise exc from cause

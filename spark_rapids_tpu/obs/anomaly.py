@@ -44,9 +44,11 @@ __all__ = ["AnomalyDetector", "anomalies_from_scheduler",
 # durability loss is exactly what a flight recorder exists to explain.
 # plan_rejected: the static verifier refused to run the plan — the
 # bundle is how triage answers "why did this query never start".
+# query_cancelled: the lifecycle layer stopped the query (user /
+# deadline / budget / admission) — classified in the event's reason.
 _SCHED_ANOMALIES = ("task_failed", "worker_respawn", "worker_blacklisted",
                     "straggler_detected", "fetch_failed", "stage_rerun",
-                    "plan_rejected")
+                    "plan_rejected", "query_cancelled")
 
 
 class AnomalyDetector:

@@ -54,13 +54,18 @@ class _WeightedWindow:
     only sub-30 locks when entering. tpu-lint's lock analysis and the
     runtime watchdog both enforce this."""
 
-    def __init__(self, window: int, max_weight: Optional[int]):
+    def __init__(self, window: int, max_weight: Optional[int],
+                 token=None):
         self._window = window
         self._max_weight = max_weight
         self._count = 0
         self._weight = 0
         self._closed = False
         self._cv = threading.Condition()
+        # lifecycle.CancellationToken: a cancelled query's parked
+        # feeder must not sit on a full window forever — acquire
+        # becomes a cancellation point (checked on a bounded wait)
+        self._token = token
 
     def acquire(self, weight: int = 0) -> None:
         with self._cv:
@@ -68,7 +73,11 @@ class _WeightedWindow:
                     self._count >= self._window
                     or (self._max_weight is not None and self._count
                         and self._weight + weight > self._max_weight)):
-                self._cv.wait()
+                if self._token is not None \
+                        and self._token.poll_local() is not None:
+                    raise self._token.error()
+                self._cv.wait(timeout=None if self._token is None
+                              else 0.05)
             self._count += 1
             self._weight += weight
 
@@ -87,7 +96,8 @@ class _WeightedWindow:
 def pipelined_map(fn: Callable[[T], R], items: Iterable[T],
                   threads: int = 1, window: int = 2,
                   weigher: Optional[Callable[[T], int]] = None,
-                  max_weight: Optional[int] = None) -> Iterator[R]:
+                  max_weight: Optional[int] = None,
+                  token=None) -> Iterator[R]:
     """Yield ``fn(item)`` for each item, in order, with up to ``window``
     results in flight across ``threads`` worker threads.
 
@@ -105,15 +115,24 @@ def pipelined_map(fn: Callable[[T], R], items: Iterable[T],
       exception is a source exception.
     - ``close()`` (or GC) of the generator stops the feeder, cancels
       queued work, and returns without waiting for stragglers.
+    - ``token`` (a lifecycle.CancellationToken) makes the window's
+      admission gate AND the consumer loop cancellation points: a
+      cancelled query's feeder stops feeding (even parked on a full
+      window) and the consumer raises the classified QueryCancelled at
+      its next ``next()``, early-draining in-flight work through the
+      normal close path.
     """
     if threads <= 0 or window <= 0:
         for x in items:
+            if token is not None:
+                token.check()
             yield fn(x)
         return
 
     out: "queue.Queue" = queue.Queue()
     slots = _WeightedWindow(window,
-                            max_weight if weigher is not None else None)
+                            max_weight if weigher is not None else None,
+                            token=token)
     stop = threading.Event()
     pool = concurrent.futures.ThreadPoolExecutor(
         max_workers=threads, thread_name_prefix="pipelined-map")
@@ -123,13 +142,15 @@ def pipelined_map(fn: Callable[[T], R], items: Iterable[T],
             for x in items:
                 if stop.is_set():
                     return
+                if token is not None:
+                    token.check()  # stop feeding a cancelled query
                 w = int(weigher(x)) if weigher is not None else 0
                 slots.acquire(w)
                 if stop.is_set():
                     return
                 out.put((_FUT, (pool.submit(fn, x), w)))
             out.put((_END, None))
-        except BaseException as e:  # source iterator failed
+        except BaseException as e:  # source iterator failed/cancelled
             out.put((_ERR, e))
 
     th = threading.Thread(target=feeder, daemon=True,
@@ -137,7 +158,18 @@ def pipelined_map(fn: Callable[[T], R], items: Iterable[T],
     th.start()
     try:
         while True:
-            kind, val = out.get()
+            if token is None:
+                kind, val = out.get()
+            else:
+                # bounded waits so cancellation interrupts a consumer
+                # blocked on a stalled producer
+                while True:
+                    token.check()
+                    try:
+                        kind, val = out.get(timeout=0.05)
+                        break
+                    except queue.Empty:
+                        continue
             if kind == _END:
                 return
             if kind == _ERR:

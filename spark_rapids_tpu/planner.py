@@ -132,6 +132,7 @@ class PhysicalPlan:
         self.conf = conf
         self.source = source  # "sql" | "plan": how the tree was built
         self.last_ctx: Optional[ExecCtx] = None  # metrics of last collect
+        self.last_qctx = None  # lifecycle context of last collect
         self.last_profile_path: Optional[str] = None
 
     @property
@@ -158,12 +159,25 @@ class PhysicalPlan:
             return ""
         return "\n".join(self.meta.explain_lines(mode))
 
-    def collect(self, ctx: Optional[ExecCtx] = None) -> pa.Table:
+    def collect(self, ctx: Optional[ExecCtx] = None,
+                qctx=None) -> pa.Table:
         import time as _time
         ctx = ctx or ExecCtx(self.conf)
         self.last_ctx = ctx
+        # query lifecycle (lifecycle.py): default-on — every collect
+        # gets a QueryContext (deadline/tenant/budget from conf) unless
+        # the caller supplied one; the token threads through ExecCtx
+        # into every operator shim and the upload pipelines
+        from .lifecycle import (LIFECYCLE_ENABLED, QueryCancelled,
+                                QueryContext)
+        if qctx is None:
+            qctx = getattr(ctx, "qctx", None)
+        if qctx is None and self.conf.get(LIFECYCLE_ENABLED):
+            qctx = QueryContext.from_conf(self.conf)
+        ctx.qctx = qctx
+        self.last_qctx = qctx
         from .config import PROFILE_PATH
-        from .columnar.arrow_bridge import arrow_schema, device_to_arrow
+        from .columnar.arrow_bridge import arrow_schema
         import contextlib
         _t0 = _time.perf_counter()
         schema = arrow_schema(self.root.output_schema)
@@ -181,37 +195,21 @@ class PhysicalPlan:
         try:
             with tracer, qspan:
                 if self.root_on_device:
-                    try:
-                        _ts = _time.perf_counter()
-                        with ctx.mm.task_slot():  # GpuSemaphore admission
-                            # blocking happened at entry: charge the
-                            # admission wait to the root operator (the
-                            # semaphoreWaitTime analog)
-                            ctx.metric(self.root, "ledgerWaitTime") \
-                                .value += _time.perf_counter() - _ts
-                            rbs = [device_to_arrow(b)
-                                   for b in self.root.execute(ctx)]
-                    except BaseException:
-                        ctx.discard_deferred()  # dead query's flags
-                        ctx.opm.discard()
-                        raise
-                    finally:
-                        ctx.run_cleanups()
-                    ctx.check_deferred()  # downloads were the sync point
+                    rbs = self._collect_device(ctx, qctx)
                 else:
                     # CPU-rooted plans can still contain device islands
                     # (under DeviceToHostExec): their cleanups and
                     # deferred device checks must run here too
-                    try:
-                        rbs = list(self.root.execute_cpu(ctx))
-                    except BaseException:
-                        ctx.discard_deferred()
-                        ctx.opm.discard()
-                        raise
-                    finally:
-                        ctx.run_cleanups()
-                    ctx.check_deferred()
+                    rbs = self._collect_cpu(ctx)
+        except QueryCancelled as e:
+            self._report_cancel(ctx, e, _time.perf_counter() - _t0)
+            raise
         finally:
+            # width-1 exclusivity must not outlive the query (a
+            # degraded CPU-island subtree can set it while holding no
+            # admission slot — nothing else would clear it)
+            if qctx is not None:
+                ctx.mm.admission.clear_exclusive(qctx.query_id)
             # failed queries are exactly the ones whose timeline is
             # needed; a trace-dir write failure must never fail a query
             if ctx.tracer.enabled:
@@ -231,6 +229,84 @@ class PhysicalPlan:
         log_query_event(self, ctx, wall_s)
         self._write_profile(ctx, wall_s)
         return pa.Table.from_batches(rbs, schema=schema)
+
+    def _collect_device(self, ctx: ExecCtx, qctx) -> List:
+        """Device-rooted execution under fair admission; the
+        degradation ladder's terminal rung answers a
+        ladder-exhausted OOM with the classified CPU fallback."""
+        import time as _time
+        from .columnar.arrow_bridge import device_to_arrow
+        from .memory import TpuRetryOOM
+        try:
+            _ts = _time.perf_counter()
+            with ctx.mm.task_slot(qctx):  # GpuSemaphore admission
+                # blocking happened at entry: charge the admission
+                # wait to the root operator (the semaphoreWaitTime
+                # analog)
+                ctx.metric(self.root, "ledgerWaitTime") \
+                    .value += _time.perf_counter() - _ts
+                rbs = [device_to_arrow(b)
+                       for b in self.root.execute(ctx)]
+        except TpuRetryOOM as oom:
+            ctx.discard_deferred()  # dead attempt's flags
+            ctx.opm.discard()
+            ctx.run_cleanups()
+            if qctx is None or not getattr(oom, "ladder_exhausted",
+                                           False):
+                raise
+            # ladder rung `cpu`: re-run on the Spark-semantics CPU
+            # path (the shims flag every operator cpuFallback, so
+            # EXPLAIN ANALYZE/profiles show the degradation per
+            # operator); the rung itself was already counted by
+            # DegradationLadder.escalate
+            from .obs.recorder import RECORDER
+            RECORDER.record("lifecycle", ev="cpu_fallback",
+                            query=qctx.query_id,
+                            detail=str(oom)[:200])
+            # drop the aborted device attempt's per-operator counts:
+            # the shims re-count on the CPU rerun, and keeping the
+            # residue would double rows/batches in EXPLAIN ANALYZE
+            # and the query profile
+            for ms in ctx.metrics.values():
+                for name in ("rows", "batches", "outputBytes"):
+                    ms.pop(name, None)
+            ctx.metric(self.root, "ladderCpuFallback").set(1)
+            return self._collect_cpu(ctx)
+        except BaseException:
+            ctx.discard_deferred()  # dead query's flags
+            ctx.opm.discard()
+            ctx.run_cleanups()
+            raise
+        ctx.run_cleanups()
+        ctx.check_deferred()  # downloads were the sync point
+        return rbs
+
+    def _collect_cpu(self, ctx: ExecCtx) -> List:
+        try:
+            rbs = list(self.root.execute_cpu(ctx))
+        except BaseException:
+            ctx.discard_deferred()
+            ctx.opm.discard()
+            ctx.run_cleanups()
+            raise
+        ctx.run_cleanups()
+        ctx.check_deferred()
+        return rbs
+
+    def _report_cancel(self, ctx: ExecCtx, e, wall_s: float) -> None:
+        """Classified-cancel evidence: one event-log line (type
+        query_cancelled) + a flight-recorder event; the Prometheus
+        counter was incremented by the token at classification time."""
+        from .obs.recorder import RECORDER
+        RECORDER.record("lifecycle", ev="cancelled_query",
+                        query=e.query_id, reason=e.reason,
+                        wall_s=round(wall_s, 6))
+        from .tools.event_log import log_query_cancelled
+        try:
+            log_query_cancelled(self.conf, e, wall_s,
+                                source=self.source)
+        except OSError:
+            pass  # evidence must never mask the cancellation
 
     def _write_profile(self, ctx: ExecCtx, wall_s: float) -> None:
         """Persist one query-profile JSON (spark.rapids.history.dir) —

@@ -12,19 +12,25 @@ unit-testable on one host (Spark gets the equivalent via its
 TaskSetManager test harness; production clusters get the faults for
 free).
 
-Two hook points:
+Three hook points:
 
 - ``maybe_inject``        — BEFORE a claimed task runs (process-level
-  faults: ``crash`` / ``hang`` / ``delay``).
+  faults: ``crash`` / ``hang`` / ``delay``; query-scoped:
+  ``hang_query``).
 - ``maybe_inject_output`` — AFTER a map task's atomic commit
   (shuffle-durability faults: ``corrupt`` / ``drop`` / ``eio``), the
   committed-then-lost class the lineage-recovery path exists for.
+- ``conf_overrides``      — per-task conf rewrites applied before the
+  task builds its ExecCtx (query-scoped: ``oom_storm``); plus
+  ``slow_admission``, consumed driver-side by the fair admission
+  controller (lifecycle.py) with the QUERY id as the task glob.
 
 Grammar (whitespace-insensitive)::
 
     spec    := rule (';' rule)*
     rule    := mode ':' task_glob ':' attempt [':' arg] ['@w' worker]
     mode    := 'crash' | 'hang' | 'delay' | 'corrupt' | 'drop' | 'eio'
+             | 'hang_query' | 'oom_storm' | 'slow_admission'
     attempt := int | '*'
 
 - ``crash``   — the worker process exits immediately (``os._exit``),
@@ -46,6 +52,28 @@ Grammar (whitespace-insensitive)::
   transient-IO path; readers burn in-place retries, and counts above
   ``spark.rapids.shuffle.fetch.maxRetries`` escalate to a stage rerun.
 
+Query-scoped modes (the lifecycle layer's chaos surface)::
+
+- ``hang_query`` — the task stalls WITHOUT suspending its heartbeat
+  (the worker stays healthy; the QUERY is wedged — a stuck source,
+  not a stuck process): the sleep polls the query's rendezvous
+  ``.cancel`` marker and raises the classified QueryCancelled the
+  moment the driver publishes it — exactly how a cooperative
+  between-batches cancel lands on a real stalled task. ``arg`` bounds
+  the stall (default: the caller's hang bound) so a missed cancel
+  runs the task normally instead of wedging the test.
+- ``oom_storm`` — the task's conf gains
+  ``spark.rapids.sql.test.injectRetryOOM.storm = arg`` (default 2):
+  its FIRST ``arg`` retry-scope executions raise synthetic device
+  OOM, driving split-and-retry (and, on the local path, the
+  degradation ladder) under sustained pressure.
+- ``slow_admission`` — evaluated by the DRIVER's fair admission
+  controller with the query id as the task id: admission of a
+  matching query is delayed ``arg`` seconds (default 2.0), the
+  deterministic way to trip the queue-time deadline
+  (``spark.rapids.query.admission.timeout`` →
+  QueryCancelled(reason=admission)).
+
 Examples::
 
     crash:q1s1m0:0            # kill the worker running map task 0,
@@ -55,6 +83,11 @@ Examples::
     crash:q1s1m0:0@w1         # only when worker 1 runs it
     corrupt:q1s1m0:0          # attempt 0's committed output is rotten
     eio:q1s1m*:0:5            # every map output needs 5 reads to stick
+    hang_query:q1r*:*         # every final-stage task of query 1
+                              # stalls until cancelled
+    oom_storm:q1s1m0:0:6      # six injected OOMs at the start of the
+                              # map task's retry scopes
+    slow_admission:q2:0:3     # query q2 waits 3s for admission
 """
 from __future__ import annotations
 
@@ -66,11 +99,16 @@ import time
 from typing import List, Optional, Sequence
 
 __all__ = ["ChaosRule", "parse_fault_spec", "find_rule", "maybe_inject",
-           "maybe_inject_output"]
+           "maybe_inject_output", "conf_overrides"]
 
-_PRE_MODES = ("crash", "hang", "delay")
+_PRE_MODES = ("crash", "hang", "delay", "hang_query")
 _POST_MODES = ("corrupt", "drop", "eio")
-_MODES = _PRE_MODES + _POST_MODES
+#: query-scoped modes resolved OUTSIDE the worker pre/post hooks:
+#: oom_storm rewrites the task's conf (conf_overrides);
+#: slow_admission is consumed by the driver's admission controller
+_CONF_MODES = ("oom_storm",)
+_DRIVER_MODES = ("slow_admission",)
+_MODES = _PRE_MODES + _POST_MODES + _CONF_MODES + _DRIVER_MODES
 
 #: fallback hang bound when the caller has no conf in reach — still
 #: finite so an orphaned chaos worker can't outlive its test run
@@ -82,8 +120,15 @@ class ChaosRule:
     mode: str
     task_glob: str
     attempt: Optional[int]  # None = any attempt
-    seconds: float = 2.0  # delay seconds / eio failing-read count
+    #: the optional 4th field (delay seconds / eio failing-read count /
+    #: oom count / stall bound). None = not given — each mode applies
+    #: its own default via ``arg()``; a sentinel default here would
+    #: make an explicit ':2' indistinguishable from "no arg"
+    seconds: Optional[float] = None
     worker: Optional[int] = None  # None = any worker
+
+    def arg(self, default: float) -> float:
+        return default if self.seconds is None else self.seconds
 
     def matches(self, worker_id: int, task_id: str, attempt: int) -> bool:
         if self.worker is not None and self.worker != worker_id:
@@ -104,12 +149,18 @@ def parse_fault_spec(spec: str) -> List[ChaosRule]:
             raw, _, w = raw.rpartition("@w")
             worker = int(w)
         parts = [p.strip() for p in raw.split(":")]
-        if len(parts) < 3 or parts[0] not in _MODES:
+        if len(parts) < 3:
             raise ValueError(f"bad injectFaults rule {raw!r} (want "
                              "mode:task_glob:attempt[:arg])")
+        if parts[0] not in _MODES:
+            # never a silent no-op: an unknown mode is a spec typo the
+            # test author must hear about, with the valid set named
+            raise ValueError(
+                f"unknown injectFaults mode {parts[0]!r} in rule "
+                f"{raw!r}; valid modes: {', '.join(_MODES)}")
         mode, glob, att = parts[:3]
         attempt = None if att == "*" else int(att)
-        seconds = float(parts[3]) if len(parts) > 3 else 2.0
+        seconds = float(parts[3]) if len(parts) > 3 else None
         rules.append(ChaosRule(mode, glob, attempt, seconds, worker))
     return rules
 
@@ -126,16 +177,34 @@ def find_rule(spec: str, worker_id: int, task_id: str, attempt: int,
 
 def maybe_inject(spec: str, worker_id: int, task_id: str, attempt: int,
                  heartbeat=None,
-                 hang_bound_s: Optional[float] = None) -> None:
+                 hang_bound_s: Optional[float] = None,
+                 cancel_path: Optional[str] = None) -> None:
     """Worker-side pre-run hook: apply the first matching process-level
     rule, if any. ``crash`` never returns; ``hang`` does not return
     while the driver behaves (it kills the process), but self-destructs
     after ``hang_bound_s`` — derived by the caller from the heartbeat
     timeout — so a missed kill fails the test quickly instead of
-    parking for ten minutes; ``delay`` returns after sleeping."""
+    parking for ten minutes; ``delay`` returns after sleeping;
+    ``hang_query`` stalls with a LIVE heartbeat, polling
+    ``cancel_path`` so a driver-published cancel lands as the
+    classified QueryCancelled (the cooperative-cancel rehearsal)."""
     rule = find_rule(spec, worker_id, task_id, attempt, _PRE_MODES)
     if rule is None:
         return
+    if rule.mode == "hang_query":
+        bound = rule.arg(hang_bound_s if hang_bound_s is not None
+                         else _DEFAULT_HANG_BOUND_S)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < bound:
+            if cancel_path and os.path.exists(cancel_path):
+                from ..lifecycle import (QueryCancelled,
+                                         read_cancel_marker)
+                reason, detail = read_cancel_marker(cancel_path)
+                raise QueryCancelled(
+                    reason, f"chaos hang_query observed cancel "
+                            f"marker: {detail}")
+            time.sleep(0.05)
+        return  # bound elapsed without a cancel: run normally
     if rule.mode == "crash":
         # tpu-lint: allow[exit-without-flush] crash chaos SIMULATES a flushless death; the worker loop flushed the ring at task claim
         os._exit(13)
@@ -149,7 +218,20 @@ def maybe_inject(spec: str, worker_id: int, task_id: str, attempt: int,
         # tpu-lint: allow[exit-without-flush] hang self-destruct: ring was flushed at task claim; the driver should have killed us long ago
         os._exit(14)
     if rule.mode == "delay":
-        time.sleep(rule.seconds)
+        time.sleep(rule.arg(2.0))
+
+
+def conf_overrides(spec: str, worker_id: int, task_id: str,
+                   attempt: int) -> dict:
+    """Per-task conf rewrites for conf-carried chaos modes, applied by
+    the worker loop BEFORE the task builds its ExecCtx. ``oom_storm``
+    maps to ``spark.rapids.sql.test.injectRetryOOM.storm`` (arg =
+    injected-OOM count, default 2)."""
+    rule = find_rule(spec, worker_id, task_id, attempt, _CONF_MODES)
+    if rule is None:
+        return {}
+    return {"spark.rapids.sql.test.injectRetryOOM.storm":
+            str(max(1, int(rule.arg(2))))}
 
 
 def maybe_inject_output(spec: str, worker_id: int, task_id: str,
@@ -188,4 +270,4 @@ def maybe_inject_output(spec: str, worker_id: int, task_id: str,
                 f.write(bytes(b ^ 0xFF for b in chunk))
         elif rule.mode == "eio":
             with open(path + ".eio", "w") as f:
-                f.write(str(int(rule.seconds)))
+                f.write(str(int(rule.arg(2))))

@@ -55,7 +55,7 @@ _SCHED_EVENTS = _METRICS.counter(
     "Task scheduler lifecycle events by type: task_submitted / task_ok "
     "/ task_failed / attempt_lost / speculative_attempt / "
     "worker_respawn / worker_blacklisted / straggler_detected / "
-    "fetch_failed / stage_rerun.",
+    "fetch_failed / stage_rerun / query_cancelled.",
     ("event",))
 
 
@@ -131,12 +131,23 @@ class TaskScheduler:
     """
 
     def __init__(self, pool, tasks_dir: str, conf: RapidsConf,
-                 query_id: str = "q", tracer=NULL_TRACER):
+                 query_id: str = "q", tracer=NULL_TRACER, qctx=None):
         self.pool = pool
         self.tasks_dir = tasks_dir
         self.conf = conf
         self.query_id = query_id
         self.tracer = tracer
+        # query lifecycle (lifecycle.py): the poll loop checks the
+        # token/deadline every pass; on cancellation the driver
+        # publishes a rendezvous marker workers poll between batches,
+        # reaps in-flight attempts (bounded join), and raises the
+        # classified QueryCancelled
+        self._qctx = qctx
+        self._cancel_path = os.path.join(
+            tasks_dir, f"{query_id}.cancel")
+        self._cancel_published = False
+        from ..lifecycle import CANCEL_JOIN_TIMEOUT
+        self._cancel_join_s = conf.get(CANCEL_JOIN_TIMEOUT)
         self._stage_span_id: Optional[str] = None
         self.events: List[Dict] = []
         self.worker_failures: Dict[int, int] = {}
@@ -244,6 +255,18 @@ class TaskScheduler:
         }
 
     @staticmethod
+    def _read_qcancel(path: str) -> Optional[Dict]:
+        """The worker's structured ``.qcancel`` marker (written next
+        to its ``.err`` when the attempt stopped on a classified
+        QueryCancelled), or None for ordinary task errors."""
+        try:
+            with open(path + ".qcancel") as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+        return doc if isinstance(doc, dict) else None
+
+    @staticmethod
     def _read_fetchfail(path: str) -> Optional[Dict]:
         """The worker's structured ``.fetchfail`` marker (written next
         to its ``.err``), or None for ordinary task errors."""
@@ -321,6 +344,12 @@ class TaskScheduler:
         payload = dict(spec.payload)
         payload["task_id"] = spec.task_id
         payload["attempt"] = number
+        if self._qctx is not None:
+            # cancel marker path + wall deadline ride the pickle: the
+            # worker's token polls the marker between batches and
+            # honors the deadline locally even if the driver stalls
+            payload["lifecycle"] = self._qctx.worker_payload(
+                self._cancel_path)
         if self.tracer.enabled:
             # trace context rides the task pickle: the worker's spans
             # join the driver's trace under this attempt's span, and
@@ -337,6 +366,62 @@ class TaskScheduler:
         att = _Attempt(spec, number, worker, path)
         running.append(att)
         return att
+
+    # --- query lifecycle --------------------------------------------------
+
+    def _check_lifecycle(self, running: List[_Attempt]) -> None:
+        """One poll-loop pass of the lifecycle layer: enforce the
+        query deadline / observe an external cancel, and on
+        cancellation publish the marker, reap, and raise."""
+        q = self._qctx
+        if q is None or q.poll() is None:
+            return
+        self._cancel_and_reap(running)
+
+    def _cancel_and_reap(self, running: List[_Attempt]) -> None:
+        """The cancel fan-out: (1) atomically publish the rendezvous
+        ``<query>.cancel`` marker every in-flight worker token polls
+        between batches, (2) unlink unclaimed task files so no worker
+        starts a dead query's work, (3) bounded-join the claimed
+        attempts until they settle (.ok/.err) or the join timeout
+        passes, then raise the classified QueryCancelled. Worker-side
+        settlement runs the tasks' normal failure paths, so staged
+        shuffle attempts abort and ledger entries release."""
+        tok = self._qctx.token
+        if not self._cancel_published:
+            self._cancel_published = True
+            try:
+                with open(self._cancel_path + ".tmp", "w") as f:
+                    f.write(f"{tok.reason} {tok.detail}"[:600])
+                os.replace(self._cancel_path + ".tmp",
+                           self._cancel_path)
+            except OSError:
+                pass  # workers still stop via the deadline/err paths
+            self._event("query_cancelled",
+                        reason=f"[{tok.reason}] {tok.detail}")
+        for att in list(running):
+            if att.claim_ts is None \
+                    and not os.path.exists(att.path + ".claim"):
+                # never claimed: retract the task file entirely
+                try:
+                    os.unlink(att.path)
+                except OSError:
+                    pass
+                att.state = "lost"
+                running.remove(att)
+                self._close_attempt_span(att, "lost", "query cancelled")
+                self._event("attempt_lost", att.spec.task_id,
+                            att.number, att.worker, att.runtime,
+                            "query cancelled before claim")
+        deadline = time.monotonic() + max(0.0, self._cancel_join_s)
+        while time.monotonic() < deadline:
+            unsettled = [a for a in running
+                         if not os.path.exists(a.path + ".ok")
+                         and not os.path.exists(a.path + ".err")]
+            if not unsettled:
+                break
+            time.sleep(_POLL_S)  # tpu-lint: allow[blocking-call-in-thread] bounded reap join on the driver loop; ceiling is cancel.joinTimeout
+        raise tok.error()
 
     # --- stage loop -------------------------------------------------------
 
@@ -418,6 +503,7 @@ class TaskScheduler:
                                 for a in running)
 
         while outstanding():
+            self._check_lifecycle(running)
             if time.monotonic() > deadline:
                 pending = sorted({a.spec.task_id for a in running
                                   if a.spec.task_id not in done}
@@ -484,6 +570,22 @@ class TaskScheduler:
                     except OSError:
                         tb = "(unreadable .err)"
                     self._absorb_worker_spans(att)
+                    qc = self._read_qcancel(att.path)
+                    if qc is not None and self._qctx is not None:
+                        # the worker classified the stop itself (its
+                        # token saw the marker/deadline/budget first):
+                        # adopt the classification and take the cancel
+                        # path — never a retry, never a worker fault
+                        att.state = "err"
+                        running.remove(att)
+                        self._close_attempt_span(
+                            att, "cancelled", qc.get("reason", ""))
+                        from ..lifecycle import CANCEL_REASONS
+                        r = qc.get("reason")
+                        self._qctx.token.cancel(
+                            r if r in CANCEL_REASONS else "user",
+                            qc.get("detail", ""))
+                        self._cancel_and_reap(running)
                     ff = self._read_fetchfail(att.path)
                     if ff is not None and ff.get("map_task"):
                         # classified shuffle-read failure with a known
@@ -506,7 +608,11 @@ class TaskScheduler:
                             ff.get("shuffle_id", -1), ff["map_task"],
                             kind, ff.get("path", ""), att.spec.task_id,
                             att.number, att.worker, completed=set(done))
-                    fail_attempt(att, tb, worker_fault=True)
+                    # a worker that stopped itself on the query's own
+                    # cancel marker / deadline is healthy — don't let
+                    # cooperative cancellation feed the blacklist
+                    fail_attempt(att, tb,
+                                 worker_fault="QueryCancelled" not in tb)
                 elif att.claim_ts is not None \
                         and att.spec.task_id in done:
                     pass  # superseded: never kill a healthy worker (or

@@ -346,8 +346,12 @@ class DataFrame:
         from .planner import TpuOverrides
         return TpuOverrides(self._session.conf).apply(self._node)
 
-    def collect(self) -> pa.Table:
-        return self._plan().collect()
+    def collect(self, qctx=None) -> pa.Table:
+        """Execute and download. ``qctx`` (a lifecycle.QueryContext,
+        e.g. from ``session.query_context(deadline_s=5)``) carries the
+        cancellation token / deadline / tenant / memory budget; without
+        one the session conf's lifecycle defaults apply."""
+        return self._plan().collect(qctx=qctx)
 
     def count(self) -> int:
         return self.collect().num_rows
@@ -388,6 +392,16 @@ class TpuSession:
         with cross-worker folded per-operator metrics (None detaches —
         back to in-process execution)."""
         self._cluster = cluster
+
+    def query_context(self, **kw):
+        """A lifecycle.QueryContext over this session's conf —
+        deadline_s / tenant / budget_bytes / query_id overrides ride
+        the kwargs. Pass it to ``DataFrame.collect(qctx=...)`` (or
+        ``TpuProcessCluster.run_query``) to get a cancel handle:
+        ``qctx.cancel()`` stops the query cooperatively with
+        QueryCancelled(reason=user)."""
+        from .lifecycle import QueryContext
+        return QueryContext(self.conf, **kw)
 
     # --- SQL frontend -----------------------------------------------------
     def register_table(self, name: str, df: Union["DataFrame",

@@ -34,8 +34,8 @@ from typing import Dict, List, Optional
 import numpy as np
 import pyarrow as pa
 
-from ..config import (RapidsConf, SHUFFLE_COMPRESSION,
-                      SHUFFLE_FETCH_MAX_RETRIES,
+from ..config import (RapidsConf, SHUFFLE_CLOSE_JOIN_TIMEOUT,
+                      SHUFFLE_COMPRESSION, SHUFFLE_FETCH_MAX_RETRIES,
                       SHUFFLE_FETCH_RETRY_WAIT_MS, SHUFFLE_THREADS)
 from ..columnar.batch import TpuBatch
 from ..obs.metrics import REGISTRY as _METRICS
@@ -49,10 +49,6 @@ __all__ = ["HostShuffleTransport", "SHUF_PARTS_WRITTEN",
            "SHUF_FETCH_FAILURES"]
 
 _LOG = logging.getLogger(__name__)
-
-#: Bounded wait for outstanding writer futures in close(): a wedged
-#: codec thread must not hang process teardown forever.
-_CLOSE_JOIN_S = 10.0
 
 _IPC_CODECS = ("none", "lz4", "zstd")
 
@@ -552,8 +548,10 @@ class HostShuffleTransport(ShuffleTransport):
     def close(self):
         """Bounded teardown: a wedged writer thread (stuck codec /
         filesystem call) must not hang close() forever behind
-        ``shutdown(wait=True)`` — wait up to ``_CLOSE_JOIN_S`` for
-        outstanding writes, then abandon them with a log line."""
+        ``shutdown(wait=True)`` — wait up to
+        ``spark.rapids.shuffle.close.joinTimeout`` for outstanding
+        writes, then abandon them with a log line."""
+        join_s = self._conf.get(SHUFFLE_CLOSE_JOIN_TIMEOUT)
         if self._pool is not None:
             with self._lock:
                 futs = [f for fs in self._futures.values() for f in fs]
@@ -561,12 +559,12 @@ class HostShuffleTransport(ShuffleTransport):
             self._pool.shutdown(wait=False)
             if futs:
                 _, pending = concurrent.futures.wait(
-                    futs, timeout=_CLOSE_JOIN_S)
+                    futs, timeout=join_s)
                 if pending:
                     _LOG.warning(
                         "HostShuffleTransport.close: abandoning %d "
                         "outstanding shuffle write(s) still running "
-                        "after %.0fs", len(pending), _CLOSE_JOIN_S)
+                        "after %.0fs", len(pending), join_s)
                     # keep interpreter exit from joining the wedged
                     # threads too (the atexit hook would re-hang there)
                     try:

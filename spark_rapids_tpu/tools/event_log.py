@@ -23,8 +23,8 @@ import time
 from typing import Dict, Iterator, List, Optional
 
 __all__ = ["EVENT_LOG_DIR", "log_query_event", "log_scheduler_events",
-           "log_plan_rejected", "log_sql_error", "read_event_logs",
-           "plan_fingerprint"]
+           "log_plan_rejected", "log_sql_error", "log_query_cancelled",
+           "read_event_logs", "plan_fingerprint"]
 
 from ..config import register
 
@@ -154,6 +154,32 @@ def log_sql_error(conf, err, sql_text: str) -> None:
     event = dict(err.to_dict())
     event["ts"] = time.time()
     event["sql"] = sql_text[:4000]
+    with open(_app_path(base), "a") as f:
+        f.write(json.dumps(event) + "\n")
+    _prune_event_logs(conf, base)
+
+
+def log_query_cancelled(conf, err, wall_s: float,
+                        source: str = "plan",
+                        cluster: str = "local") -> None:
+    """Append one query_cancelled event: the lifecycle layer stopped
+    this query — classified (user | deadline | budget | admission),
+    mirroring ``plan_rejected`` as the "why didn't my query finish"
+    record. ``err`` is the QueryCancelled. No-op unless
+    spark.rapids.eventLog.dir is set."""
+    base = conf.get(EVENT_LOG_DIR)
+    if not base:
+        return
+    event = {
+        "type": "query_cancelled",
+        "ts": time.time(),
+        "query": getattr(err, "query_id", ""),
+        "reason": getattr(err, "reason", "user"),
+        "detail": getattr(err, "detail", "")[:500],
+        "wall_s": round(wall_s, 6),
+        "source": source,
+        "cluster": cluster,
+    }
     with open(_app_path(base), "a") as f:
         f.write(json.dumps(event) + "\n")
     _prune_event_logs(conf, base)

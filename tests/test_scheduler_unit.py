@@ -21,7 +21,10 @@ from spark_rapids_tpu.scheduler.chaos import (ChaosRule, find_rule,
 def test_chaos_parse_basic():
     rules = parse_fault_spec(
         "crash:q1s1m0:0; hang:*m1:*; delay:q2*:1:3.5; crash:t:0@w1")
-    assert rules[0] == ChaosRule("crash", "q1s1m0", 0, 2.0, None)
+    # no 4th field -> seconds None (an explicit ':2' must stay
+    # distinguishable from "no arg"); mode defaults apply via .arg()
+    assert rules[0] == ChaosRule("crash", "q1s1m0", 0, None, None)
+    assert rules[0].arg(2.0) == 2.0
     assert rules[1].attempt is None and rules[1].mode == "hang"
     assert rules[2].seconds == 3.5
     assert rules[3].worker == 1
@@ -30,8 +33,14 @@ def test_chaos_parse_basic():
 def test_chaos_parse_empty_and_bad():
     assert parse_fault_spec("") == []
     assert parse_fault_spec(None) == []
-    with pytest.raises(ValueError, match="bad injectFaults"):
+    # unknown mode: a hard parse error NAMING the mode and the valid
+    # set — never a silent no-op (a typo'd chaos spec that injects
+    # nothing would green-light the exact test it was meant to fail)
+    with pytest.raises(ValueError, match="unknown injectFaults mode "
+                                         "'explode'"):
         parse_fault_spec("explode:x:0")
+    with pytest.raises(ValueError, match="hang_query"):
+        parse_fault_spec("explode:x:0")  # valid modes are listed
     with pytest.raises(ValueError, match="bad injectFaults"):
         parse_fault_spec("crash:x")  # missing attempt
 
@@ -284,9 +293,15 @@ def test_worker_death_respawns_and_retries(tmp_path):
     state = {"killed": False}
 
     def script(tid, attempt, worker):
-        if tid == "t0" and attempt == 0 and not state["killed"]:
-            state["killed"] = True
-            pool.dead.add(worker)  # process "dies" mid-task
+        if tid == "t0" and attempt == 0:
+            # the dead incarnation must NEVER finish this attempt: a
+            # later rescan answering "ok" for attempt 0 raced the
+            # scheduler's liveness pass under load and made the stage
+            # complete respawn-free (flaky under a loaded full-suite
+            # run)
+            if not state["killed"]:
+                state["killed"] = True
+                pool.dead.add(worker)  # process "dies" mid-task
             return None
         return "ok"
 

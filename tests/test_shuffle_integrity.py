@@ -305,10 +305,12 @@ def test_drain_error_sticky_across_reads(tmp_path):
     t.close()
 
 
-def test_close_bounded_behind_wedged_writer(tmp_path, monkeypatch):
-    from spark_rapids_tpu.shuffle import host as host_mod
-    monkeypatch.setattr(host_mod, "_CLOSE_JOIN_S", 0.2)
-    t = _transport(tmp_path, threads=1)
+def test_close_bounded_behind_wedged_writer(tmp_path):
+    # the close() join bound is a registered conf now, not a module
+    # literal (spark.rapids.shuffle.close.joinTimeout)
+    t = HostShuffleTransport(
+        RapidsConf({"spark.rapids.shuffle.close.joinTimeout": "0.2"}),
+        threads=1, root=str(tmp_path / "shuffle"))
     t.register_shuffle(1, 1)
     release = []
     t._submit(1, lambda: [time.sleep(0.05)

@@ -210,7 +210,13 @@ def check_flight(path):
         for i, a in enumerate(doc["anomalies"]):
             if not a.get("kind"):
                 errors.append(f"anomaly {i}: no kind")
-            if not (a.get("task") or a.get("worker", -1) >= 0):
+            # query-scoped anomalies (the lifecycle layer / the plan
+            # verifier) name the query, not a task or worker
+            elif a["kind"] in ("query_cancelled", "plan_rejected"):
+                if not a.get("detail"):
+                    errors.append(f"anomaly {i}: query-scoped "
+                                  f"{a['kind']} carries no detail")
+            elif not (a.get("task") or a.get("worker", -1) >= 0):
                 errors.append(f"anomaly {i}: names neither task nor "
                               "worker")
     if not isinstance(doc["rings"], dict) or "driver" not in doc["rings"]:
@@ -277,6 +283,72 @@ def run_flight_smoke(out_dir):
         f"expected exactly one bundle, got {bundles}"
     report = triage_report(bundle)
     assert "what fired" in report and "HBM timeline" in report, report
+    return bundle
+
+
+def run_lifecycle_smoke(out_dir):
+    """ci_smoke step: a deadline-exceeded query under chaos
+    ``hang_query`` must yield exactly ONE classified query_cancelled
+    event-log line, ONE incident bundle carrying the anomaly — and a
+    post-cancel query on the SAME cluster must run green (no poisoned
+    state: no leaked admission slots, no stale cancel observed).
+    Returns the bundle path (validated by check_flight)."""
+    import pyarrow as pa
+
+    from spark_rapids_tpu.cluster import TpuProcessCluster
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.exec.base import HostBatchSourceExec
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.expr import Alias, UnresolvedColumn as col
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.lifecycle import QueryCancelled
+    from spark_rapids_tpu.memory import DeviceMemoryManager
+    from spark_rapids_tpu.shuffle.partitioner import HashPartitioning
+    from spark_rapids_tpu.tools.event_log import read_event_logs
+    flight_dir = os.path.join(out_dir, "incidents")
+    log_dir = os.path.join(out_dir, "events")
+    rbs = [pa.record_batch({"k": [i % 5 for i in range(n)],
+                            "v": list(range(n))})
+           for n in (300, 250)]
+    src = HostBatchSourceExec(rbs)
+    plan = TpuHashAggregateExec(
+        [col("k")], [Alias(Sum(col("v")), "s")],
+        TpuShuffleExchangeExec(HashPartitioning([col("k")], 4), src))
+    conf = RapidsConf({
+        "spark.rapids.query.deadline": "2.0",
+        "spark.rapids.tpu.test.injectFaults": "hang_query:q1r*:*:60",
+        "spark.rapids.flight.dir": flight_dir,
+        "spark.rapids.eventLog.dir": log_dir,
+    })
+    with TpuProcessCluster(n_workers=2, conf=conf) as c:
+        try:
+            c.run_query(plan)
+            raise AssertionError("hang_query deadline did not cancel")
+        except QueryCancelled as e:
+            assert e.reason == "deadline", e
+        bundle = c.last_incident_path
+        assert bundle, "no incident bundle from the cancelled query"
+        with open(bundle) as f:
+            doc = json.load(f)
+        kinds = [a["kind"] for a in doc["anomalies"]]
+        assert "query_cancelled" in kinds, kinds
+        # no poisoned state: the same cluster runs the query green
+        out = c.run_query(plan, conf=RapidsConf({}))
+        assert out.num_rows == 5, f"post-cancel query wrong: {out}"
+        snap = DeviceMemoryManager.shared(conf).admission.snapshot()
+        assert snap["in_use"] == 0 and not snap["queued"], snap
+    bundles = [n for n in os.listdir(flight_dir)
+               if n.startswith("incident-") and n.endswith(".json")]
+    assert bundles == [os.path.basename(bundle)], \
+        f"expected exactly one bundle, got {bundles}"
+    cancels = [e for e in read_event_logs(log_dir)
+               if e.get("type") == "query_cancelled"]
+    assert len(cancels) == 1, cancels
+    assert cancels[0]["reason"] == "deadline", cancels
+    print(f"lifecycle smoke OK: one classified cancel "
+          f"({cancels[0]['reason']}), one bundle, post-cancel query "
+          f"green")
     return bundle
 
 
@@ -735,6 +807,13 @@ def main(argv=None):
                     help="run a cluster shuffle query with injected "
                          "post-commit corruption, assert oracle rows "
                          "via exactly one map-stage rerun")
+    ap.add_argument("--lifecycle-smoke", metavar="DIR",
+                    dest="lifecycle_smoke",
+                    help="run a deadline-exceeded cluster query under "
+                         "chaos hang_query: exactly one classified "
+                         "query_cancelled event + one incident bundle, "
+                         "and a post-cancel query running green on the "
+                         "same cluster")
     ap.add_argument("--sql-smoke", metavar="DIR", dest="sql_smoke",
                     help="parse + compile + plan-verify the full SQL "
                          "corpus (zero parse failures / fallbacks) and "
@@ -779,6 +858,11 @@ def main(argv=None):
         bundle = run_shuffle_smoke(args.shuffle_smoke)
         flights.append(bundle)
         print(f"shuffle smoke output: {bundle}")
+    if args.lifecycle_smoke:
+        os.makedirs(args.lifecycle_smoke, exist_ok=True)
+        bundle = run_lifecycle_smoke(args.lifecycle_smoke)
+        flights.append(bundle)
+        print(f"lifecycle smoke output: {bundle}")
     ran_sql = False
     if args.sql_smoke:
         os.makedirs(args.sql_smoke, exist_ok=True)
@@ -794,8 +878,8 @@ def main(argv=None):
             and not args.lockwatch:
         ap.error("nothing to do: pass --trace/--prom/--smoke/"
                  "--scan-smoke/--flight/--flight-smoke/--shuffle-smoke/"
-                 "--sql-smoke/--profile/--analyze-smoke/--lint-report/"
-                 "--lockwatch")
+                 "--lifecycle-smoke/--sql-smoke/--profile/"
+                 "--analyze-smoke/--lint-report/--lockwatch")
     if args.lint_report:
         errors += [f"[lint] {e}"
                    for e in check_lint_report(args.lint_report)]
