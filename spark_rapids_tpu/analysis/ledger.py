@@ -39,6 +39,20 @@ as **allocators**; at their call sites the rule is deliberately weaker
 — flagged only when no path releases the result at all — because
 conditional-ownership protocols (``rsb, owned = ...``) are
 path-insensitive noise otherwise.
+
+Checked and deliberately NOT covered (PR 12 satellite): ``_charge``-
+style paired side effects — ``mgr._charge(sb, n)`` in
+``SpillableBatch.get`` must be undone by ``mgr._uncharge`` only on
+the exception edges *before* the re-upload commits. The token
+machinery here tracks the escape of a **value** carrying a release
+obligation; a charge is an anonymous counter mutation whose
+obligation is conditional on reaching a commit point — a
+path-sensitive bracket protocol. Modelling it in this frozenset
+lattice would either flag every legitimate charge-outlives-function
+use (charges outliving ``get()`` is the point) or need the
+success-flag path sensitivity the engine deliberately avoids (see the
+allocator note above). The invariant is guarded dynamically instead:
+``tests/test_memory.py::test_get_charge_unwind_on_failed_reupload``.
 """
 from __future__ import annotations
 

@@ -118,6 +118,13 @@ LOCK_HIERARCHY: Tuple[LockLevel, ...] = (
               ("memory.py", "SpillableBatch", None),
               "per-batch tier transitions; acquires the ledger lock "
               "inside (eviction paths only ever TRY-acquire it)"),
+    LockLevel("*memory.py::_SWEEP_LOCK", 45,
+              ("memory.py", None, "<module>"),
+              "orphan-spill-sweep once-per-root guard: held only "
+              "around the swept-roots set check (the sweep's IO runs "
+              "outside it); acquired during manager construction, so "
+              "it sits above the manager-cache lock (15) and below "
+              "the ledger"),
     LockLevel("DeviceMemoryManager._lock", 50,
               ("memory.py", "DeviceMemoryManager", "__init__"),
               "the byte ledger + catalog; leaf-ish: nothing below it "

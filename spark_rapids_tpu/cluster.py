@@ -54,6 +54,7 @@ from .config import (FLIGHT_ENABLED, FLIGHT_STRAGGLER_FACTOR,
                      SHUFFLE_MAX_STAGE_RETRIES)
 from .exec.base import ExecCtx, LeafExec, TpuExec
 from .lifecycle import QueryCancelled as _QueryCancelled
+from .memory import SpillReadError as _SpillReadError
 from .obs.metrics import (METRICS_ENABLED, REGISTRY,
                           flush_worker_metrics, maybe_start_http_server,
                           read_worker_metrics, render_merged_snapshots)
@@ -341,6 +342,17 @@ def _flush_task_obs(root: str, worker_id: int, task_path: str, tracer,
         pass
 
 
+def _write_marker(path: str, suffix: str, doc: Dict) -> None:
+    """Commit a structured classification marker (``.qcancel`` /
+    ``.spillfail`` / ``.fetchfail``) next to a task's ``.err`` via
+    tmp+rename, so the driver never reads a torn marker
+    (`TaskScheduler._read_marker` is the consumer)."""
+    tmp = f"{path}.{suffix}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, f"{path}.{suffix}")
+
+
 class _Heartbeat:
     """Worker-side liveness beacon: a daemon thread rewriting
     ``heartbeats/w<K>.hb`` every ``interval`` seconds. The driver treats
@@ -523,25 +535,29 @@ def worker_main(root: str, worker_id: int, poll_s: float = 0.02,
                     # structured marker BEFORE the .err, so the driver
                     # escalates to the classified cancel path instead
                     # of burning retries on a dead query
-                    with open(path + ".qcancel.tmp", "w") as f:
-                        json.dump({"reason": exc.reason,
-                                   "detail": (exc.detail or "")[:400]},
-                                  f)
-                    os.replace(path + ".qcancel.tmp", path + ".qcancel")
+                    _write_marker(path, "qcancel",
+                                  {"reason": exc.reason,
+                                   "detail": (exc.detail or "")[:400]})
+                if isinstance(exc, _SpillReadError):
+                    # classified spill-tier data loss: a structured
+                    # marker BEFORE the .err, so the scheduler retries
+                    # the task (re-execution regenerates what the disk
+                    # lost) WITHOUT blaming this worker — bit rot on a
+                    # spill file is not a process fault
+                    _write_marker(path, "spillfail",
+                                  {"kind": exc.kind, "path": exc.path,
+                                   "detail": (exc.detail or "")[:500]})
                 if isinstance(exc, FetchFailure):
                     # structured marker BEFORE the .err it accompanies:
                     # when the driver harvests the .err, the
                     # classification is already on disk and the failure
                     # escalates to lineage recovery instead of burning
                     # a retry against the same bad bytes
-                    with open(path + ".fetchfail.tmp", "w") as f:
-                        json.dump({"shuffle_id": exc.shuffle_id,
+                    _write_marker(path, "fetchfail",
+                                  {"shuffle_id": exc.shuffle_id,
                                    "map_task": exc.map_task,
                                    "path": exc.path, "kind": exc.kind,
-                                   "detail": (exc.detail or "")[:500]},
-                                  f)
-                    os.replace(path + ".fetchfail.tmp",
-                               path + ".fetchfail")
+                                   "detail": (exc.detail or "")[:500]})
                 with open(err + ".tmp", "w") as f:
                     f.write(tb)
                 os.replace(err + ".tmp", err)
@@ -739,6 +755,18 @@ class TpuProcessCluster:
         # ring records scheduler/shuffle/memory events passively; an
         # anomaly turns it into an incident bundle at query end
         RECORDER.configure(self.conf)
+        # spill-tier orphan GC at boot (forced: this driver process may
+        # already have swept for an earlier cluster/manager): namespaces
+        # whose owner pid is dead — a previous crashed run's spill
+        # files — are reclaimed instead of leaking disk forever
+        try:
+            from .config import DISK_ORPHAN_TTL, SPILL_DIR
+            from .memory import sweep_orphan_spill_dirs
+            sweep_orphan_spill_dirs(self.conf.get(SPILL_DIR),
+                                    self.conf.get(DISK_ORPHAN_TTL),
+                                    force=True)
+        except Exception:  # noqa: BLE001 — GC must never fail boot
+            pass
 
     def shutdown(self) -> None:
         self.pool.shutdown()

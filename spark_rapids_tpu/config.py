@@ -139,7 +139,37 @@ HOST_SPILL_LIMIT = register(
     "falling through to disk.", conv=_bytes_conv)
 SPILL_DIR = register(
     "spark.rapids.memory.spillDir", "/tmp/rapids_tpu_spill",
-    "Directory for disk-tier spill files.")
+    "Base directory for disk-tier spill files. Each process spills "
+    "under its own incarnation namespace "
+    "<host>-<pid>-<incarnation>/ so crashed processes' files are "
+    "attributable and reclaimable (see memory.sweep_orphan_spill_dirs).")
+DISK_SPILL_LIMIT = register(
+    "spark.rapids.memory.disk.limit", 0,
+    "Byte budget for LIVE disk-tier spill residency (0 = unlimited). "
+    "A spill that would breach it first evicts the oldest unpinned "
+    "disk entries back to the host tier; if the budget still cannot "
+    "fit the write, the batch stays host-resident and the breach is "
+    "classified as disk pressure (metric + event log + flight "
+    "recorder) instead of failing the caller's eviction cascade.",
+    conv=_bytes_conv)
+DISK_READ_RETRIES = register(
+    "spark.rapids.memory.disk.readRetries", 3,
+    "Transient (EIO-class) spill-file read failures are retried in "
+    "place this many times with exponential backoff before the read "
+    "escalates a classified SpillReadError(kind=io). Missing, corrupt "
+    "and torn spill files are never retried in place — rereading bad "
+    "bytes cannot fix them.")
+DISK_READ_RETRY_WAIT_MS = register(
+    "spark.rapids.memory.disk.readRetryWaitMs", 50,
+    "Base wait between in-place spill read retries, doubling per "
+    "retry.", conv=_to_float)
+DISK_ORPHAN_TTL = register(
+    "spark.rapids.memory.disk.orphanTTL", 86400.0,
+    "Age bound (seconds) for the orphan-spill sweep's fallback: an "
+    "incarnation spill directory whose owner pid cannot be proven "
+    "dead (a different host on a shared filesystem) is reclaimed only "
+    "once it is at least this old. Same-host directories with a dead "
+    "owner pid are reclaimed immediately at manager/cluster startup.")
 OOM_RETRY_ENABLED = register(
     "spark.rapids.sql.oomRetry.enabled", True,
     "Enable the task-level retry/split-and-retry framework on device OOM.")
@@ -384,12 +414,14 @@ INJECT_FAULTS = register(
     "Testing: deterministic fault injection in cluster workers. "
     "Semicolon-separated rules 'mode:task_glob:attempt[:arg]' with "
     "mode crash | hang | delay | corrupt | drop | eio (process/"
-    "shuffle-durability faults) or hang_query | oom_storm | "
+    "shuffle-durability faults), hang_query | oom_storm | "
     "slow_admission (query-scoped lifecycle faults; slow_admission "
     "matches the QUERY id and is applied by the driver's admission "
-    "controller), task_glob an fnmatch pattern over task ids (e.g. "
-    "'q1s1m0'), attempt an int or '*'. Unknown modes are a hard parse "
-    "error, never a silent no-op. See scheduler/chaos.py.",
+    "controller), or spill_corrupt | spill_torn | disk_full | "
+    "slow_disk (spill-tier durability faults, applied by the task's "
+    "memory manager), task_glob an fnmatch pattern over task ids "
+    "(e.g. 'q1s1m0'), attempt an int or '*'. Unknown modes are a "
+    "hard parse error, never a silent no-op. See scheduler/chaos.py.",
     internal=True)
 
 # --- Flight recorder ------------------------------------------------------
@@ -456,6 +488,22 @@ TEST_RETRY_OOM_STORM = register(
     "device OOM (0 = disabled) — the sustained-pressure injection the "
     "degradation ladder is walked with; chaos mode 'oom_storm' sets "
     "it per cluster task.", internal=True)
+TEST_SPILL_FAULT = register(
+    "spark.rapids.memory.test.injectSpillFault", "",
+    "Testing: damage every committed spill file this manager writes — "
+    "'corrupt' flips payload bytes (only the CRC can catch it), "
+    "'torn' truncates the trailer. Set per cluster task by chaos "
+    "modes 'spill_corrupt' / 'spill_torn'.", internal=True)
+TEST_DISK_FULL = register(
+    "spark.rapids.memory.test.injectDiskFull", 0,
+    "Testing: the FIRST N disk-spill writes raise ENOSPC mid-write "
+    "(0 = disabled) — the full-disk rehearsal; chaos mode 'disk_full' "
+    "sets it per cluster task.", internal=True)
+TEST_SLOW_DISK = register(
+    "spark.rapids.memory.test.injectSlowDisk", 0.0,
+    "Testing: sleep this many seconds before every disk-spill write "
+    "and read (0 = disabled) — the degraded-disk rehearsal; chaos "
+    "mode 'slow_disk' sets it per cluster task.", internal=True)
 
 
 class RapidsConf:

@@ -191,7 +191,15 @@ class TpuSortExec(UnaryExec):
         spillable batch, n-way merge'), the TPU-idiomatic way:
 
         1. sort each batch on device, register it spillable, spill to host
-           Arrow (the runs);
+           Arrow (the runs). Runs ride :class:`SpillableBatch`, so a run
+           the host tier cascades to disk lands as a SEALED file
+           (CRC32C+length trailer, tmp+rename commit —
+           shuffle/integrity.py) under the process's incarnation spill
+           namespace, and its read-back is verified: a run the disk
+           lost or rotted raises a classified
+           :class:`~..memory.SpillReadError` through the task path
+           (scheduler retries the task; the reading worker is never
+           blamed) instead of feeding garbage into the merge;
         2. chunked k-way merge: per round, pull the next chunk of every
            live run host->device, concat with the carry, sort, and emit
            the prefix whose key tuples are <= the lexicographic MIN over
@@ -199,6 +207,12 @@ class TpuSortExec(UnaryExec):
            after run i's boundary, so that prefix is globally final);
            the remainder becomes the carry (a lazy selection view — no
            copy). Memory high-water: carry + k chunks, not the dataset.
+           A run is released the moment its last chunk is pulled, so
+           host-tier spill residency DRAINS as the merge progresses
+           instead of ballooning until query end. (Disk residency for
+           a run drains earlier, at the verified ``get_host``
+           read-back that precedes the merge — the read-back unlinks
+           the sealed file and walks the live disk gauge down.)
         """
         import numpy as np
         from ..columnar.arrow_bridge import arrow_to_device
@@ -278,6 +292,15 @@ class TpuSortExec(UnaryExec):
                     # an exhausted run imposes no boundary
                     boundary_valid.append(cursors[i] < rows[i])
                     base += take
+                    if cursors[i] >= rows[i]:
+                        # last chunk pulled (and already on device):
+                        # drop the run's catalog entry NOW so its
+                        # host-tier residency drains mid-merge (disk
+                        # already drained at the get_host read-back)
+                        hosts[i] = None
+                        if runs[i] is not None:
+                            runs[i].release()
+                            runs[i] = None
                 merged = concat_batches(parts)
                 if not any(boundary_valid):
                     # every run exhausted: the whole merge is final
@@ -304,9 +327,11 @@ class TpuSortExec(UnaryExec):
             # forever (host-tier bytes stay charged, the catalog
             # grows per query). tpu-lint 2.0 flagged the exception
             # window between register and append; the happy path
-            # never released them either [ledger-leak-path]
+            # never released them either [ledger-leak-path]. Runs the
+            # merge already drained were released in place (None).
             for sp in runs:
-                sp.release()
+                if sp is not None:
+                    sp.release()
 
     def execute_cpu(self, ctx: ExecCtx):
         rbs = list(self.child.execute_cpu(ctx))

@@ -380,15 +380,19 @@ class DegradationLadder:
             QUERY_DEGRADED.labels("halve").inc()
         self.counts["halve"] = self.counts.get("halve", 0) + 1
 
-    def escalate(self) -> str:
+    def escalate(self, cause: str = "oom") -> str:
         """Enter the next rung above halving and return its name
-        (sticky at ``cpu``)."""
+        (sticky at ``cpu``). ``cause`` names WHY the walk climbs —
+        ``oom`` for device pressure, ``disk_pressure`` when the spill
+        tier itself has nowhere to go (full disk / disk budget) — and
+        rides the flight-recorder evidence so triage can tell a
+        compute-bound query from one starved of spill room."""
         self._idx = min(self._idx + 1, len(LADDER_RUNGS) - 1)
         rung = LADDER_RUNGS[self._idx]
         self.counts[rung] = self.counts.get(rung, 0) + 1
         QUERY_DEGRADED.labels(rung).inc()
         _FLIGHT.record("lifecycle", ev="degrade", rung=rung,
-                       query=self._qctx.query_id)
+                       cause=cause, query=self._qctx.query_id)
         return rung
 
 

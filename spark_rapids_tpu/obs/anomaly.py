@@ -46,8 +46,12 @@ __all__ = ["AnomalyDetector", "anomalies_from_scheduler",
 # bundle is how triage answers "why did this query never start".
 # query_cancelled: the lifecycle layer stopped the query (user /
 # deadline / budget / admission) — classified in the event's reason.
+# spill_read_failed: a committed spill file failed its verified
+# read-back (missing/corrupt/torn/io) and the task re-ran — the
+# spill-tier mirror of fetch_failed.
 _SCHED_ANOMALIES = ("task_failed", "worker_respawn", "worker_blacklisted",
-                    "straggler_detected", "fetch_failed", "stage_rerun",
+                    "straggler_detected", "fetch_failed",
+                    "spill_read_failed", "stage_rerun",
                     "plan_rejected", "query_cancelled")
 
 
@@ -65,6 +69,23 @@ class AnomalyDetector:
         if failed:
             return ("task_failure", error.strip().splitlines()[-1][:200]
                     if error else "task raised")
+        pressure = [e for e in events if e.get("kind") == "mem"
+                    and e.get("ev") == "disk_pressure"]
+        if pressure:
+            return ("disk_pressure",
+                    f"{len(pressure)} refused disk-spill write"
+                    f"{'' if len(pressure) == 1 else 's'} "
+                    f"([{pressure[-1].get('fail_kind', '?')}]) — "
+                    "batches stayed host-resident")
+        spill_fail = [e for e in events if e.get("kind") == "mem"
+                      and e.get("ev") in ("spill_read_failed",
+                                          "spill_write_failed")]
+        if spill_fail:
+            e = spill_fail[-1]
+            return ("spill_failure",
+                    f"{len(spill_fail)} spill-tier failure"
+                    f"{'' if len(spill_fail) == 1 else 's'} "
+                    f"(last: {e.get('ev')} [{e.get('fail_kind', '?')}])")
         ooms = sum(1 for e in events
                    if e.get("kind") == "mem" and e.get("ev") == "oom_retry")
         if ooms:

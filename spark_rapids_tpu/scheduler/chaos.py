@@ -31,6 +31,8 @@ Grammar (whitespace-insensitive)::
     rule    := mode ':' task_glob ':' attempt [':' arg] ['@w' worker]
     mode    := 'crash' | 'hang' | 'delay' | 'corrupt' | 'drop' | 'eio'
              | 'hang_query' | 'oom_storm' | 'slow_admission'
+             | 'spill_corrupt' | 'spill_torn' | 'disk_full'
+             | 'slow_disk'
     attempt := int | '*'
 
 - ``crash``   — the worker process exits immediately (``os._exit``),
@@ -74,6 +76,23 @@ Query-scoped modes (the lifecycle layer's chaos surface)::
   (``spark.rapids.query.admission.timeout`` →
   QueryCancelled(reason=admission)).
 
+Spill-tier durability modes (conf-carried like ``oom_storm``; the
+task's DeviceMemoryManager applies them — memory.py)::
+
+- ``spill_corrupt`` / ``spill_torn`` — every spill file the task's
+  manager commits is damaged post-commit (payload bytes flipped /
+  trailer truncated): the verified read-back must classify the loss
+  (``SpillReadError(kind=corrupt|torn)``) and the scheduler must
+  retry the task WITHOUT blacklisting the reading worker.
+- ``disk_full`` — the task's first ``arg`` (default 2) disk-spill
+  writes raise ENOSPC mid-write
+  (``spark.rapids.memory.test.injectDiskFull``): partial files must
+  be cleaned, the batch must stay host-resident, and the pressure
+  must surface classified (never a raw OSError out of an eviction
+  cascade).
+- ``slow_disk`` — every disk-spill write and read sleeps ``arg``
+  seconds (default 0.05): the degraded-disk / straggling-spill path.
+
 Examples::
 
     crash:q1s1m0:0            # kill the worker running map task 0,
@@ -88,6 +107,10 @@ Examples::
     oom_storm:q1s1m0:0:6      # six injected OOMs at the start of the
                               # map task's retry scopes
     slow_admission:q2:0:3     # query q2 waits 3s for admission
+    spill_corrupt:q1r0:0      # every spill file attempt 0 of the
+                              # final task writes is rotten on read
+    disk_full:q1r*:*:3        # final-stage tasks' first 3 disk-spill
+                              # writes hit ENOSPC
 """
 from __future__ import annotations
 
@@ -104,9 +127,11 @@ __all__ = ["ChaosRule", "parse_fault_spec", "find_rule", "maybe_inject",
 _PRE_MODES = ("crash", "hang", "delay", "hang_query")
 _POST_MODES = ("corrupt", "drop", "eio")
 #: query-scoped modes resolved OUTSIDE the worker pre/post hooks:
-#: oom_storm rewrites the task's conf (conf_overrides);
-#: slow_admission is consumed by the driver's admission controller
-_CONF_MODES = ("oom_storm",)
+#: oom_storm and the spill-tier modes rewrite the task's conf
+#: (conf_overrides); slow_admission is consumed by the driver's
+#: admission controller
+_CONF_MODES = ("oom_storm", "spill_corrupt", "spill_torn", "disk_full",
+               "slow_disk")
 _DRIVER_MODES = ("slow_admission",)
 _MODES = _PRE_MODES + _POST_MODES + _CONF_MODES + _DRIVER_MODES
 
@@ -226,12 +251,44 @@ def conf_overrides(spec: str, worker_id: int, task_id: str,
     """Per-task conf rewrites for conf-carried chaos modes, applied by
     the worker loop BEFORE the task builds its ExecCtx. ``oom_storm``
     maps to ``spark.rapids.sql.test.injectRetryOOM.storm`` (arg =
-    injected-OOM count, default 2)."""
-    rule = find_rule(spec, worker_id, task_id, attempt, _CONF_MODES)
-    if rule is None:
-        return {}
-    return {"spark.rapids.sql.test.injectRetryOOM.storm":
-            str(max(1, int(rule.arg(2))))}
+    injected-OOM count, default 2); the spill-tier modes map to the
+    ``spark.rapids.memory.test.*`` injections the task's
+    DeviceMemoryManager applies. Different modes compose (first
+    matching rule per mode wins), so ``disk_full`` + ``slow_disk``
+    can hit the same task — EXCEPT ``spill_corrupt`` + ``spill_torn``,
+    which share the one injectSpillFault channel a manager has: both
+    matching one (task, attempt) is a contradictory spec, and per the
+    never-a-silent-no-op rule it is a named hard error rather than
+    whichever rule happened to parse first."""
+    out: dict = {}
+    spill_fault_mode = None
+    for rule in parse_fault_spec(spec):
+        if rule.mode not in _CONF_MODES \
+                or not rule.matches(worker_id, task_id, attempt):
+            continue
+        if rule.mode == "oom_storm":
+            out.setdefault("spark.rapids.sql.test.injectRetryOOM.storm",
+                           str(max(1, int(rule.arg(2)))))
+        elif rule.mode in ("spill_corrupt", "spill_torn"):
+            fault = "corrupt" if rule.mode == "spill_corrupt" else "torn"
+            if spill_fault_mode is not None \
+                    and spill_fault_mode != rule.mode:
+                raise ValueError(
+                    f"injectFaults modes {spill_fault_mode!r} and "
+                    f"{rule.mode!r} both match task {task_id!r} "
+                    f"attempt {attempt}: they share one spill-fault "
+                    "injection channel and cannot compose on the same "
+                    "task")
+            spill_fault_mode = rule.mode
+            out.setdefault("spark.rapids.memory.test.injectSpillFault",
+                           fault)
+        elif rule.mode == "disk_full":
+            out.setdefault("spark.rapids.memory.test.injectDiskFull",
+                           str(max(1, int(rule.arg(2)))))
+        elif rule.mode == "slow_disk":
+            out.setdefault("spark.rapids.memory.test.injectSlowDisk",
+                           str(rule.arg(0.05)))
+    return out
 
 
 def maybe_inject_output(spec: str, worker_id: int, task_id: str,

@@ -55,7 +55,8 @@ _SCHED_EVENTS = _METRICS.counter(
     "Task scheduler lifecycle events by type: task_submitted / task_ok "
     "/ task_failed / attempt_lost / speculative_attempt / "
     "worker_respawn / worker_blacklisted / straggler_detected / "
-    "fetch_failed / stage_rerun / query_cancelled.",
+    "fetch_failed / spill_read_failed / stage_rerun / "
+    "query_cancelled.",
     ("event",))
 
 
@@ -250,28 +251,19 @@ class TaskScheduler:
             "workers_respawned": c.get("worker_respawn", 0),
             "workers_blacklisted": len(self.blacklist),
             "fetch_failures": c.get("fetch_failed", 0),
+            "spill_read_failures": c.get("spill_read_failed", 0),
             "stage_reruns": c.get("stage_rerun", 0),
             "retry_overhead_s": round(overhead, 6),
         }
 
     @staticmethod
-    def _read_qcancel(path: str) -> Optional[Dict]:
-        """The worker's structured ``.qcancel`` marker (written next
-        to its ``.err`` when the attempt stopped on a classified
-        QueryCancelled), or None for ordinary task errors."""
+    def _read_marker(path: str, suffix: str) -> Optional[Dict]:
+        """A worker's structured classification marker (``.qcancel`` /
+        ``.fetchfail`` / ``.spillfail``, written tmp+rename next to its
+        ``.err`` BEFORE the .err commits), or None for ordinary task
+        errors."""
         try:
-            with open(path + ".qcancel") as f:
-                doc = json.load(f)
-        except (OSError, json.JSONDecodeError):
-            return None
-        return doc if isinstance(doc, dict) else None
-
-    @staticmethod
-    def _read_fetchfail(path: str) -> Optional[Dict]:
-        """The worker's structured ``.fetchfail`` marker (written next
-        to its ``.err``), or None for ordinary task errors."""
-        try:
-            with open(path + ".fetchfail") as f:
+            with open(path + "." + suffix) as f:
                 doc = json.load(f)
         except (OSError, json.JSONDecodeError):
             return None
@@ -570,7 +562,7 @@ class TaskScheduler:
                     except OSError:
                         tb = "(unreadable .err)"
                     self._absorb_worker_spans(att)
-                    qc = self._read_qcancel(att.path)
+                    qc = self._read_marker(att.path, "qcancel")
                     if qc is not None and self._qctx is not None:
                         # the worker classified the stop itself (its
                         # token saw the marker/deadline/budget first):
@@ -586,7 +578,7 @@ class TaskScheduler:
                             r if r in CANCEL_REASONS else "user",
                             qc.get("detail", ""))
                         self._cancel_and_reap(running)
-                    ff = self._read_fetchfail(att.path)
+                    ff = self._read_marker(att.path, "fetchfail")
                     if ff is not None and ff.get("map_task"):
                         # classified shuffle-read failure with a known
                         # producer: escalate to lineage recovery
@@ -608,6 +600,25 @@ class TaskScheduler:
                             ff.get("shuffle_id", -1), ff["map_task"],
                             kind, ff.get("path", ""), att.spec.task_id,
                             att.number, att.worker, completed=set(done))
+                    sf = self._read_marker(att.path, "spillfail")
+                    if sf is not None:
+                        # classified spill-tier loss (SpillReadError):
+                        # the task retries normally — re-execution
+                        # regenerates the data the disk lost — but the
+                        # worker is NEVER blamed: a corrupt/torn/
+                        # missing spill file is bit rot or disk churn,
+                        # not a process fault, and blacklisting the
+                        # reader would punish the only machine that
+                        # noticed
+                        kind = sf.get("kind", "io")
+                        reason = (f"[spill {kind}] "
+                                  f"{os.path.basename(sf.get('path') or '')}"
+                                  f": {(sf.get('detail') or '')[:200]}")
+                        self._event("spill_read_failed",
+                                    att.spec.task_id, att.number,
+                                    att.worker, att.runtime, reason)
+                        fail_attempt(att, reason, worker_fault=False)
+                        continue
                     # a worker that stopped itself on the query's own
                     # cancel marker / deadline is healthy — don't let
                     # cooperative cancellation feed the blacklist

@@ -43,8 +43,9 @@ from typing import Dict, List, Optional, Tuple
 from .transport import FetchFailure
 
 __all__ = ["FOOTER_LEN", "MANIFEST_NAME", "crc32c", "write_block",
-           "write_manifest", "read_manifest", "verify_payload",
-           "read_block", "expected_partition_files",
+           "footer_bytes", "write_sealed_file", "verify_sealed",
+           "read_sealed_file", "write_manifest", "read_manifest",
+           "verify_payload", "read_block", "expected_partition_files",
            "expected_partition_index"]
 
 _FOOTER_MAGIC = b"RSF1"
@@ -69,13 +70,50 @@ except ImportError:  # pragma: no cover - environment-dependent
 
 # --- write side --------------------------------------------------------------
 
+def footer_bytes(payload, crc: Optional[int] = None) -> bytes:
+    """The 16-byte integrity trailer for ``payload`` — the one sealed
+    format shuffle blocks and spill files share."""
+    if crc is None:
+        crc = crc32c(payload)
+    return struct.pack("<QI4s", len(payload), crc, _FOOTER_MAGIC)
+
+
 def write_block(path: str, payload: bytes) -> Tuple[int, int]:
     """Write ``payload`` plus the integrity footer; returns the file's
     total size and the payload CRC (the manifest entry)."""
     crc = crc32c(payload)
     with open(path, "wb") as f:
         f.write(payload)
-        f.write(struct.pack("<QI4s", len(payload), crc, _FOOTER_MAGIC))
+        f.write(footer_bytes(payload, crc))
+    return len(payload) + FOOTER_LEN, crc
+
+
+def write_sealed_file(path: str, payload, fail_hook=None) -> Tuple[int, int]:
+    """Crash-safe sealed write: ``payload`` + footer land in
+    ``<path>.tmp`` and are published with ONE ``os.replace``, so a
+    reader can never observe a half-written file under ``path`` — it
+    either sees the previous content (or nothing) or the complete
+    sealed file. Any failure (ENOSPC included) unlinks the partial tmp
+    before propagating: a crashed or rejected write must not leak an
+    unreferenced file onto the very disk that just ran out of space.
+    Returns (total file size, payload crc). ``fail_hook``, if given,
+    runs after the payload bytes are written and before the commit —
+    the deterministic mid-write failure-injection seam (chaos
+    ``disk_full``)."""
+    crc = crc32c(payload)
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            if fail_hook is not None:
+                fail_hook()
+            f.write(footer_bytes(payload, crc))
+        os.replace(tmp, path)
+    except BaseException:
+        import contextlib
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
     return len(payload) + FOOTER_LEN, crc
 
 
@@ -115,32 +153,45 @@ def read_manifest(mapout_dir: str, shuffle_id: int = -1) -> Optional[Dict]:
 
 # --- read side ---------------------------------------------------------------
 
-def verify_payload(data: bytes, path: str, shuffle_id: int = -1,
-                   map_task=None, expected_crc: Optional[int] = None):
-    """Strip + check the footer; the Arrow IPC payload (a zero-copy
-    memoryview over ``data``) on success. ``expected_crc`` is the
-    manifest's record — compared against the footer field BEFORE the
-    (single) payload scan, so a healthy block pays exactly one CRC
+def verify_sealed(data: bytes, make_error,
+                  expected_crc: Optional[int] = None):
+    """Strip + check the footer of one sealed file; the payload (a
+    zero-copy memoryview over ``data``) on success. ``make_error`` is
+    the caller's classification factory ``(kind, detail) -> Exception``
+    (``kind in (torn, corrupt)`` here) — the ONE verification pass the
+    shuffle and spill tiers share. ``expected_crc`` (the manifest's
+    record, shuffle-side) is compared against the footer field BEFORE
+    the (single) payload scan, so a healthy block pays exactly one CRC
     pass."""
     if len(data) < FOOTER_LEN or data[-4:] != _FOOTER_MAGIC:
-        raise FetchFailure(shuffle_id, map_task, path, "torn",
-                           f"bad footer (file is {len(data)} bytes)")
+        raise make_error("torn", f"bad footer (file is {len(data)} bytes)")
     plen, crc = struct.unpack("<QI", data[-FOOTER_LEN:-4])
     if plen != len(data) - FOOTER_LEN:
-        raise FetchFailure(
-            shuffle_id, map_task, path, "torn",
+        raise make_error(
+            "torn",
             f"footer claims {plen} payload bytes, file holds "
             f"{len(data) - FOOTER_LEN}")
     if expected_crc is not None and expected_crc != crc:
-        raise FetchFailure(shuffle_id, map_task, path, "corrupt",
-                           f"footer crc {crc:#010x} != manifest "
-                           f"{expected_crc:#010x}")
+        raise make_error("corrupt",
+                         f"footer crc {crc:#010x} != manifest "
+                         f"{expected_crc:#010x}")
     payload = memoryview(data)[:-FOOTER_LEN]
     got = crc32c(payload)
     if got != crc:
-        raise FetchFailure(shuffle_id, map_task, path, "corrupt",
-                           f"crc {got:#010x} != footer {crc:#010x}")
+        raise make_error("corrupt",
+                         f"crc {got:#010x} != footer {crc:#010x}")
     return payload
+
+
+def verify_payload(data: bytes, path: str, shuffle_id: int = -1,
+                   map_task=None, expected_crc: Optional[int] = None):
+    """Shuffle-flavored :func:`verify_sealed`: failures classify as
+    :class:`~.transport.FetchFailure`."""
+    return verify_sealed(
+        data,
+        lambda kind, detail: FetchFailure(shuffle_id, map_task, path,
+                                          kind, detail),
+        expected_crc=expected_crc)
 
 
 def _maybe_inject_eio(path: str) -> None:
@@ -161,23 +212,28 @@ def _maybe_inject_eio(path: str) -> None:
     raise OSError(errno.EIO, f"injected EIO ({left - 1} left)", path)
 
 
-def read_block(path: str, meta: Optional[Dict] = None, *,
-               shuffle_id: int = -1, map_task=None,
-               max_retries: int = 3, retry_wait_s: float = 0.05,
-               on_retry=None):
-    """Read + verify one shuffle block (returns the Arrow IPC payload
-    as a zero-copy memoryview), classifying every failure:
+def read_sealed_file(path: str, make_error, *,
+                     expected_size: Optional[int] = None,
+                     expected_crc: Optional[int] = None,
+                     max_retries: int = 0, retry_wait_s: float = 0.05,
+                     on_retry=None,
+                     missing_detail: str = "sealed file is gone"):
+    """Read + verify one sealed file (returns the payload as a
+    zero-copy memoryview), classifying every failure through
+    ``make_error(kind, detail)``:
 
     - the file is gone                      -> ``missing`` (no retry:
-      commit made it durable once; absence is loss, not latency)
-    - footer truncated/malformed            -> ``torn``
-    - CRC mismatch (vs footer, or vs the manifest's expectation)
-      -> ``corrupt``
+      the commit made it durable once; absence is loss, not latency)
+    - footer truncated/malformed, or a size disagreeing with
+      ``expected_size``                     -> ``torn``
+    - CRC mismatch (vs footer, or vs ``expected_crc``) -> ``corrupt``
     - any other OSError -> bounded in-place retry with exponential
       backoff, then ``io``.
+
+    The ``<file>.eio`` countdown sidecar (chaos ``eio`` injection)
+    works here exactly as on the shuffle read path — the spill tier
+    inherits the same transient-IO rehearsal for free.
     """
-    meta = meta or {}
-    map_task = meta.get("task", map_task)
     last: Optional[OSError] = None
     for attempt in range(max(0, max_retries) + 1):
         if attempt and on_retry is not None:
@@ -187,23 +243,40 @@ def read_block(path: str, meta: Optional[Dict] = None, *,
             with open(path, "rb") as f:
                 data = f.read()
         except FileNotFoundError:
-            raise FetchFailure(shuffle_id, map_task, path, "missing",
-                               "block listed in the manifest is gone")
+            raise make_error("missing", missing_detail)
         except OSError as e:
             last = e
             if attempt < max_retries:  # no sleep before the escalation
                 time.sleep(retry_wait_s * (2 ** attempt))
             continue
-        size = meta.get("size")
-        if size is not None and size != len(data):
-            raise FetchFailure(
-                shuffle_id, map_task, path, "torn",
-                f"manifest expects {size} bytes, file holds {len(data)}")
-        return verify_payload(data, path, shuffle_id, map_task,
-                              expected_crc=meta.get("crc"))
-    raise FetchFailure(
-        shuffle_id, map_task, path, "io",
+        if expected_size is not None and expected_size != len(data):
+            raise make_error(
+                "torn",
+                f"manifest expects {expected_size} bytes, file holds "
+                f"{len(data)}")
+        return verify_sealed(data, make_error, expected_crc=expected_crc)
+    raise make_error(
+        "io",
         f"still failing after {max_retries} in-place retries: {last}")
+
+
+def read_block(path: str, meta: Optional[Dict] = None, *,
+               shuffle_id: int = -1, map_task=None,
+               max_retries: int = 3, retry_wait_s: float = 0.05,
+               on_retry=None):
+    """Read + verify one shuffle block: :func:`read_sealed_file` with
+    the manifest's expectations and :class:`~.transport.FetchFailure`
+    classification."""
+    meta = meta or {}
+    map_task = meta.get("task", map_task)
+    return read_sealed_file(
+        path,
+        lambda kind, detail: FetchFailure(shuffle_id, map_task, path,
+                                          kind, detail),
+        expected_size=meta.get("size"), expected_crc=meta.get("crc"),
+        max_retries=max_retries, retry_wait_s=retry_wait_s,
+        on_retry=on_retry,
+        missing_detail="block listed in the manifest is gone")
 
 
 _PID_RE = re.compile(r"_p(\d+)\.arrow$")

@@ -24,7 +24,7 @@ from typing import Dict, Iterator, List, Optional
 
 __all__ = ["EVENT_LOG_DIR", "log_query_event", "log_scheduler_events",
            "log_plan_rejected", "log_sql_error", "log_query_cancelled",
-           "read_event_logs", "plan_fingerprint"]
+           "log_spill_event", "read_event_logs", "plan_fingerprint"]
 
 from ..config import register
 
@@ -180,6 +180,24 @@ def log_query_cancelled(conf, err, wall_s: float,
         "source": source,
         "cluster": cluster,
     }
+    with open(_app_path(base), "a") as f:
+        f.write(json.dumps(event) + "\n")
+    _prune_event_logs(conf, base)
+
+
+def log_spill_event(conf, type_: str, **fields) -> None:
+    """Append one spill-tier durability event — ``spill_write_failed``
+    (a non-ENOSPC OSError refused a spill write), ``spill_read_failed``
+    (a committed spill file failed its verified read-back, classified
+    missing|corrupt|torn|io), or ``disk_pressure`` (ENOSPC or the live
+    disk-residency budget refused the write; the batch stayed
+    host-resident) — mirroring the shuffle tier's ``fetch_failed``
+    evidence. No-op unless spark.rapids.eventLog.dir is set."""
+    base = conf.get(EVENT_LOG_DIR)
+    if not base:
+        return
+    event = {"type": type_, "ts": time.time()}
+    event.update({k: v for k, v in fields.items() if v is not None})
     with open(_app_path(base), "a") as f:
         f.write(json.dumps(event) + "\n")
     _prune_event_logs(conf, base)

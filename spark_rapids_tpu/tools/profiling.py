@@ -356,7 +356,17 @@ def _fmt_ring_event(e: dict) -> str:
                 f"a{e.get('attempt', '?')} w{e.get('worker', '?')} "
                 f"{e.get('reason', '')}").rstrip()
     if kind == "mem":
-        return (f"mem {e.get('ev', '?')} {_fmt_bytes(e.get('bytes', 0))} "
+        ev = e.get("ev", "?")
+        if ev in ("disk_pressure", "spill_read_failed",
+                  "spill_write_failed"):
+            return (f"mem SPILL-{ev.upper()} "
+                    f"[{e.get('fail_kind', '?')}] "
+                    f"{os.path.basename(e.get('path') or '')} "
+                    f"{e.get('detail', '')}").rstrip()
+        if ev == "spill_read_retry":
+            return (f"mem spill-read-retry #{e.get('n', '?')} "
+                    f"{e.get('error', '')}").rstrip()
+        return (f"mem {ev} {_fmt_bytes(e.get('bytes', 0))} "
                 f"(device {_fmt_bytes(e.get('device', 0))}, "
                 f"host {_fmt_bytes(e.get('host', 0))})")
     if kind == "task":

@@ -303,6 +303,27 @@ def test_chaos_conf_overrides_oom_storm():
     assert conf_overrides("crash:q1s1m0:*", 0, "q1s1m0", 0) == {}
 
 
+def test_chaos_spill_fault_modes_do_not_silently_collide():
+    """spill_corrupt + spill_torn share the one injectSpillFault
+    channel a manager has: both matching the same (task, attempt) is a
+    contradictory spec and a named hard error (never a silent no-op),
+    while disjoint task globs and repeated rules of ONE mode still
+    compose / first-match-win."""
+    from spark_rapids_tpu.scheduler.chaos import conf_overrides
+    with pytest.raises(ValueError,
+                       match="spill_corrupt.*spill_torn"):
+        conf_overrides("spill_corrupt:q1r0:*;spill_torn:q1r0:*",
+                       0, "q1r0", 0)
+    spec = "spill_corrupt:q1r0:*;spill_torn:q2r0:*"
+    assert conf_overrides(spec, 0, "q1r0", 0) == {
+        "spark.rapids.memory.test.injectSpillFault": "corrupt"}
+    assert conf_overrides(spec, 0, "q2r0", 0) == {
+        "spark.rapids.memory.test.injectSpillFault": "torn"}
+    assert conf_overrides("spill_torn:q1r0:*;spill_torn:q1r0:*",
+                          0, "q1r0", 0) == {
+        "spark.rapids.memory.test.injectSpillFault": "torn"}
+
+
 def test_chaos_hang_query_returns_after_bound_without_cancel(tmp_path):
     from spark_rapids_tpu.scheduler.chaos import maybe_inject
     t0 = time.monotonic()

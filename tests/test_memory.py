@@ -216,16 +216,499 @@ def test_host_tier_cascades_to_disk(tmp_path):
     # device budget forced host spills; host limit forced disk spills
     assert mm.spill_bytes > 0
     assert mm.disk_spill_bytes > 0
+    assert mm.disk_in_use_bytes > 0  # live residency tracked
     assert any(sb.on_disk for sb in sbs)
     import os
-    assert os.listdir(tmp_path)
+    # files land in this process's incarnation namespace, not the root
+    assert os.path.dirname(mm.spill_dir) == str(tmp_path)
+    assert os.listdir(mm.spill_dir)
     # read-back restores values through all tiers
     for sb in sbs:
         host = sb.get_host()
         assert host.num_rows == 512
     for sb in sbs:
         sb.release()
-    assert os.listdir(tmp_path) == []  # disk files cleaned on release
+    # disk files cleaned on release; live residency back to zero
+    assert os.listdir(mm.spill_dir) == []
+    assert mm.disk_in_use_bytes == 0
+
+
+# --- spill durability: sealed files, classified read-back, disk budget -----
+
+def _disk_mgr(tmp_path, extra=None):
+    conf = {"spark.rapids.memory.device.budgetBytes": 1 << 22,
+            "spark.rapids.memory.spillDir": str(tmp_path)}
+    conf.update(extra or {})
+    return DeviceMemoryManager(RapidsConf(conf))
+
+
+def _spill_to_disk(mm, n=256, seed=1):
+    """One batch walked device -> host -> committed sealed disk file."""
+    rb = _rb(n, seed=seed)
+    sb = mm.register(arrow_to_device(rb))
+    sb.spill(cascade=False)
+    assert sb.spill_to_disk(), "spill file did not commit"
+    assert sb.on_disk and sb._host is None
+    return sb, rb
+
+
+def test_spill_file_is_sealed_and_verified_roundtrip(tmp_path):
+    """The committed spill file carries the shuffle tier's CRC32C+length
+    trailer and read-back verifies it (same sealed format — PR 12)."""
+    from spark_rapids_tpu.shuffle.integrity import read_sealed_file
+    mm = _disk_mgr(tmp_path)
+    sb, rb = _spill_to_disk(mm)
+    # independently verifiable with the shuffle-side reader
+    payload = read_sealed_file(sb._disk_path, RuntimeError)
+    assert len(payload) == sb._disk_size - 16  # FOOTER_LEN
+    host = sb.get_host()  # verified read-back
+    assert pa.Table.from_batches([host]).to_pydict() \
+        == pa.Table.from_batches([rb]).to_pydict()
+    assert not sb.on_disk and mm.disk_in_use_bytes == 0
+    sb.release()
+
+
+@pytest.mark.parametrize("damage,kind", [
+    ("torn", "torn"), ("corrupt", "corrupt"), ("missing", "missing")])
+def test_spill_read_failure_classified(tmp_path, damage, kind):
+    """Torn trailer / flipped payload bytes / deleted file each classify
+    as SpillReadError(kind=...) — never a raw OSError/ArrowInvalid."""
+    import os
+    from spark_rapids_tpu.memory import SpillReadError
+    mm = _disk_mgr(tmp_path)
+    sb, _ = _spill_to_disk(mm)
+    path = sb._disk_path
+    if damage == "torn":
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) - 8)
+    elif damage == "corrupt":
+        with open(path, "r+b") as f:
+            f.seek(os.path.getsize(path) // 2)
+            chunk = f.read(4)
+            f.seek(os.path.getsize(path) // 2)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+    else:
+        os.unlink(path)
+    with pytest.raises(SpillReadError) as ei:
+        sb.get_host()
+    assert ei.value.kind == kind
+    # tier state untouched: a later consumer sees the SAME classified
+    # state, and release still cleans the ledger
+    assert sb.on_disk
+    sb.release()
+    assert mm.disk_in_use_bytes == 0
+
+
+def test_spill_write_side_chaos_injections(tmp_path):
+    """spark.rapids.memory.test.injectSpillFault damages the COMMITTED
+    file exactly like the chaos modes spill_corrupt/spill_torn do."""
+    from spark_rapids_tpu.memory import SpillReadError
+    for fault, kind in (("corrupt", "corrupt"), ("torn", "torn")):
+        mm = _disk_mgr(tmp_path / fault, {
+            "spark.rapids.memory.test.injectSpillFault": fault})
+        sb, _ = _spill_to_disk(mm)
+        with pytest.raises(SpillReadError) as ei:
+            sb.get_host()
+        assert ei.value.kind == kind
+        sb.release()
+
+
+def test_spill_read_eio_retries_in_place(tmp_path):
+    """A transient EIO (countdown sidecar — the shuffle tier's chaos
+    grammar) is retried in place and the read succeeds."""
+    mm = _disk_mgr(tmp_path, {
+        "spark.rapids.memory.disk.readRetryWaitMs": 1})
+    sb, rb = _spill_to_disk(mm)
+    with open(sb._disk_path + ".eio", "w") as f:
+        f.write("2")  # first two reads fail transiently
+    host = sb.get_host()
+    assert host.num_rows == rb.num_rows
+    sb.release()
+
+
+def test_spill_read_eio_exhausted_classifies_io(tmp_path):
+    from spark_rapids_tpu.memory import SpillReadError
+    mm = _disk_mgr(tmp_path, {
+        "spark.rapids.memory.disk.readRetries": 1,
+        "spark.rapids.memory.disk.readRetryWaitMs": 1})
+    sb, _ = _spill_to_disk(mm)
+    with open(sb._disk_path + ".eio", "w") as f:
+        f.write("99")  # more failures than the retry budget
+    with pytest.raises(SpillReadError) as ei:
+        sb.get_host()
+    assert ei.value.kind == "io"
+    sb.release()
+
+
+def test_zero_row_batch_spill_roundtrip(tmp_path):
+    """A 0-live-row batch survives the full device->host->disk->host
+    walk (0-row Arrow IPC tables yield no batches on read — the
+    read-back must rebuild an empty RecordBatch, not crash)."""
+    mm = _disk_mgr(tmp_path)
+    rb = pa.record_batch({"a": pa.array([], pa.int64()),
+                          "b": pa.array([], pa.string())})
+    sb = mm.register(arrow_to_device(rb))
+    sb.spill(cascade=False)
+    assert sb.spill_to_disk()
+    host = sb.get_host()
+    assert host.num_rows == 0
+    assert host.schema.names == ["a", "b"]
+    sb.release()
+
+
+def test_enospc_mid_write_classified_and_no_partial_file(tmp_path):
+    """Injected ENOSPC mid-write (after payload, before commit): the
+    partial tmp is unlinked, the batch stays host-resident, the refusal
+    is classified disk pressure — no OSError escapes, nothing leaks."""
+    import os
+    from spark_rapids_tpu.memory import _SPILL_WRITE_FAILURES
+    before = _SPILL_WRITE_FAILURES.labels("enospc").value
+    mm = _disk_mgr(tmp_path, {
+        "spark.rapids.memory.test.injectDiskFull": 2})  # both attempts
+    rb = _rb(256)
+    sb = mm.register(arrow_to_device(rb))
+    sb.spill(cascade=False)
+    assert sb.spill_to_disk() is False  # refused, not raised
+    assert sb._host is not None and not sb.on_disk  # data survives
+    assert mm.disk_pressure_active()
+    assert _SPILL_WRITE_FAILURES.labels("enospc").value == before + 1
+    leftovers = os.listdir(mm.spill_dir) if os.path.isdir(mm.spill_dir) \
+        else []
+    assert leftovers == [], f"partial files leaked: {leftovers}"
+    # countdown spent: the next attempt commits and clears the pressure
+    assert sb.spill_to_disk() is True
+    assert not mm.disk_pressure_active()
+    sb.release()
+    assert mm.disk_in_use_bytes == 0
+
+
+def test_io_write_failure_is_evidence_not_pressure(tmp_path, monkeypatch):
+    """A transient non-ENOSPC write error classifies as spill_write_failed
+    evidence (metric + flight ring) but does NOT open the sticky
+    disk-pressure window: one flaky EIO must not pause host->disk
+    eviction or flip the ladder's terminal rung to a budget cancel for
+    a disk that has room and is healthy again."""
+    import errno
+    from spark_rapids_tpu.memory import _SPILL_WRITE_FAILURES
+    from spark_rapids_tpu.shuffle import integrity
+    mm = _disk_mgr(tmp_path)
+    rb = _rb(256)
+    sb = mm.register(arrow_to_device(rb))
+    sb.spill(cascade=False)
+    before = _SPILL_WRITE_FAILURES.labels("io").value
+
+    def flaky(path, payload, fail_hook=None):
+        raise OSError(errno.EIO, "flaky disk")
+
+    monkeypatch.setattr(integrity, "write_sealed_file", flaky)
+    from spark_rapids_tpu.obs.recorder import RECORDER
+    ring_before = len(RECORDER.snapshot())
+    assert sb.spill_to_disk() is False  # refused, not raised
+    assert sb._host is not None and not sb.on_disk  # data survives
+    assert _SPILL_WRITE_FAILURES.labels("io").value == before + 1
+    assert not mm.disk_pressure_active()  # evidence, not pressure
+    # the flight event matches: spill_write_failed (spill_failure
+    # anomaly), NOT disk_pressure (which would emit a disk-pressure
+    # incident bundle for one flaky EIO)
+    new = [e for e in RECORDER.snapshot()[ring_before:]
+           if e.get("kind") == "mem" and e.get("fail_kind") == "io"]
+    assert [e["ev"] for e in new] == ["spill_write_failed"]
+    monkeypatch.undo()
+    assert sb.spill_to_disk() is True  # healthy again: commits
+    sb.release()
+    assert mm.disk_in_use_bytes == 0
+
+
+def test_slow_disk_injection_gets_fresh_manager(tmp_path):
+    """spark.rapids.memory.test.injectSlowDisk bypasses the shared()
+    cache like every other spill/disk fault injection: the delay must
+    neither silently no-op (default-conf manager built first, then
+    shared by the injected task) nor bleed into later non-injected
+    tasks that hash to the same key."""
+    base = {"spark.rapids.memory.device.budgetBytes": 1 << 22,
+            "spark.rapids.memory.spillDir": str(tmp_path)}
+    plain = DeviceMemoryManager.shared(RapidsConf(base))
+    slow = DeviceMemoryManager.shared(RapidsConf(
+        {**base, "spark.rapids.memory.test.injectSlowDisk": 50}))
+    assert slow is not plain
+    assert slow._slow_disk_s > 0 and plain._slow_disk_s == 0
+    # and a second default-conf resolve still shares the plain one
+    assert DeviceMemoryManager.shared(RapidsConf(base)) is plain
+
+
+def test_disk_read_policy_confs_fragment_shared_cache(tmp_path):
+    """The disk read-retry/orphan-TTL knobs are part of the shared()
+    cache key: a query setting readRetries=0 for fail-fast reads must
+    get a manager that honors it, not the cached default-policy one
+    (DISK_SPILL_LIMIT already fragments the cache; these ride the same
+    rule)."""
+    base = {"spark.rapids.memory.device.budgetBytes": 1 << 22,
+            "spark.rapids.memory.spillDir": str(tmp_path)}
+    plain = DeviceMemoryManager.shared(RapidsConf(base))
+    fast = DeviceMemoryManager.shared(RapidsConf(
+        {**base, "spark.rapids.memory.disk.readRetries": 0,
+         "spark.rapids.memory.disk.readRetryWaitMs": 500}))
+    assert fast is not plain
+    assert fast.disk_read_retries == 0 and plain.disk_read_retries == 3
+    assert DeviceMemoryManager.shared(RapidsConf(base)) is plain
+
+
+def test_budget_eviction_skips_terminally_bad_victim(tmp_path):
+    """A victim whose read-back fails terminally (corrupt) is skipped by
+    later budget-eviction passes: its classified failure is counted once
+    for the eviction probe, not once per over-budget spill, and the bad
+    file stays referenced for the real consumer to classify."""
+    from spark_rapids_tpu.memory import SpillReadError, \
+        _SPILL_READ_FAILURES
+    mm = _disk_mgr(tmp_path)
+    sb1, _ = _spill_to_disk(mm, seed=1)
+    with open(sb1._disk_path, "r+b") as f:
+        f.seek(3)
+        f.write(b"\xff")
+    mm.disk_limit = sb1._disk_size  # any further spill is over budget
+    before = _SPILL_READ_FAILURES.labels("corrupt").value
+    spills = []
+    for seed in (2, 3, 4):  # three eviction passes over the bad victim
+        sb = mm.register(arrow_to_device(_rb(256, seed=seed)))
+        sb.spill(cascade=False)
+        assert sb.spill_to_disk() is False  # budget refusal, classified
+        spills.append(sb)
+    assert _SPILL_READ_FAILURES.labels("corrupt").value == before + 1
+    assert sb1.on_disk  # never silently dropped
+    with pytest.raises(SpillReadError) as ei:  # consumer still classifies
+        sb1.get_host()
+    assert ei.value.kind == "corrupt"
+    for sb in (sb1, *spills):
+        sb.release()
+    assert mm.disk_in_use_bytes == 0
+
+
+def test_budget_eviction_skips_persistent_eio_victim(tmp_path):
+    """A victim whose read-back exhausts the EIO retry budget (kind=io)
+    is marked bad exactly like corrupt/torn victims: later
+    budget-eviction passes must neither re-sleep the full retry ladder
+    under another batch's spill nor re-count the classified failure
+    once per over-budget write."""
+    from spark_rapids_tpu.memory import _SPILL_READ_FAILURES
+    mm = _disk_mgr(tmp_path, {
+        "spark.rapids.memory.disk.readRetries": 1,
+        "spark.rapids.memory.disk.readRetryWaitMs": 1})
+    sb1, _ = _spill_to_disk(mm, seed=1)
+    with open(sb1._disk_path + ".eio", "w") as f:
+        f.write("9999")  # persistent: every read attempt fails
+    mm.disk_limit = sb1._disk_size  # any further spill is over budget
+    before = _SPILL_READ_FAILURES.labels("io").value
+    spills = []
+    for seed in (2, 3, 4):  # three eviction passes over the bad victim
+        sb = mm.register(arrow_to_device(_rb(256, seed=seed)))
+        sb.spill(cascade=False)
+        assert sb.spill_to_disk() is False  # budget refusal, classified
+        spills.append(sb)
+    assert _SPILL_READ_FAILURES.labels("io").value == before + 1
+    assert sb1.on_disk  # never silently dropped
+    for sb in (sb1, *spills):
+        sb.release()
+    assert mm.disk_in_use_bytes == 0
+
+
+def test_disk_budget_admission_reserves_not_check_then_act(tmp_path):
+    """Admission RESERVES the file size in disk_in_use_bytes under the
+    ledger lock: two concurrent spills that each fit alone must not
+    both pass the check and breach spark.rapids.memory.disk.limit
+    together — the second admit sees the first's reservation and
+    refuses classified."""
+    from spark_rapids_tpu.memory import _SPILL_WRITE_FAILURES
+    mm = _disk_mgr(tmp_path)
+    mm.disk_limit = 100
+    before = _SPILL_WRITE_FAILURES.labels("budget").value
+    assert mm._disk_budget_admit(60) is True
+    assert mm.disk_in_use_bytes == 60  # reserved before the write lands
+    # check-then-act would admit this too (60 <= 100); the reservation
+    # makes it see 120 > 100 with nothing on disk to evict
+    assert mm._disk_budget_admit(60) is False
+    assert mm.disk_in_use_bytes == 60  # a refusal reserves nothing
+    assert _SPILL_WRITE_FAILURES.labels("budget").value == before + 1
+    assert mm.disk_pressure_active()
+    with mm._lock:  # the caller's non-commit path releases its hold
+        mm.disk_in_use_bytes -= 60
+    assert mm.disk_in_use_bytes == 0
+
+
+def test_unlink_failure_after_verified_read_not_classified(tmp_path,
+                                                           monkeypatch):
+    """An unlink that fails AFTER the verified read succeeded (EACCES,
+    ro-remount) must not escape as an unclassified OSError that
+    discards the table and blames the reading worker: the data is
+    returned, the residency ledger drops the bytes, and the stale file
+    is a bounded leak the next incarnation's orphan sweep reclaims."""
+    import errno
+    import os
+    mm = _disk_mgr(tmp_path)
+    sb, rb = _spill_to_disk(mm)
+    path = sb._disk_path
+    real_unlink = os.unlink
+
+    def ro_unlink(p, *a, **k):
+        if p == path:
+            raise OSError(errno.EACCES, "read-only remount")
+        return real_unlink(p, *a, **k)
+
+    monkeypatch.setattr(os, "unlink", ro_unlink)
+    host = sb.get_host()  # returns the data, does not raise
+    assert host.num_rows == rb.num_rows
+    assert not sb.on_disk
+    assert mm.disk_in_use_bytes == 0
+    assert os.path.exists(path)  # the bounded leak, swept next boot
+    monkeypatch.undo()
+    sb.release()
+
+
+def test_stale_pressure_window_does_not_abort_eviction_pass(tmp_path):
+    """_evict_host_to_disk stops a pass only on a FRESH disk refusal
+    (every refusal restamps the sticky window, so a fresh one strictly
+    advances it) — a victim losing its try-acquire or sitting behind
+    the anti-churn bar while a stale 30s window from a healed ENOSPC
+    is still open must not strand the rest of the host tier over its
+    limit for the remainder of the window."""
+    mm = _disk_mgr(tmp_path)
+    sb1 = mm.register(arrow_to_device(_rb(256, seed=1)))
+    sb1.spill(cascade=False)
+    sb2 = mm.register(arrow_to_device(_rb(256, seed=2)))
+    sb2.spill(cascade=False)
+    sb1._no_disk_until = time.monotonic() + 60  # anti-churn: False,
+    # without restamping the window
+    mm._disk_pressure_until = time.monotonic() + 60  # stale (healed)
+    mm.host_limit = 0
+    mm._evict_host_to_disk()
+    assert sb2.on_disk, "stale window aborted the pass at first False"
+    assert not sb1.on_disk
+    for sb in (sb1, sb2):
+        sb.release()
+    assert mm.disk_in_use_bytes == 0
+
+
+def test_get_charge_unwind_on_failed_reupload(tmp_path, monkeypatch):
+    """Regression (PR 12 satellite): a re-upload that raises after
+    _charge must not strand device_bytes on a batch whose _device stays
+    None — the charge unwinds and a later get() still works."""
+    import spark_rapids_tpu.columnar.arrow_bridge as bridge
+    mm = _disk_mgr(tmp_path)
+    rb = _rb(128)
+    sb = mm.register(arrow_to_device(rb))
+    sb.spill(cascade=False)
+    baseline = mm.device_bytes
+    real = bridge.arrow_to_device
+
+    def boom(*a, **k):
+        raise RuntimeError("upload exploded")
+
+    monkeypatch.setattr(bridge, "arrow_to_device", boom)
+    with pytest.raises(RuntimeError):
+        sb.get()
+    assert mm.device_bytes == baseline, "stranded device charge"
+    assert sb._host is not None and sb._device is None  # still retryable
+    monkeypatch.setattr(bridge, "arrow_to_device", real)
+    assert sb.get().num_rows == 128  # the retry succeeds
+    sb.release()
+
+
+def test_disk_budget_evicts_oldest_then_refuses_classified(tmp_path):
+    """spark.rapids.memory.disk.limit: an over-budget spill first
+    promotes the oldest unpinned disk entry back to host; if the budget
+    STILL can't fit (victims pinned), the write is refused classified
+    as budget pressure."""
+    from spark_rapids_tpu.memory import _SPILL_WRITE_FAILURES
+    mm = _disk_mgr(tmp_path)
+    sb1, _ = _spill_to_disk(mm, seed=1)
+    size = sb1._disk_size
+    mm.disk_limit = int(size * 1.5)  # room for one file, not two
+    sb2, _ = _spill_to_disk(mm, seed=2)  # evicts sb1 to make room
+    assert sb2.on_disk
+    assert not sb1.on_disk and sb1._host is not None  # promoted back
+    assert mm.disk_in_use_bytes <= mm.disk_limit
+    # pinned disk entries are not eviction victims: now the budget is
+    # genuinely unsatisfiable and the refusal classifies as 'budget'
+    sb2.pin()
+    before = _SPILL_WRITE_FAILURES.labels("budget").value
+    sb3 = mm.register(arrow_to_device(_rb(256, seed=3)))
+    sb3.spill(cascade=False)
+    sb1._no_disk_until = 0.0  # not the victim under test
+    assert sb3.spill_to_disk() is False
+    assert _SPILL_WRITE_FAILURES.labels("budget").value == before + 1
+    assert mm.disk_pressure_active()
+    sb2.unpin()
+    for sb in (sb1, sb2, sb3):
+        sb.release()
+    assert mm.disk_in_use_bytes == 0
+
+
+def test_disk_pressure_feeds_ladder_terminal_as_budget_cancel(tmp_path):
+    """A query OOMing while the disk tier refuses writes walks the
+    ladder and terminates QueryCancelled(reason=budget) — CPU fallback
+    cannot spill either when the disk is full."""
+    from spark_rapids_tpu.lifecycle import QueryCancelled, QueryContext
+    mm = _disk_mgr(tmp_path, {"spark.rapids.sql.oomRetry.maxSplits": 0,
+                              "spark.rapids.query.admission.timeout": 1})
+    mm._disk_pressure_until = time.monotonic() + 60  # sticky pressure
+    qctx = QueryContext(mm.conf, query_id="qdisk")
+
+    def boom(_):
+        raise TpuRetryOOM("RESOURCE_EXHAUSTED: fake")
+
+    b = arrow_to_device(_rb(64))
+    with pytest.raises(QueryCancelled) as ei:
+        mm.with_retry(b, boom, qctx=qctx)
+    assert ei.value.reason == "budget"
+    assert "disk spill tier" in ei.value.detail
+
+
+def test_orphan_sweep_reclaims_dead_incarnations(tmp_path):
+    """Namespaces whose same-host owner pid is dead are reclaimed
+    immediately; foreign-host dirs only via the age fallback; the live
+    process's own namespace is never touched."""
+    import os
+    import subprocess
+    from spark_rapids_tpu.memory import (_hostname, spill_namespace,
+                                         sweep_orphan_spill_dirs)
+    base = str(tmp_path)
+    host = _hostname()
+    p = subprocess.Popen(["true"])
+    p.wait()  # reaped: the pid is provably dead
+    dead = os.path.join(base, f"{host}-{p.pid}-{'a' * 8}")
+    os.makedirs(dead)
+    open(os.path.join(dead, "spill-x.arrow"), "w").close()
+    old_foreign = os.path.join(base, f"elsewhere-4242-{'b' * 8}")
+    os.makedirs(old_foreign)
+    os.utime(old_foreign, (1.0, 1.0))  # ancient
+    young_foreign = os.path.join(base, f"elsewhere-4243-{'c' * 8}")
+    os.makedirs(young_foreign)
+    own = spill_namespace(base)
+    os.makedirs(own)
+    removed = sweep_orphan_spill_dirs(base, ttl_s=3600.0, force=True)
+    assert dead in removed and old_foreign in removed
+    assert not os.path.exists(dead) and not os.path.exists(old_foreign)
+    assert os.path.exists(young_foreign)  # can't prove abandonment yet
+    assert os.path.exists(own)  # never sweep the live namespace
+
+
+def test_manager_construction_sweeps_once(tmp_path):
+    """Manager construction runs the orphan sweep for its root (and a
+    dead namespace planted there is gone before the first spill)."""
+    import os
+    import subprocess
+    from spark_rapids_tpu.memory import _hostname
+    p = subprocess.Popen(["true"])
+    p.wait()
+    dead = os.path.join(str(tmp_path), f"{_hostname()}-{p.pid}-{'d' * 8}")
+    os.makedirs(dead)
+    # force=False path is once-per-root-per-process; force guarantees
+    # this test is order-independent under pytest
+    from spark_rapids_tpu.memory import sweep_orphan_spill_dirs
+    sweep_orphan_spill_dirs(str(tmp_path), force=True)
+    assert not os.path.exists(dead)
+    mm = _disk_mgr(tmp_path)
+    sb, _ = _spill_to_disk(mm)
+    sb.release()
 
 
 def test_leak_report(tmp_path):

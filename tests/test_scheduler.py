@@ -281,3 +281,71 @@ def _walk(node):
     yield node
     for ch in getattr(node, "children", ()):
         yield from _walk(ch)
+
+
+# --- spill-tier durability under chaos (PR 12) -----------------------------
+
+@pytest.mark.parametrize("mode,kind", [("spill_corrupt", "corrupt"),
+                                       ("spill_torn", "torn")])
+def test_chaos_spill_damage_classified_retry_no_blacklist(
+        tmp_path, mode, kind):
+    """PR 12 acceptance: a worker whose committed spill files rot
+    (chaos ``spill_corrupt``) fails its attempt CLASSIFIED — the
+    SpillReadError rides a structured ``.spillfail`` marker — and the
+    scheduler retries the task WITHOUT blacklisting the reading worker
+    (bit rot is not a process fault; re-execution regenerates the
+    data). The retry (no injection at attempt 1) goes green, the query
+    matches the oracle, the incident bundle carries the
+    spill_read_failed anomaly, and no live incarnation spill dir
+    leaks files."""
+    from spark_rapids_tpu.exec.sort import SortOrder, TpuSortExec
+    log_dir = str(tmp_path / "events")
+    flight_dir = str(tmp_path / "incidents")
+    spill_dir = str(tmp_path / "spill")
+    conf = RapidsConf({
+        "spark.rapids.tpu.test.injectFaults": f"{mode}:q1r*:0",
+        # budgets tiny enough that the reduce task's global sort goes
+        # out-of-core: its runs walk device -> host -> sealed disk
+        # files and are read back (verified) during the k-way merge
+        "spark.rapids.memory.device.budgetBytes": 1 << 14,
+        "spark.rapids.memory.host.spillStorageSize": 1 << 12,
+        "spark.rapids.memory.spillDir": spill_dir,
+        "spark.rapids.eventLog.dir": log_dir,
+        "spark.rapids.flight.dir": flight_dir,
+    })
+    rng = np.random.default_rng(7)
+    rbs = [pa.record_batch({
+        "k": pa.array(rng.integers(0, 1 << 30, 1200).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 1000, 1200).astype(np.int64)),
+    }) for _ in range(4)]
+    plan = TpuSortExec(
+        [SortOrder(col("k"))],
+        TpuShuffleExchangeExec(HashPartitioning([col("v")], 1),
+                               HostBatchSourceExec(rbs)))
+    with TpuProcessCluster(n_workers=2, conf=conf) as c:
+        got = c.run_query(plan)
+        sched = c.last_scheduler
+        bundle = c.last_incident_path
+    assert _rows(got) == _rows(_oracle(plan))
+    # the loss was classified, not a raw OSError/ArrowInvalid task error
+    spill_fails = _events(sched, "spill_read_failed")
+    assert spill_fails, "spill_corrupt never bit a reduce task"
+    assert f"[spill {kind}]" in spill_fails[0]["reason"]
+    # the reading worker is never blamed
+    assert not sched.blacklist
+    assert not _events(sched, "worker_blacklisted")
+    # the task re-ran and went green elsewhere/next attempt
+    task = spill_fails[0]["task"]
+    ok = _events(sched, "task_ok", task)
+    assert ok and ok[0]["attempt"] >= 1
+    # forensics: the bundle names the classified anomaly
+    assert bundle and os.path.exists(bundle)
+    kinds = {a["kind"] for a in json.load(open(bundle))["anomalies"]}
+    assert "spill_read_failed" in kinds, kinds
+    # no orphan spill files survive in any live incarnation namespace
+    leftovers = []
+    if os.path.isdir(spill_dir):
+        for ns in os.listdir(spill_dir):
+            leftovers += [f for f in os.listdir(os.path.join(
+                spill_dir, ns)) if f.endswith(".arrow")]
+    assert leftovers == [], f"leaked spill files: {leftovers}"

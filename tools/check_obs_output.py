@@ -417,6 +417,102 @@ def run_shuffle_smoke(out_dir):
     return bundle
 
 
+def run_spill_smoke(out_dir):
+    """ci_smoke step: a reduce-side out-of-core sort whose disk-spill
+    writes ALL hit injected ENOSPC (chaos ``disk_full``). The full-disk
+    response must be classified end to end: the query completes green
+    (refused writes leave batches host-resident — no raw OSError
+    escapes into the eviction cascade), the persisted event log carries
+    ``disk_pressure`` lines with kind=enospc, exactly ONE incident
+    bundle names the ``disk_pressure`` anomaly, a PLANTED
+    dead-incarnation spill namespace is reclaimed by the boot-time
+    orphan sweep, and no live namespace leaks a spill file. Returns
+    the bundle path (validated by check_flight)."""
+    import subprocess
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.cluster import TpuProcessCluster
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.exec.base import HostBatchSourceExec
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.exec.sort import SortOrder, TpuSortExec
+    from spark_rapids_tpu.expr import UnresolvedColumn as col
+    from spark_rapids_tpu.memory import _hostname
+    from spark_rapids_tpu.shuffle.partitioner import HashPartitioning
+    from spark_rapids_tpu.tools.event_log import read_event_logs
+    flight_dir = os.path.join(out_dir, "incidents")
+    log_dir = os.path.join(out_dir, "events")
+    spill_dir = os.path.join(out_dir, "spill")
+    # plant a dead incarnation: a namespace owned by a reaped pid,
+    # holding a stale spill file a crashed process would have leaked
+    p = subprocess.Popen(["true"])
+    p.wait()
+    orphan = os.path.join(spill_dir, f"{_hostname()}-{p.pid}-{'0' * 8}")
+    os.makedirs(orphan)
+    open(os.path.join(orphan, "spill-stale.arrow"), "w").close()
+    rng = np.random.default_rng(7)
+    rbs = [pa.record_batch({
+        "k": pa.array(rng.integers(0, 1 << 30, 1200).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 1000, 1200).astype(np.int64)),
+    }) for _ in range(4)]
+    plan = TpuSortExec(
+        [SortOrder(col("k"))],
+        TpuShuffleExchangeExec(HashPartitioning([col("v")], 1),
+                               HostBatchSourceExec(rbs)))
+    conf = RapidsConf({
+        # every disk-spill write the reduce task attempts is refused
+        "spark.rapids.tpu.test.injectFaults": "disk_full:q1r*:*:99",
+        # tiny budgets: the reduce-side sort goes out-of-core and its
+        # host tier WANTS to cascade to disk on every run
+        "spark.rapids.memory.device.budgetBytes": 1 << 14,
+        "spark.rapids.memory.host.spillStorageSize": 1 << 12,
+        "spark.rapids.memory.spillDir": spill_dir,
+        "spark.rapids.flight.dir": flight_dir,
+        "spark.rapids.eventLog.dir": log_dir,
+    })
+    with TpuProcessCluster(n_workers=2, conf=conf) as c:
+        assert not os.path.exists(orphan), \
+            "boot-time orphan sweep did not reclaim the dead namespace"
+        out = c.run_query(plan)
+        sched = c.last_scheduler
+        bundle = c.last_incident_path
+    assert out.num_rows == 4 * 1200, \
+        f"query wrong under full disk: {out.num_rows} rows"
+    ks = out.column("k").to_pylist()
+    assert ks == sorted(ks), "sort order lost under full disk"
+    # no raw OSError reached the scheduler: zero failed attempts
+    failed = [e for e in sched.events if e["event"] == "task_failed"]
+    assert not failed, f"full disk broke a task: {failed}"
+    # classified evidence: event log
+    pressure = [e for e in read_event_logs(log_dir)
+                if e.get("type") == "disk_pressure"]
+    assert pressure and pressure[0]["kind"] == "enospc", pressure
+    # ... and exactly one bundle naming the anomaly
+    assert bundle, "no incident bundle from the pressured query"
+    bundles = [n for n in os.listdir(flight_dir)
+               if n.startswith("incident-") and n.endswith(".json")]
+    assert bundles == [os.path.basename(bundle)], \
+        f"expected exactly one bundle, got {bundles}"
+    with open(bundle) as f:
+        kinds = {a["kind"] for a in json.load(f)["anomalies"]}
+    assert "disk_pressure" in kinds, kinds
+    # no live namespace leaks a spill file (refused writes cleaned
+    # their partials; committed files were read back or released)
+    leftovers = []
+    for ns in os.listdir(spill_dir):
+        nsp = os.path.join(spill_dir, ns)
+        if os.path.isdir(nsp):
+            leftovers += [f for f in os.listdir(nsp)
+                          if f.endswith(".arrow")]
+    assert leftovers == [], f"leaked spill files: {leftovers}"
+    print(f"spill smoke OK: query green under injected ENOSPC, "
+          f"{len(pressure)} classified disk_pressure event(s), one "
+          f"bundle, orphan namespace reclaimed")
+    return bundle
+
+
 _PROFILE_KEYS = ("version", "profile_id", "ts", "query", "source",
                  "cluster", "wall_s", "fingerprint", "nodes", "ops")
 
@@ -814,6 +910,12 @@ def main(argv=None):
                          "query_cancelled event + one incident bundle, "
                          "and a post-cancel query running green on the "
                          "same cluster")
+    ap.add_argument("--spill-smoke", metavar="DIR", dest="spill_smoke",
+                    help="run a reduce-side out-of-core sort with all "
+                         "disk-spill writes hitting injected ENOSPC "
+                         "(chaos disk_full): query green, classified "
+                         "disk_pressure evidence, exactly one bundle, "
+                         "planted orphan spill namespace reclaimed")
     ap.add_argument("--sql-smoke", metavar="DIR", dest="sql_smoke",
                     help="parse + compile + plan-verify the full SQL "
                          "corpus (zero parse failures / fallbacks) and "
@@ -863,6 +965,11 @@ def main(argv=None):
         bundle = run_lifecycle_smoke(args.lifecycle_smoke)
         flights.append(bundle)
         print(f"lifecycle smoke output: {bundle}")
+    if args.spill_smoke:
+        os.makedirs(args.spill_smoke, exist_ok=True)
+        bundle = run_spill_smoke(args.spill_smoke)
+        flights.append(bundle)
+        print(f"spill smoke output: {bundle}")
     ran_sql = False
     if args.sql_smoke:
         os.makedirs(args.sql_smoke, exist_ok=True)
@@ -878,7 +985,7 @@ def main(argv=None):
             and not args.lockwatch:
         ap.error("nothing to do: pass --trace/--prom/--smoke/"
                  "--scan-smoke/--flight/--flight-smoke/--shuffle-smoke/"
-                 "--lifecycle-smoke/--sql-smoke/--profile/"
+                 "--lifecycle-smoke/--spill-smoke/--sql-smoke/--profile/"
                  "--analyze-smoke/--lint-report/--lockwatch")
     if args.lint_report:
         errors += [f"[lint] {e}"

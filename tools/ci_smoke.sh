@@ -47,6 +47,14 @@
 #      hang_query must yield exactly one classified query_cancelled
 #      event + one incident bundle, and a post-cancel query must run
 #      green on the same cluster (no poisoned state)
+#  14. spill-durability smoke: a reduce-side out-of-core sort whose
+#      disk-spill writes ALL hit injected ENOSPC (chaos disk_full)
+#      must run green with classified disk_pressure evidence (event
+#      log + exactly one incident bundle), the boot-time orphan sweep
+#      must reclaim a planted dead-incarnation spill namespace, and
+#      no live namespace may leak a spill file; the spill unit matrix
+#      (torn/corrupt/missing/eio/ENOSPC, tests/test_memory.py) runs
+#      under the step-12 lock-order watchdog
 #
 # Pass --full to also run the tier-1 suite (see ROADMAP.md), bounded to
 # 870s like the driver's own gate — with the lock-order watchdog
@@ -54,46 +62,46 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/13 compileall =="
+echo "== 1/14 compileall =="
 python -m compileall -q spark_rapids_tpu tests
 
-echo "== 2/13 package import =="
+echo "== 2/14 package import =="
 JAX_PLATFORMS=cpu python -c "import spark_rapids_tpu; print('import ok:', spark_rapids_tpu.__name__)"
 
-echo "== 3/13 pytest collection =="
+echo "== 3/14 pytest collection =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q --collect-only -m 'not slow' \
     -p no:cacheprovider 2>&1 | tail -3
 
-echo "== 4/13 observability smoke =="
+echo "== 4/14 observability smoke =="
 OBS_TMP="$(mktemp -d)"
 trap 'rm -rf "$OBS_TMP"' EXIT
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --smoke "$OBS_TMP"
 
-echo "== 5/13 device-decode scan smoke =="
+echo "== 5/14 device-decode scan smoke =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --scan-smoke "$OBS_TMP/scan"
 
-echo "== 6/13 flight-recorder smoke =="
+echo "== 6/14 flight-recorder smoke =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --flight-smoke "$OBS_TMP/flight"
 
-echo "== 7/13 shuffle-durability smoke =="
+echo "== 7/14 shuffle-durability smoke =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --shuffle-smoke "$OBS_TMP/shuffle"
 
-echo "== 8/13 static analysis (tpu-lint + plan verifier) =="
+echo "== 8/14 static analysis (tpu-lint + plan verifier) =="
 JAX_PLATFORMS=cpu python tools/tpu_lint.py --json --baseline tools/tpu_lint_baseline.json > "$OBS_TMP/lint-step8.json"
 tail -8 "$OBS_TMP/lint-step8.json"
 JAX_PLATFORMS=cpu python tools/tpu_lint.py --check-docs
 JAX_PLATFORMS=cpu python -m spark_rapids_tpu.analysis.plan_verifier --smoke
 
-echo "== 9/13 widened-envelope scan smoke (mixed encodings) =="
+echo "== 9/14 widened-envelope scan smoke (mixed encodings) =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --scan-smoke "$OBS_TMP/scan-envelope" --mixed-encodings
 
-echo "== 10/13 SQL frontend smoke (full corpus + cluster run) =="
+echo "== 10/14 SQL frontend smoke (full corpus + cluster run) =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --sql-smoke "$OBS_TMP/sql"
 
-echo "== 11/13 operator-metrics smoke (EXPLAIN ANALYZE + profile) =="
+echo "== 11/14 operator-metrics smoke (EXPLAIN ANALYZE + profile) =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --analyze-smoke "$OBS_TMP/analyze"
 
-echo "== 12/13 tpu-lint 2.0 report gate + lock-order watchdog =="
+echo "== 12/14 tpu-lint 2.0 report gate + lock-order watchdog =="
 JAX_PLATFORMS=cpu python tools/tpu_lint.py --json --baseline tools/tpu_lint_baseline.json > "$OBS_TMP/lint.json"
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --lint-report "$OBS_TMP/lint.json"
 RAPIDS_TPU_LOCKWATCH=1 RAPIDS_TPU_LOCKWATCH_OUT="$OBS_TMP/lockwatch.json" \
@@ -103,8 +111,11 @@ RAPIDS_TPU_LOCKWATCH=1 RAPIDS_TPU_LOCKWATCH_OUT="$OBS_TMP/lockwatch.json" \
     -q -m 'not slow' -p no:cacheprovider
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --lockwatch "$OBS_TMP/lockwatch.json"
 
-echo "== 13/13 query-lifecycle smoke (deadline cancel under hang_query) =="
+echo "== 13/14 query-lifecycle smoke (deadline cancel under hang_query) =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --lifecycle-smoke "$OBS_TMP/lifecycle"
+
+echo "== 14/14 spill-durability smoke (out-of-core sort under disk_full) =="
+JAX_PLATFORMS=cpu python tools/check_obs_output.py --spill-smoke "$OBS_TMP/spill"
 
 if [[ "${1:-}" == "--full" ]]; then
     echo "== tier-1 (full, watchdog-enabled) =="
