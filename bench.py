@@ -242,8 +242,13 @@ def bench_nds_from_files(tmp_dir, n_sales=1 << 20, use_sql=True):
     reads files -> device decode -> query, vs pandas read_parquet + the
     oracle computation on the same files. Two queries bound first-run
     compile time; both place every operator on device. Returns
-    (geomean, detail, verify_fn) — the caller runs verify AFTER every
-    timed phase (downloads flip tunneled dispatch to sync)."""
+    (geomean, detail, verify_fn, chunks, op_budget) — the caller runs
+    verify AFTER every timed phase (downloads flip tunneled dispatch to
+    sync). ``chunks`` carries decode coverage AND the whole-stage-fusion
+    dispatch counters; ``op_budget`` is the per-operator from-files
+    time budget mined from the query-profile history each run writes
+    (the number that guided the fusion work and that BENCH rounds
+    publish)."""
     import math
 
     import jax
@@ -280,8 +285,28 @@ def bench_nds_from_files(tmp_dir, n_sales=1 << 20, use_sql=True):
     outs = {}
     # decode-coverage across the whole corpus: every planned column
     # chunk counts as device-decoded or host-fallback (the envelope-
-    # regression tripwire — acceptance wants ZERO fallbacks here)
-    chunks = {"device": 0, "fallback": 0}
+    # regression tripwire — acceptance wants ZERO fallbacks here), plus
+    # the dispatch-granularity counters: scan_programs = programs the
+    # scans dispatched, fused_dispatches = the ones where decode+chain
+    # ran as ONE spliced program (whole-stage fusion through the scan)
+    chunks = {"device": 0, "fallback": 0, "scan_programs": 0,
+              "fused_dispatches": 0}
+    # per-operator from-files time budget rides the PR 9 profile
+    # history: each query's folded metrics are committed as a profile
+    # and mined back below
+    # profiles land under the bench cache (not a leaked tempdir): the
+    # history stays inspectable via `profiling history/compare` and
+    # write_profile's retention pruning bounds it across runs
+    hist_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            ".bench_cache", "nds_profiles")
+    from spark_rapids_tpu.config import RapidsConf as _RC
+    hist_conf = _RC({"spark.rapids.history.dir": hist_dir})
+    from spark_rapids_tpu.obs.opmetrics import (build_profile, fold_ctx,
+                                                read_profiles,
+                                                top_op_sinks,
+                                                write_profile)
+    prof_inputs = []  # (name, root, ctx, dev_t): folded AFTER timing
+    RUNS_FOLDED = 3   # warm-up + 2 timed runs accumulate in one ctx
     for name in order:
         df = build(name, s, tables)
         pp = TpuOverrides(s.conf).apply(df._node)
@@ -296,17 +321,23 @@ def bench_nds_from_files(tmp_dir, n_sales=1 << 20, use_sql=True):
         # accumulate per run; counting after the timed loop would
         # triple every chunk)
         for node_metrics in ctx.metrics.values():
-            if "deviceChunks" in node_metrics:
-                chunks["device"] += node_metrics["deviceChunks"].value
-            if "fallbackChunks" in node_metrics:
-                chunks["fallback"] += \
-                    node_metrics["fallbackChunks"].value
+            for mk, ck in (("deviceChunks", "device"),
+                           ("fallbackChunks", "fallback"),
+                           ("scanPrograms", "scan_programs"),
+                           ("fusedDispatches", "fused_dispatches")):
+                if mk in node_metrics:
+                    chunks[ck] += node_metrics[mk].value
         times = []
         for _ in range(2):
             t0 = time.perf_counter()
             outs[name] = run_dev()
             times.append(time.perf_counter() - t0)
         dev_t = min(times)
+        # profile folding is DEFERRED to finish_profiles(): fold_ctx
+        # finalizes deferred row counts with a device_get, and a mid-
+        # bench readback would flip a tunneled session to synchronous
+        # dispatch for every later timed phase
+        prof_inputs.append((name, pp.root, ctx, dev_t))
 
         import pandas as pd
 
@@ -349,7 +380,31 @@ def bench_nds_from_files(tmp_dir, n_sales=1 << 20, use_sql=True):
                                        atol=1e-5), (name, c)
                 else:
                     assert (g == w).all(), (name, c)
-    return round(geomean, 3), results, verify, chunks
+
+    def finish_profiles():
+        """POST-TIMING phase (the fold's deferred-row-count readback is
+        only safe once every timed loop is done): commit one profile
+        per query to the history dir and mine the published
+        per-operator from-files time budget from them. Each ctx folded
+        RUNS_FOLDED executions, so per-run budget times divide by it
+        (profiles record runs_folded so `profiling compare` diffs
+        like-for-like across rounds)."""
+        for name, root, ctx_, dev_t in prof_inputs:
+            write_profile(hist_conf, build_profile(
+                root, fold_ctx(ctx_), dev_t, query=name,
+                source="bench",
+                extra={"bench": "nds_from_files",
+                       "runs_folded": RUNS_FOLDED}))
+        op_budget = {}
+        for _, doc in read_profiles(hist_dir):
+            runs = max(1, int(doc.get("runs_folded", 1)))
+            sinks = top_op_sinks(doc.get("ops", {}), n=5)
+            op_budget[doc.get("query", doc.get("profile_id", "?"))] = [
+                {"op": sk["op"],
+                 "time_ms": round(sk["time_s"] * 1e3 / runs, 1),
+                 "rows": int(sk["rows"] / runs)} for sk in sinks]
+        return op_budget
+    return round(geomean, 3), results, verify, chunks, finish_profiles
 
 
 def bench_nds_subset(n_sales=1 << 21, use_sql=True):
@@ -488,14 +543,17 @@ def main():
     # --- timed phase 0b: NDS from FILES (scan in the timed region) -------
     nds_files_dir = os.path.join(os.path.dirname(
         os.path.abspath(__file__)), ".bench_cache", "nds_parquet")
-    nds_files_geo, nds_files_detail, nds_files_verify, nds_chunks = \
-        bench_nds_from_files(nds_files_dir)
+    (nds_files_geo, nds_files_detail, nds_files_verify, nds_chunks,
+     nds_profiles_fn) = bench_nds_from_files(nds_files_dir)
     print(f"nds from-files: geomean {nds_files_geo}x host "
           "(pandas read_parquet + compute); "
           + "; ".join(f"{k} {v['vs_host']}x" for k, v in
                       nds_files_detail.items())
           + f"; chunks device={nds_chunks['device']} "
-          f"fallback={nds_chunks['fallback']}", file=sys.stderr)
+          f"fallback={nds_chunks['fallback']}; "
+          f"fused {nds_chunks['fused_dispatches']}/"
+          f"{nds_chunks['scan_programs']} scan programs",
+          file=sys.stderr)
 
     n = SF_ROWS
     cols = gen_lineitem(n)
@@ -662,6 +720,55 @@ def main():
                        "pallas_ms": round(ts_pal * 1e3, 3),
                        "pallas_over_xla": round(ts_xla / ts_pal, 3)}
 
+    # fused filter+partial-agg A/B (ISSUE 15c): the whole-stage-fusion
+    # PR moved the from-files hot loop into ONE program per batch doing
+    # filter->project->partial-agg — this measures whether a hand
+    # Pallas kernel beats the fused XLA chain ON THAT SHAPE (grouped
+    # partial reduction, not the global sum pallas_ab measured). Same
+    # falsifiability contract as the gather/sort A/Bs.
+    from spark_rapids_tpu.ops.pallas_kernels import (
+        FUSED_AGG_GROUPS, fused_filter_agg_pallas, fused_filter_agg_xla)
+    fa_key = jax.device_put(
+        (np.arange(pcap) % FUSED_AGG_GROUPS).astype(np.int32))
+    fa_args = (fa_key,) + pargs
+    fa_xla = jax.jit(fused_filter_agg_xla)
+    r_fxla = fa_xla(*fa_args)
+    r_fxla.block_until_ready()
+
+    def _tfa(fn):
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            fn(*fa_args).block_until_ready()
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[2]
+    tfa_xla = _tfa(fa_xla)
+    try:
+        r_fpal = fused_filter_agg_pallas(*fa_args, False)
+        r_fpal.block_until_ready()
+        fa_compiled = True
+    except Exception as e:  # noqa: BLE001 — recorded, not masked
+        fa_compiled = False
+        fused_agg_ab = {"xla_ms": round(tfa_xla * 1e3, 3),
+                        "status": "mosaic-rejected",
+                        "error": f"{type(e).__name__}: {str(e)[:120]}"}
+    if fa_compiled:
+        # float grouped sums: reduction ORDER differs between the tiled
+        # kernel and the XLA chain, so equality is a tolerance check —
+        # beyond-tolerance disagreement is WRONG-RESULT, not noise
+        ok = bool(jnp.all(jnp.abs(r_fxla - r_fpal)
+                          <= 1e-3 * jnp.maximum(jnp.abs(r_fxla), 1.0)))
+        if not ok:
+            fused_agg_ab = {"xla_ms": round(tfa_xla * 1e3, 3),
+                            "status": "WRONG-RESULT"}
+        else:
+            tfa_pal = _tfa(
+                lambda *a: fused_filter_agg_pallas(*a, False))
+            fused_agg_ab = {"xla_ms": round(tfa_xla * 1e3, 3),
+                            "pallas_ms": round(tfa_pal * 1e3, 3),
+                            "pallas_over_xla":
+                                round(tfa_xla / tfa_pal, 3)}
+
     # --- timed phase 2: FROM FILES (scan -> filter -> proj -> agg) -------
     # one scan exec per timed run would re-plan splits; splits are cheap
     # (footers cached by OS); build the plan once and re-execute.
@@ -718,6 +825,15 @@ def main():
         if "deviceChunks" in sm else 0
     q6_fb_chunks = int(sm["fallbackChunks"].value) \
         if "fallbackChunks" in sm else 0
+    # dispatch granularity (the whole-stage-fusion claim, counter-
+    # verified): scan_programs = programs dispatched by the scan this
+    # run, scan_fused_dispatches = how many ran decode+filter+project+
+    # partial-agg as ONE spliced program — equal counts mean every
+    # coalesced batch paid exactly one dispatch
+    q6_programs = int(sm["scanPrograms"].value) \
+        if "scanPrograms" in sm else 0
+    q6_fused = int(sm["fusedDispatches"].value) \
+        if "fusedDispatches" in sm else 0
 
     # --- timed phase 2b: observability overhead A/B (same pipeline) ------
     # The "cheap enough to leave always-on" claim of the flight
@@ -788,6 +904,36 @@ def main():
         max(0.0, lc_on_t / lc_off_t - 1.0), 4)
     print(f"lifecycle overhead: on {lc_on_t*1e3:.1f} ms vs off "
           f"{lc_off_t*1e3:.1f} ms -> {lifecycle_overhead_frac:.1%}",
+          file=sys.stderr)
+
+    # --- timed phase 2d: whole-stage fusion on/off A/B (same pipeline) ---
+    # The dispatch-granularity win, measured: the warm q6 from-parquet
+    # pipeline with stageFusion fully ON (scan-rooted splice: ONE
+    # program per coalesced batch) vs fully OFF (per-operator dispatch
+    # + a full HBM materialization of the decoded batch between scan
+    # and chain). Still upload-only; same warm jit caches discipline as
+    # the obs/lifecycle A/Bs (the OFF path compiles its own programs on
+    # its first run, which is excluded by the warm-up call).
+    ctx_fu_on = ExecCtx(_RC({}))
+    ctx_fu_off = ExecCtx(_RC(
+        {"spark.rapids.sql.stageFusion.enabled": "false"}))
+
+    def _time_fusion(c):
+        list(plan_files.execute(c))  # warm-up (compile for this mode)
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            o = list(plan_files.execute(c))
+            jax.block_until_ready(o)
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[1]
+    fu_on_t = _time_fusion(ctx_fu_on)
+    fu_off_t = _time_fusion(ctx_fu_off)
+    fusion_ab = {"fused_ms": round(fu_on_t * 1e3, 1),
+                 "unfused_ms": round(fu_off_t * 1e3, 1),
+                 "fused_speedup": round(fu_off_t / fu_on_t, 3)}
+    print(f"whole-stage fusion: fused {fu_on_t*1e3:.1f} ms vs unfused "
+          f"{fu_off_t*1e3:.1f} ms -> {fusion_ab['fused_speedup']}x",
           file=sys.stderr)
 
     # --- timed phase 3: join+group-by (q97/q72 shape), STILL pipelined ---
@@ -862,6 +1008,8 @@ def main():
     join_check(join_outs, host_join_out)
     nds_verify()
     nds_files_verify()
+    # profile fold + history write (does a readback — post-timing only)
+    nds_op_budget = nds_profiles_fn()
     if r_pal is not None:
         assert abs(float(r_xla) - float(r_pal)) <= \
             1e-3 * max(1.0, abs(float(r_xla))), \
@@ -920,6 +1068,23 @@ def main():
         "scan_fallback_chunks": q6_fb_chunks,
         "nds_scan_device_chunks": nds_chunks["device"],
         "nds_scan_fallback_chunks": nds_chunks["fallback"],
+        # whole-stage fusion (ISSUE 15): dispatch granularity on the
+        # from-files path, counter-verified — fused == programs means
+        # every coalesced batch ran decode+filter+project+partial-agg
+        # as ONE spliced XLA program (was >= 2 dispatches + an HBM
+        # round-trip of the decoded batch). fusion_ab is the measured
+        # on/off wall delta on the warm q6 pipeline; on CPU-only hosts
+        # (device_kind == "cpu") gate on the counters + bit-exactness,
+        # not the wall ratio (ROADMAP/acceptance rule).
+        "scan_programs": q6_programs,
+        "scan_fused_dispatches": q6_fused,
+        "nds_scan_programs": nds_chunks["scan_programs"],
+        "nds_scan_fused_dispatches": nds_chunks["fused_dispatches"],
+        "fusion_ab": fusion_ab,
+        # per-operator from-files time budget, mined from the query
+        # profiles this run wrote (PR 9 profile history): where each
+        # NDS from-files query actually spends its time, per operator
+        "nds_from_files_op_budget": nds_op_budget,
         "tunnel_upload_gbs": tunnel_gbs,
         "tunnel_upload_latency_ms": tunnel_latency_ms,
         # observability overhead audit (flight recorder + tracing fully
@@ -957,6 +1122,12 @@ def main():
         # OPEN for gather shapes, not answered.
         "pallas_ab": pallas_ab,
         "pallas_gather_ab": gather_ab,
+        # fused filter+partial-agg A/B (ISSUE 15c): a hand Pallas
+        # kernel vs the fused XLA chain on the whole-stage-fusion
+        # shape itself (grouped partial reduction) — same
+        # mosaic-rejected / WRONG-RESULT falsifiability as the
+        # gather/sort A/Bs
+        "pallas_fused_agg_ab": fused_agg_ab,
         # sort A/B (ROADMAP item 4): bitonic Pallas network vs
         # jax.lax.sort — the sort shape was never Mosaic-blocked
         "pallas_sort_ab": sort_ab,

@@ -111,10 +111,32 @@ VERIFY_PLAN = register(
 
 STAGE_FUSION = register(
     "spark.rapids.sql.stageFusion.enabled", True,
-    "Compose chains of per-batch operators (project/filter/aggregate "
-    "partial) into one XLA program per batch — the whole-stage-codegen "
-    "analog. Filters stay as lazy selection masks inside a fused stage "
-    "instead of paying stream compaction.")
+    "Compose chains of per-batch operators (project/filter/expand/"
+    "aggregate partial/exchange partition-key split) into one XLA "
+    "program per batch — the whole-stage-codegen analog. Filters stay "
+    "as lazy selection masks inside a fused stage instead of paying "
+    "stream compaction.")
+
+SCAN_STAGE_FUSION = register(
+    "spark.rapids.sql.stageFusion.scan.enabled", True,
+    "Extend whole-stage fusion THROUGH the parquet device-decode scan: "
+    "the downstream fused chain (filter -> project -> partial-agg "
+    "tail) is spliced into the fused-decode program, so each coalesced "
+    "row-group batch pays ONE program dispatch for "
+    "decode+filter+project+partial-agg instead of a decode dispatch "
+    "plus a chain dispatch (and skips the full-batch HBM "
+    "materialization between them). Requires stageFusion.enabled and "
+    "the parquet deviceDecode path; per-scan fusedDispatches/"
+    "scanPrograms metrics prove the dispatch count.")
+
+SCAN_FUSED_DONATE = register(
+    "spark.rapids.sql.scan.fused.donateInputs", True,
+    "Donate the staged decode blob (and the fused chain's uploaded "
+    "host-fallback/partition columns) into the fused-decode program "
+    "(jax donate_argnums): XLA reuses their HBM for the outputs "
+    "instead of holding input + output live across the dispatch — the "
+    "direct attack on scan-path HBM round-trips. Ignored on the CPU "
+    "backend (donation is unimplemented there and would only warn).")
 
 # --- Batching / memory ----------------------------------------------------
 BATCH_SIZE_BYTES = register(

@@ -59,6 +59,11 @@ def _unalias(e: Expression) -> Tuple[AggregateFunction, str]:
 class TpuHashAggregateExec(UnaryExec):
     """Sort-based group-by with partial/merge phases."""
 
+    FUSION_NOTE = ("barrier: grouped reduction ACROSS batches; the "
+                   "per-batch PARTIAL phase fuses as a chain tail "
+                   "(fused_batches tail_fn) — scan-rooted, "
+                   "decode->filter->project->partial-agg is one program")
+
     def __init__(self, group_exprs: Sequence[Expression],
                  agg_exprs: Sequence[Expression], child: TpuExec):
         super().__init__(child)

@@ -31,7 +31,7 @@ from ..expr.base import EvalCtx
 
 __all__ = ["ExecCtx", "TpuMetric", "TpuExec", "LeafExec", "UnaryExec",
            "HostBatchSourceExec", "OpContract", "collect_arrow",
-           "collect_arrow_cpu", "fused_batches"]
+           "collect_arrow_cpu", "fused_batches", "fn_content_key"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -380,12 +380,33 @@ class TpuExec:
         unknown)."""
         return None
 
+    #: Row-wise-map audit note rendered into SUPPORTED_OPS.md's stage-
+    #: fusion section: operators implementing ``device_fn`` are fusable
+    #: and need no note; every other operator states WHY it is a fusion
+    #: barrier (the audited reason, not an omission). The doc generator
+    #: reads this together with the live ``device_fn`` overrides, so the
+    #: published table cannot drift from the code (tpu-lint
+    #: --check-docs).
+    FUSION_NOTE: str = "barrier: not audited"
+
     def device_fn(self):
         """Pure per-batch device function `(TpuBatch, EvalCtx) -> TpuBatch`
         when this operator is a row-wise map over one batch (project,
-        filter-as-selection-mask) — the unit of stage fusion. None for
-        barriers (sort, aggregate, exchange) and multi-batch operators."""
+        filter-as-selection-mask, expand-as-traced-concat) — the unit of
+        stage fusion. None for barriers (sort, aggregate, exchange) and
+        multi-batch operators; barriers document why in ``FUSION_NOTE``.
+        Operators that fuse via a ``fused_batches`` *tail* instead
+        (aggregate's partial phase, the exchange writer's partition-key
+        split) also say so there."""
         return None
+
+    def fusion_content(self) -> str:
+        """Content string identifying this operator's per-batch
+        semantics for the fused-program cache key (``fn_content_key``).
+        Defaults to ``describe()``; operators whose describe() omits
+        semantics-bearing state (the exchange's partition key
+        expressions) override."""
+        return self.describe()
 
     def expressions(self) -> Sequence["object"]:
         """The expression trees this operator evaluates — walked by the
@@ -428,6 +449,40 @@ class TpuExec:
         return self.tree_string()
 
 
+def fn_content_key(f):
+    """Stable content key for one fused-chain callable: op class +
+    method name + the owner's semantic content string. Keyed on content,
+    not id(): after a planner rebuild a recycled id could silently hit a
+    stale program with different semantics. Identical keys imply
+    identical per-batch semantics, so sharing a compiled program is
+    correct — including across the global fused-decode cache the
+    scan-rooted splice uses (io/parquet_device.py)."""
+    owner = getattr(f, "__self__", None)
+    if owner is None:
+        return getattr(f, "__qualname__", repr(f))
+    content = getattr(owner, "fusion_content", None)
+    content = content() if content is not None else owner.describe()
+    return (type(owner).__qualname__, getattr(f, "__name__", ""), content)
+
+
+def _record_stage_time(ctx, metric, t0, out) -> None:
+    """opTime for a fused stage, honestly: under async dispatch the
+    wall-clock around the jitted call measures LAUNCH time, not compute
+    — so the (t0, output) pair is handed to the opmetrics collector's
+    completion watcher, which stamps the metric when the output is
+    actually ready (the deferred-readback idiom extended to time; no
+    sync on this thread). Launch cost stays visible as its own
+    ``dispatchTime`` metric. DEBUG metrics (sync_metrics) and disabled
+    opmetrics fall back to the synchronous wall-clock add."""
+    if metric is None:
+        return
+    opm = getattr(ctx, "opm", None)
+    if not ctx.sync_metrics and opm is not None \
+            and opm.defer_stage_time(metric, t0, out):
+        return
+    metric.value += time.perf_counter() - t0
+
+
 def fused_batches(consumer: TpuExec, ctx: ExecCtx, tail_fn=None,
                   metric: Optional[TpuMetric] = None) -> Iterator[TpuBatch]:
     """Stream the device batches feeding `consumer`, composing the chain of
@@ -435,33 +490,75 @@ def fused_batches(consumer: TpuExec, ctx: ExecCtx, tail_fn=None,
     `tail_fn` — into ONE jitted XLA program per batch: the
     whole-stage-codegen analog (reference: operator-at-a-time cudf calls;
     here XLA fuses the chain into one kernel schedule, eliding intermediate
-    HBM materialization). Falls back to per-op execution when
-    `spark.rapids.sql.stageFusion.enabled` is off."""
+    HBM materialization). When the chain bottoms out at a scan whose
+    device-decode path can splice the chain INTO its fused-decode program
+    (``fused_scan_execute``), the whole stage — parquet decode included —
+    runs as ONE dispatch per coalesced row-group batch. Falls back to
+    per-op execution when `spark.rapids.sql.stageFusion.enabled` is off.
+    Tails must be PURE per-batch functions: the OOM split-and-retry
+    wrapper may re-run them over batch halves, yielding each half as its
+    own stream item (the exchange writer's side effects therefore live
+    outside the tail, after the yield)."""
     import jax
 
     node = consumer.children[0]
     fns = []
+    fused_nodes = []
     if ctx.stage_fusion:
         while isinstance(node, UnaryExec) and node.device_fn() is not None:
             fns.append(node.device_fn())
+            fused_nodes.append(node)
             node = node.children[0]
         fns.reverse()
+        fused_nodes.reverse()
     if tail_fn is not None:
         fns.append(tail_fn)
     if not fns:
         yield from node.execute(ctx)
         return
+    key = tuple(fn_content_key(f) for f in fns)
+    label = consumer.node_label()
+    # fusion observability: every operator instance that executes inside
+    # this consumer's program records WHICH program (the consumer's
+    # stable op id) — a plain numeric metric, so it folds across
+    # snapshots/workers and EXPLAIN ANALYZE can render the membership
+    oid = getattr(consumer, "_op_id", None) or consumer._label_id
+    for fn_node in fused_nodes:
+        ctx.metric(fn_node, "fusedInto").set(oid)
+    ctx.metric(consumer, "fusedChainOps").set(len(fns))
+    dispatch_m = ctx.metric(consumer, "dispatchTime")
+    # scan-rooted splice: a leaf that can run the chain INSIDE its own
+    # fused-decode program declines with None when that path is off
+    scan_fused = getattr(node, "fused_scan_execute", None)
+    if scan_fused is not None and ctx.stage_fusion:
+        gen = scan_fused(ctx, tuple(fns), key)
+        if gen is not None:
+            ctx.metric(node, "fusedInto").set(oid)
+            try:
+                while True:
+                    try:
+                        out = next(gen)
+                    except StopIteration:
+                        return
+                    # the dispatch happened on the scan's feeder thread
+                    # (its uploadTime/uploadWaitTime account for launch
+                    # and wait) — the consumer's stage time starts at
+                    # HANDOVER and runs to output readiness, so it is
+                    # residual chain compute, not a re-count of the
+                    # scan's read/plan/upload wall
+                    t0 = time.perf_counter()
+                    with ctx.tracer.span(label, cat="op",
+                                         args={"fused": "scan"}):
+                        if ctx.sync_metrics and isinstance(out, TpuBatch):
+                            out.block_until_ready()
+                        _record_stage_time(ctx, metric, t0, out)
+                    yield out
+            finally:
+                # deterministic teardown: an early-closed consumer must
+                # close the scan's feeder pipeline (ledger releases,
+                # pool shutdown) now, not at GC time
+                gen.close()
     cache = consumer.__dict__.setdefault("_fused_jit_cache", {})
-    # key on stable content (op class + bound-expression describe), not
-    # id(): after a planner rebuild a recycled id could silently hit a
-    # stale program with different semantics. Identical keys imply
-    # identical per-batch semantics, so sharing the program is correct.
-    def _fn_key(f):
-        owner = getattr(f, "__self__", None)
-        if owner is None:
-            return getattr(f, "__qualname__", repr(f))
-        return (type(owner).__qualname__, owner.describe())
-    key = tuple(_fn_key(f) for f in fns)
     entry = cache.get(key)
     if entry is None:
         def composed(b, ectx):
@@ -475,23 +572,23 @@ def fused_batches(consumer: TpuExec, ctx: ExecCtx, tail_fn=None,
     jitted = entry[0]
     rows = ctx.metric(consumer, "numOutputRows") if ctx.sync_metrics \
         else None
-    label = consumer.node_label()
     for b in node.execute(ctx):
         with ctx.tracer.span(label, cat="op"):
             t0 = time.perf_counter()
-            # split-and-retry on device OOM: the fused stage re-runs over
-            # batch halves (memory.py; SURVEY.md §5.3 layer 3); the
-            # query context carries the per-query budget and the
+            # split-and-retry on device OOM: the fused stage re-runs
+            # over batch halves (memory.py; SURVEY.md §5.3 layer 3);
+            # the query context carries the per-query budget and the
             # degradation ladder above the halving
-            outs = ctx.mm.with_retry(b,
-                                     lambda bb: jitted(bb, ctx.eval_ctx),
-                                     qctx=getattr(ctx, "qctx", None))
+            outs = ctx.mm.with_retry(
+                b, lambda bb: jitted(bb, ctx.eval_ctx),
+                qctx=getattr(ctx, "qctx", None))
+            dispatch_m.value += time.perf_counter() - t0
             if ctx.sync_metrics:
                 for out in outs:
-                    out.block_until_ready()
-                    rows += out.num_rows  # syncs; DEBUG metrics only
-            if metric is not None:
-                metric.value += time.perf_counter() - t0
+                    if isinstance(out, TpuBatch):
+                        out.block_until_ready()
+                        rows += out.num_rows  # syncs; DEBUG metrics only
+            _record_stage_time(ctx, metric, t0, outs)
         yield from outs
 
 
@@ -516,6 +613,8 @@ class UnaryExec(TpuExec):
 class HostBatchSourceExec(LeafExec):
     """Leaf over in-memory host Arrow batches — the LocalTableScan analog
     and the entry point the JVM-side bridge feeds (Arrow C Data batches)."""
+
+    FUSION_NOTE = "chain root: source leaf — fusable chains begin above it"
 
     def __init__(self, batches: Sequence[pa.RecordBatch],
                  schema: Optional[dt.Schema] = None):
@@ -566,6 +665,8 @@ class HostBatchSourceExec(LeafExec):
 
 class DeviceBatchSourceExec(LeafExec):
     """Leaf over already-resident device batches (bench/internal use)."""
+
+    FUSION_NOTE = "chain root: source leaf — fusable chains begin above it"
 
     def __init__(self, batches: Sequence[TpuBatch], schema: dt.Schema):
         super().__init__()

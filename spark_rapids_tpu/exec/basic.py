@@ -136,6 +136,8 @@ class TpuRangeExec(LeafExec):
     """spark.range() source (GpuRangeExec analog): int64 sequence generated
     directly on device, split into bucketed batches."""
 
+    FUSION_NOTE = "chain root: source leaf — fusable chains begin above it"
+
     def __init__(self, start: int, end: int, step: int = 1,
                  max_rows_per_batch: int = 1 << 20, name: str = "id"):
         super().__init__()

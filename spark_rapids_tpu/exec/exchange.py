@@ -144,6 +144,32 @@ class TpuShuffleExchangeExec(UnaryExec):
                 return str(e)
         return None
 
+    #: stage-fusion audit: the exchange itself is a barrier, but its
+    #: writer's hash-partition KEY computation is a row-wise map and
+    #: fuses as the chain's tail (see ``materialize``)
+    FUSION_NOTE = ("barrier: repartitions rows across batches; the "
+                   "writer's partition-key split fuses as a chain TAIL "
+                   "(fused_batches tail_fn) — with a device-decode scan "
+                   "child, decode->chain->partition-ids is one program")
+
+    def fusion_content(self) -> str:
+        """describe() omits the partition key expressions; the fused
+        split program's content key must not (two exchanges hashing
+        different columns are different programs). Range partitionings
+        additionally bake their SAMPLED BOUNDS into the traced program
+        — identical keys with different bounds are different programs,
+        so the bounds values join the content key too (the scan-spliced
+        cache is process-global; a collision would silently route rows
+        by another exchange's bounds)."""
+        key_exprs = getattr(self.partitioning, "key_exprs", None) or \
+            [o.child for o in getattr(self.partitioning, "orders", [])]
+        content = (f"{self.describe()} keys="
+                   f"[{', '.join(map(repr, key_exprs))}]")
+        bounds = getattr(self.partitioning, "bounds", None)
+        if bounds is not None:  # List[tuple] of host key values
+            content += f" bounds={bounds!r}"
+        return content
+
     def _split(self, batch: TpuBatch, ectx):
         """All partitions in ONE traced call: compute pids once, emit one
         selection-masked view per partition. The views share the input's
@@ -156,6 +182,21 @@ class TpuShuffleExchangeExec(UnaryExec):
 
     def _pids(self, batch: TpuBatch, ectx):
         return self.partitioning.partition_ids_device(batch, ectx)
+
+    def _split_tail(self, batch: TpuBatch, ectx):
+        """Fused-chain tail for the map phase: the upstream chain's
+        output batch plus its per-partition selection views, all from
+        ONE program."""
+        return (batch, self._split(batch, ectx))
+
+    def _pids_tail(self, batch: TpuBatch, ectx):
+        """write_unsplit transports: (batch, partition ids) tail."""
+        return (batch, self._pids(batch, ectx))
+
+    def _single_tail(self, batch: TpuBatch, ectx):
+        """n == 1: the whole batch IS the partition — no pids/views
+        computed (they would be dead program outputs XLA cannot DCE)."""
+        return (batch, None)
 
     def materialize(self, ctx: ExecCtx) -> "ShuffleStageHandle":
         """Run the WRITE phase (map side) and return a handle exposing the
@@ -175,15 +216,53 @@ class TpuShuffleExchangeExec(UnaryExec):
             # syncs (spark.rapids.sql.adaptive.freeStatsOnly stays safe)
             from ..config import ADAPTIVE_ENABLED
             transport.set_stats_recording(ctx.conf.get(ADAPTIVE_ENABLED))
-        if self._jit_split is None:
-            fn = self._pids if unsplit else self._split
-            self._jit_split = jax.jit(fn, static_argnums=1)
         n = self.partitioning.num_partitions
         sid = next(_shuffle_ids)
         transport.register_shuffle(sid, n)
         op_time = ctx.metric(self, "opTime")
         rows = ctx.metric(self, "numPartitions")
         rows.set(n)
+        from ..shuffle.partitioner import RangePartitioning
+        needs_bounds = isinstance(self.partitioning, RangePartitioning) \
+            and self.partitioning.bounds is None
+        if not needs_bounds:
+            # the partition-KEY computation is a row-wise map: fuse it
+            # as the tail of the chain feeding this exchange
+            # (fused_batches), so filter/project — and, scan-rooted,
+            # the parquet decode itself — land in ONE program with the
+            # pids/split. OOM split-and-retry stays on: the tail is
+            # pure (pids/views only — the writer's side effects happen
+            # AFTER the yield), so a halved retry simply yields each
+            # half as its own map task
+            from .base import fused_batches
+            if unsplit:
+                tail = self._pids_tail
+            elif n == 1:
+                tail = self._single_tail
+            else:
+                tail = self._split_tail
+            stream = fused_batches(self, ctx, tail_fn=tail,
+                                   metric=op_time)
+            # writer wall goes to its OWN metric: op_time is stamped by
+            # the opmetrics completion watcher for the fused chain, and
+            # a second same-metric writer on this thread would race it
+            write_t = ctx.metric(self, "writeTime")
+            for map_id, (batch, split) in enumerate(stream):
+                writer = transport.writer(sid, map_id)
+                t0 = time.perf_counter()
+                if unsplit:
+                    writer.write_unsplit(batch, split)
+                elif n == 1:
+                    writer.write(0, batch)
+                else:
+                    for p in range(n):
+                        writer.write(p, split[p])
+                write_t.value += time.perf_counter() - t0
+                writer.close()
+            return ShuffleStageHandle(transport, sid, n)
+        if self._jit_split is None:
+            fn = self._pids if unsplit else self._split
+            self._jit_split = jax.jit(fn, static_argnums=1)
         source = self._with_range_bounds_device(ctx)
         for map_id, batch in enumerate(source):
             writer = transport.writer(sid, map_id)
@@ -340,6 +419,9 @@ class TpuBroadcastExchangeExec(UnaryExec):
         notes="materializes the whole child device-resident as the "
               "build-side table")
 
+    FUSION_NOTE = ("barrier: materializes/concatenates the WHOLE child "
+                   "(cross-batch), optionally through an ICI collective")
+
     def __init__(self, child: TpuExec, mesh=None, axis: str = "x"):
         super().__init__(child)
         self.mesh = mesh
@@ -402,6 +484,9 @@ class TpuCoalesceBatchesExec(UnaryExec):
     CONTRACT = OpContract(
         schema_preserving=True,
         notes="concatenates small batches; row values unchanged")
+
+    FUSION_NOTE = ("barrier: multi-batch operator — output batches "
+                   "combine SEVERAL input batches (size-driven concat)")
 
     def __init__(self, child: TpuExec, target_rows: int = 1 << 17):
         super().__init__(child)

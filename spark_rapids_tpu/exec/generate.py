@@ -71,6 +71,12 @@ class TpuGenerateExec(UnaryExec):
     """explode(expr) appending element column(s) to the child's columns
     (Spark's Generate with requiredChildOutput = full child output)."""
 
+    FUSION_NOTE = ("barrier: audited for row-wise-map form — none "
+                   "exists on this envelope: explode's output "
+                   "capacity is data-dependent (array lengths), so "
+                   "stages A/B/C need host syncs for capacity "
+                   "bucketing between programs")
+
     def __init__(self, generator: Expression, child: TpuExec,
                  outer: bool = False, position: bool = False,
                  element_name: str = "col", pos_name: str = "pos"):

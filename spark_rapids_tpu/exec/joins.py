@@ -70,6 +70,10 @@ def _and_sel(batch: TpuBatch, mask):
 class _BaseJoinExec(TpuExec):
     """Shared staged-join execution over a built right side."""
 
+    FUSION_NOTE = ("barrier: two-input operator (build side "
+                   "materializes; probe output size is data-dependent "
+                   "— staged kernels with capacity syncs)")
+
     def __init__(self, left_keys: Sequence[Expression],
                  right_keys: Sequence[Expression], join_type: str,
                  left: TpuExec, right: TpuExec,

@@ -26,6 +26,9 @@ class TpuUnionExec(TpuExec):
     """UNION ALL: children's batches streamed in child order. Children
     must share the output schema (the DataFrame layer inserts casts)."""
 
+    FUSION_NOTE = ("barrier: multi-child operator — each child's "
+                   "stream is its own fusable chain")
+
     def __init__(self, children: Sequence[TpuExec]):
         super().__init__()
         if not children:
@@ -129,6 +132,39 @@ class TpuExpandExec(UnaryExec):
         return TpuBatch(cols, self._schema, batch.row_count,
                         selection=batch.selection)
 
+    def _run_all(self, batch: TpuBatch, ectx) -> TpuBatch:
+        """Every projection over one batch as ONE traced map (the
+        row-wise-map form stage fusion composes): compact the input
+        once (traced — sort-based, no host sync), project each list,
+        and concatenate the projected batches with the sync-free
+        capacity-sum bound. Output capacity is static (projections x
+        input capacity) and the multiset equals the per-projection
+        ``execute`` path's — Spark's Expand contract is row-interleaved
+        output whose ORDER downstream aggregation never depends on."""
+        from ..columnar.batch import bucket_bytes, bucket_rows
+        from ..ops.concat import concat_device
+        from ..ops.gather import ensure_compacted
+        batch = ensure_compacted(batch)
+        parts = [self._project(tuple(p), batch, ectx)
+                 for p in self.projections]
+        out_cap = bucket_rows(len(parts) * batch.capacity)
+        char_caps = []
+        for ci in range(len(self._schema)):
+            c = parts[0].columns[ci]
+            if c.is_string_like:
+                char_caps.append(bucket_bytes(max(sum(
+                    p.columns[ci].chars.shape[0] for p in parts), 1)))
+            else:
+                char_caps.append(0)
+        return concat_device(parts, out_cap, char_caps)
+
+    def device_fn(self):
+        """Expand IS a row-wise map once all projections emit into one
+        batch (``_run_all``) — the audit's answer for the
+        ROLLUP/CUBE backbone, so a partial aggregate above an expand
+        fuses expand+partial into one program (and through the scan)."""
+        return self._run_all
+
     def execute(self, ctx: ExecCtx):
         from functools import partial
         op_time = ctx.metric(self, "opTime")
@@ -158,6 +194,10 @@ class TpuSampleExec(UnaryExec):
     dual-run harness compares exactly (Spark's XORShift sampler is
     per-partition-seeded and not bit-matched here; the row DISTRIBUTION
     contract is)."""
+
+    FUSION_NOTE = ("barrier: row selection depends on GLOBAL row "
+                   "positions accumulated across batches (host-side "
+                   "running offset), not on one batch alone")
 
     def __init__(self, fraction: float, seed: int, child: TpuExec):
         super().__init__(child)

@@ -23,7 +23,7 @@ from ..expr.base import Expression, bind_expr
 from ..ops.concat import concat_batches
 from ..ops.gather import gather_batch
 from ..ops.sort_keys import SortSpec, sort_permutation
-from .base import ExecCtx, OpContract, TpuExec, UnaryExec
+from .base import ExecCtx, OpContract, TpuExec, UnaryExec, fused_batches
 
 __all__ = ["SortOrder", "TpuSortExec", "TpuLocalLimitExec",
            "TpuGlobalLimitExec", "TpuTopNExec", "sort_batch_by",
@@ -117,6 +117,10 @@ class TpuSortExec(UnaryExec):
     CONTRACT = OpContract(
         schema_preserving=True,
         notes="reorders rows only; sort keys must be primitive")
+
+    FUSION_NOTE = ("barrier: total order is a cross-batch property "
+                   "(global merge / out-of-core runs); the TopN "
+                   "pre-pass fuses instead (_PerBatchTopN.device_fn)")
 
     def __init__(self, orders: Sequence[SortOrder], child: TpuExec,
                  global_sort: bool = True):
@@ -356,6 +360,10 @@ class TpuLocalLimitExec(UnaryExec):
     CONTRACT = OpContract(schema_preserving=True,
                           notes="truncates the stream; schema unchanged")
 
+    FUSION_NOTE = ("barrier: the remaining-rows counter is state "
+                   "carried ACROSS batches (device-resident cumsum + "
+                   "periodic sync)")
+
     _SYNC_EVERY = 8
 
     def __init__(self, limit: int, child: TpuExec):
@@ -416,25 +424,37 @@ class TpuGlobalLimitExec(TpuLocalLimitExec):
 
 class _PerBatchTopN(UnaryExec):
     """Sort each incoming batch and truncate it to `limit` rows — the
-    pre-pass that bounds TopN's global merge to O(batches * limit)."""
+    pre-pass that bounds TopN's global merge to O(batches * limit).
+    Per-batch sort+truncate is a pure batch->batch map, so it both
+    EXPOSES a ``device_fn`` (chains above fuse through it) and fuses
+    the chain BELOW it into its own program via ``fused_batches`` —
+    scan-rooted, TopN-over-scan runs decode->filter->project->topN as
+    one dispatch per coalesced batch."""
 
     def __init__(self, limit: int, orders: Sequence[SortOrder],
                  child: TpuExec):
         super().__init__(child)
         self.limit = limit
         self.orders = orders  # already bound by the owning TpuTopNExec
-        self._jitted = None
 
     def describe(self):
         return f"PerBatchTopN [{self.limit}]"
 
+    def fusion_content(self) -> str:
+        # describe() omits the sort keys; the fused-program content key
+        # must not
+        return (f"{self.describe()} orders="
+                f"[{', '.join(repr(o) for o in self.orders)}]")
+
+    def _run(self, batch, ectx):
+        return sort_batch_by(batch, tuple(self.orders), ectx, self.limit)
+
+    def device_fn(self):
+        return self._run
+
     def execute(self, ctx: ExecCtx):
-        if self._jitted is None:
-            self._jitted = jax.jit(sort_batch_by,
-                                   static_argnums=(1, 2, 3))
-        orders = tuple(self.orders)
-        for batch in self.child.execute(ctx):
-            yield self._jitted(batch, orders, ctx.eval_ctx, self.limit)
+        yield from fused_batches(self, ctx, tail_fn=self._run,
+                                 metric=ctx.metric(self, "opTime"))
 
     def execute_cpu(self, ctx: ExecCtx):
         for rb in self.child.execute_cpu(ctx):
@@ -448,6 +468,10 @@ class _PerBatchTopN(UnaryExec):
 class TpuTopNExec(UnaryExec):
     """Take-ordered(-and-project): per-batch top-N, global merge sort,
     limit, optional projection (GpuTopN / GpuTakeOrderedAndProjectExec)."""
+
+    FUSION_NOTE = ("delegating wrapper over its internal pre-topN -> "
+                   "sort -> limit pipeline; the per-batch pre-pass "
+                   "fuses with the chain below it (_PerBatchTopN)")
 
     def __init__(self, limit: int, orders: Sequence[SortOrder],
                  child: TpuExec,

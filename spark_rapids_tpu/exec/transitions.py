@@ -26,6 +26,9 @@ class DeviceToHostExec(UnaryExec):
     CONTRACT = OpContract(schema_preserving=True,
                           notes="device->host transition; values unchanged")
 
+    FUSION_NOTE = ("barrier: device->host boundary — batches leave the "
+                   "device here, there is no device map to fuse")
+
     def execute(self, ctx: ExecCtx):
         # the planner places this node under CPU parents only; a device
         # parent calling execute() means the tree was mis-planned — fail
@@ -50,6 +53,10 @@ class HostToDeviceExec(UnaryExec):
 
     CONTRACT = OpContract(schema_preserving=True,
                           notes="host->device transition; values unchanged")
+
+    FUSION_NOTE = ("chain root: uploads a CPU island's Arrow batches — "
+                   "fusable chains begin above it (its input is host "
+                   "data, not a device batch)")
 
     def execute(self, ctx: ExecCtx):
         t = ctx.metric(self, "uploadTime")

@@ -158,6 +158,10 @@ class TpuWindowExec(UnaryExec):
     spec; output = child columns (in sorted order) + one column per
     window expression."""
 
+    FUSION_NOTE = ("barrier: window partitions span batches — the "
+                   "operator concatenates its whole input before the "
+                   "partition sort")
+
     def __init__(self, window_exprs: Sequence[Expression], child: TpuExec):
         super().__init__(child)
         self.win_exprs: List[WindowExpression] = []

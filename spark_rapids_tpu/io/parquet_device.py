@@ -1097,7 +1097,10 @@ def _lane_of(name: str):
 def decode_row_group_device(plans: Dict[str, Tuple[ChunkPlan, dt.DataType]],
                             capacity: int,
                             timers: Optional[Dict[str, float]] = None,
-                            mm=None) -> Dict[str, TpuColumnVector]:
+                            mm=None, chain=None, chain_key=None,
+                            schema: Optional[dt.Schema] = None,
+                            extra_cols=None, row_count=None,
+                            ectx=None, donate: bool = False):
     """Decode every device-eligible chunk of a row group with ONE
     host->device transfer and ONE program dispatch: all encoded segments
     (packed streams, run tables, dictionaries, def levels) concatenate
@@ -1120,7 +1123,26 @@ def decode_row_group_device(plans: Dict[str, Tuple[ChunkPlan, dt.DataType]],
     DeviceMemoryManager) takes a transient ledger reservation for the
     encoded blob while the upload + dispatch are in flight, so the
     staging bytes the widened envelope ships (string stores, delta
-    streams) are visible to eviction pressure and the HBM timeline."""
+    streams) are visible to eviction pressure and the HBM timeline.
+
+    **Composable epilogue (scan-rooted whole-stage fusion).** With
+    ``chain`` (a tuple of pure ``(TpuBatch, EvalCtx) -> pytree``
+    callables — the downstream filter/project/partial-agg device_fn
+    chain plus the consumer's tail), the fused program additionally
+    assembles the decoded columns — together with ``extra_cols``
+    (already-device-resident host-fallback / partition / null columns)
+    — into a ``TpuBatch`` over ``schema`` with traced ``row_count``,
+    and applies the chain INSIDE the same XLA program: decode ->
+    filter -> project -> partial-agg is ONE dispatch with no
+    full-batch HBM materialization in between, and the return value is
+    the chain's output pytree instead of the column dict. The JIT
+    cache is keyed on the quantized arena key x ``chain_key`` (the
+    chain's content key from ``exec.base.fn_content_key``), so
+    heterogeneous row groups of one schema x one chain stay at a
+    handful of compiled variants. ``donate`` donates the staged blob
+    (and the chain's extra columns) into the program — XLA reuses
+    their HBM for outputs instead of holding both live (skip on the
+    CPU backend, where donation is unimplemented)."""
     import time
 
     import jax
@@ -1179,11 +1201,19 @@ def decode_row_group_device(plans: Dict[str, Tuple[ChunkPlan, dt.DataType]],
         buf[start:start + arr.shape[0]] = arr
     view = buf[:total]
     cap = capacity
-    key = ("rg", cap, total, tuple(spec))
+    eng_dtypes = [plans[n][1] for n in names]
+    if chain is not None:
+        schema_sig = tuple((f.name, f.dtype.simple_string(), f.nullable)
+                           for f in schema.fields)
+        extra_names = tuple(extra_cols) if extra_cols else ()
+        key = ("rgc", cap, total, tuple(spec), chain_key, extra_names,
+               schema_sig, bool(donate))
+    else:
+        key = ("rg", cap, total, tuple(spec), bool(donate))
     with _JIT_LOCK:  # one compile per key even across feeder threads
         fn = _JIT_CACHE.get(key)
         if fn is None:
-            def build(b, nr):
+            def decode_cols(b, nr):
                 outs = []
                 for j, (lane_s, eng_s, w_off, w_len, t_off, t_n, dw_off,
                         dw_len, dt_off, dt_n, d_off, d_n,
@@ -1237,7 +1267,45 @@ def decode_row_group_device(plans: Dict[str, Tuple[ChunkPlan, dt.DataType]],
                         vals = vals.astype(np.dtype(eng_s))
                     outs.append((vals, valid))
                 return tuple(outs)
-            fn = jax.jit(build)
+
+            def decoded_vectors(b, nr):
+                """Decoded columns as TpuColumnVectors, by name."""
+                cols = {}
+                for name_, eng_dtype, out in zip(
+                        names, eng_dtypes, decode_cols(b, nr)):
+                    if len(out) == 3:
+                        offsets, chars, valid = out
+                        cols[name_] = TpuColumnVector(
+                            eng_dtype, validity=valid, offsets=offsets,
+                            chars=chars)
+                    else:
+                        vals, valid = out
+                        cols[name_] = TpuColumnVector(
+                            eng_dtype, data=vals, validity=valid)
+                return cols
+
+            if chain is not None:
+                chain_fns = tuple(chain)
+                out_schema = schema
+                enames = tuple(extra_cols) if extra_cols else ()
+
+                def build(b, nr, rc, extra, e):
+                    from ..columnar.batch import TpuBatch
+                    cols = decoded_vectors(b, nr)
+                    cols.update(zip(enames, extra))
+                    batch = TpuBatch(
+                        [cols[f.name] for f in out_schema.fields],
+                        out_schema, rc)
+                    for f in chain_fns:
+                        batch = f(batch, e)
+                    return batch
+                fn = jax.jit(build, static_argnums=4,
+                             donate_argnums=(0, 3) if donate else ())
+            else:
+                def build(b, nr):
+                    return tuple(decode_cols(b, nr))
+                fn = jax.jit(build,
+                             donate_argnums=(0,) if donate else ())
             _JIT_CACHE[key] = fn
     t_up0 = time.perf_counter()
     import contextlib
@@ -1245,7 +1313,12 @@ def decode_row_group_device(plans: Dict[str, Tuple[ChunkPlan, dt.DataType]],
         and hasattr(mm, "transient_reservation") else contextlib.nullcontext()
     with charge:
         blob = jax.device_put(view)
-        outs = fn(blob, jnp.asarray(np.asarray(nrs, np.int64)))
+        nr_dev = jnp.asarray(np.asarray(nrs, np.int64))
+        if chain is not None:
+            extras = tuple((extra_cols or {}).values())
+            outs = fn(blob, nr_dev, np.int32(row_count), extras, ectx)
+        else:
+            outs = fn(blob, nr_dev)
     _STAGING.pending = outs  # arena reusable once the decode ran
     t_up1 = time.perf_counter()
     if timers is not None:
@@ -1253,6 +1326,8 @@ def decode_row_group_device(plans: Dict[str, Tuple[ChunkPlan, dt.DataType]],
             + max(0.0, t_up0 - t_asm0 - reuse_wait)
         timers["upload"] = timers.get("upload", 0.0) \
             + (t_up1 - t_up0) + reuse_wait
+    if chain is not None:
+        return outs  # the chain's output pytree (ONE dispatch, fused)
     result = {}
     for name, (plan, eng_dtype), out in zip(
             names, [plans[n] for n in names], outs):

@@ -517,6 +517,13 @@ class TpuFileScanExec(LeafExec):
     def pretty_name(self):
         return "FileScanExec"
 
+    #: stage-fusion audit (SUPPORTED_OPS.md): leaves are chain ROOTS,
+    #: and this one splices the chain into its own program
+    FUSION_NOTE = ("chain root: the device-decode path splices the "
+                   "downstream fused chain into its fused-decode "
+                   "program (`fused_scan_execute`) — ONE dispatch per "
+                   "coalesced row-group batch for decode+chain")
+
     def tpu_supported(self) -> Optional[str]:
         # nested columns ride the arrow bridge to the device since
         # round 4 (VERDICT r3 item 6); per-operator gates above the scan
@@ -678,13 +685,25 @@ class TpuFileScanExec(LeafExec):
                 tuple(fb_reasons))
 
     def _assemble_device_batch(self, n_rows, plans, host_rb, part_vals,
-                               timers=None, mm=None):
+                               timers=None, mm=None, chain=None,
+                               chain_key=None, ectx=None,
+                               donate=False):
         """Feeder side: ONE fused decode dispatch for every planned
         column + uploads for host-fallback/partition columns, then the
         TpuBatch (all async — no host sync). ``timers`` accumulates the
         assemble/upload split (decode_row_group_device contributes its
         own; the per-column uploads here add to "upload"); ``mm`` lets
-        the decode take its transient staging-blob ledger charge."""
+        the decode take its transient staging-blob ledger charge.
+
+        With ``chain`` (scan-rooted whole-stage fusion), the
+        host-fallback / partition / schema-evolution columns upload
+        FIRST and ride the fused-decode program as inputs, the batch is
+        assembled and the chain applied INSIDE that program, and the
+        return value's first element is the chain's output pytree —
+        still exactly ONE program dispatch per coalesced group. The
+        trailing ``fused`` flag says whether the splice really happened
+        (False on the no-device-column degenerate group, which pays a
+        separate chain program)."""
         from .parquet_device import decode_row_group_device
         from ..columnar.batch import bucket_rows
         from ..columnar.arrow_bridge import arrow_column_to_device
@@ -702,14 +721,12 @@ class TpuFileScanExec(LeafExec):
                 lane = plan.lane
                 decoded += n_rows * (1 if lane == bool else lane.itemsize)
                 decoded += plan.str_char_cap
-        dev_cols = decode_row_group_device(typed, cap, timers, mm=mm) \
-            if typed else {}
-        up_s = 0.0
-        cols = []
-        for fld in self._schema.fields:
-            if fld.name in dev_cols:
-                cols.append(dev_cols[fld.name])
-                continue
+
+        def other_column(fld):
+            """A non-device-planned column as a device TpuColumnVector
+            (partition constant, host-fallback decode, or nulls),
+            upload accounted to the transfer side."""
+            nonlocal up_s
             if fld.name in part_fields:
                 v = (part_vals or {}).get(fld.name)
                 arr = pa.array([v] * n_rows, type=dt.to_arrow(fld.dtype))
@@ -720,15 +737,56 @@ class TpuFileScanExec(LeafExec):
                 if arr.type != dt.to_arrow(fld.dtype):
                     arr = arr.cast(dt.to_arrow(fld.dtype))
             else:
-                cols.append(TpuColumnVector.nulls(fld.dtype, cap))
-                continue
+                return TpuColumnVector.nulls(fld.dtype, cap)
             t0 = time.perf_counter()
-            cols.append(arrow_column_to_device(arr, fld.dtype, cap))
+            col = arrow_column_to_device(arr, fld.dtype, cap)
             up_s += time.perf_counter() - t0
+            return col
+
+        up_s = 0.0
+        if chain is not None and typed:
+            extra = {fld.name: other_column(fld)
+                     for fld in self._schema.fields
+                     if fld.name not in typed}
+            out = decode_row_group_device(
+                typed, cap, timers, mm=mm, chain=chain,
+                chain_key=chain_key, schema=self._schema,
+                extra_cols=extra, row_count=n_rows, ectx=ectx,
+                donate=donate)
+            if timers is not None:
+                timers["upload"] = timers.get("upload", 0.0) + up_s
+            return out, encoded, decoded, "fused"
+        dev_cols = decode_row_group_device(typed, cap, timers, mm=mm,
+                                           donate=donate) \
+            if typed else {}
+        cols = [dev_cols[fld.name] if fld.name in dev_cols
+                else other_column(fld) for fld in self._schema.fields]
         if timers is not None:
             timers["upload"] = timers.get("upload", 0.0) + up_s
         from ..columnar.batch import TpuBatch
-        return TpuBatch(cols, self._schema, n_rows), encoded, decoded
+        batch = TpuBatch(cols, self._schema, n_rows)
+        if chain is not None:
+            # degenerate group (every column host-decoded): the chain
+            # still runs as ONE jitted program over the uploaded batch,
+            # just not spliced into a decode program
+            batch = self._chain_only(chain, chain_key, cap, batch, ectx)
+            return batch, encoded, decoded, "chain"
+        return batch, encoded, decoded, "decode" if dev_cols else "none"
+
+    def _chain_only(self, chain, chain_key, cap, batch, ectx):
+        cache = self.__dict__.setdefault("_chain_jit_cache", {})
+        key = (chain_key, cap)
+        fn = cache.get(key)
+        if fn is None:
+            import jax
+            fns = tuple(chain)
+
+            def composed(b, e):
+                for f in fns:
+                    b = f(b, e)
+                return b
+            fn = cache[key] = jax.jit(composed, static_argnums=1)
+        return fn(batch, ectx)
 
     # --- coalescing (device-decode path) ----------------------------------
 
@@ -829,14 +887,41 @@ class TpuFileScanExec(LeafExec):
         reasons = tuple(r for g in group for r in g[4])
         return n_rows, plans, host_rb, group[0][3], reasons
 
-    def _execute_device_decode(self, ctx: ExecCtx):
+    def fused_scan_execute(self, ctx: ExecCtx, fns, chain_key):
+        """Scan-rooted whole-stage fusion entry (``exec.base.
+        fused_batches``): return a generator whose batches are the
+        CHAIN's outputs, with decode -> chain spliced into ONE XLA
+        program per coalesced row-group batch — or None to decline
+        (device decode off, scan fusion off), in which case the caller
+        falls back to its own per-batch chain program over this scan's
+        ordinary output."""
+        from ..config import SCAN_STAGE_FUSION
+        if not self._use_device_decode(ctx.conf) \
+                or not ctx.conf.get(SCAN_STAGE_FUSION):
+            return None
+        # spliced dispatches have no OOM split-and-retry (the decode
+        # path never had one): under existing memory pressure, decline
+        # the splice so the chain stays in the caller's retryable
+        # per-batch program and the degradation ladder keeps its grip
+        mm = getattr(ctx, "mm", None)
+        if mm is not None and mm.device_bytes > mm.budget // 2:
+            return None
+        return self._execute_device_decode(ctx, chain=tuple(fns),
+                                           chain_key=chain_key)
+
+    def _execute_device_decode(self, ctx: ExecCtx, chain=None,
+                               chain_key=None):
         """The overlapped upload tunnel: row-group planning runs on the
         reader pool, blob assembly + device_put + fused-decode dispatch
         run on upload feeder thread(s) a bounded window ahead, and the
         consumer computes on batch N while batch N+1 crosses the link —
         the same feeder shape the legacy arrow path has, generalized
         through pipeline.pipelined_map. In-flight batches are registered
-        with the device memory ledger until the consumer takes them."""
+        with the device memory ledger until the consumer takes them.
+        With ``chain`` (see ``fused_scan_execute``) the feeder
+        dispatches the spliced decode+chain program and yields the
+        chain's outputs; ``fusedDispatches``/``scanPrograms`` count the
+        programs so the dispatch-granularity claim is verifiable."""
         conf = ctx.conf
         rows = ctx.metric(self, "numOutputRows")
         scan_t = ctx.metric(self, "scanTime")
@@ -847,6 +932,19 @@ class TpuFileScanExec(LeafExec):
         dec_m = ctx.metric(self, "decodedBytes")
         dev_chunks_m = ctx.metric(self, "deviceChunks")
         fb_chunks_m = ctx.metric(self, "fallbackChunks")
+        # dispatch-granularity observability: scanPrograms counts every
+        # program this scan dispatches (decode or chain), and
+        # fusedDispatches the ones where decode+chain ran as ONE
+        # spliced program — the counter the fusion smoke/bench gate on
+        programs_m = ctx.metric(self, "scanPrograms")
+        fused_m = ctx.metric(self, "fusedDispatches")
+        from ..config import SCAN_FUSED_DONATE
+        donate = conf.get(SCAN_FUSED_DONATE)
+        if donate:
+            import jax
+            # CPU backend: donation is unimplemented — donating would
+            # only emit a warning per dispatch, never reuse memory
+            donate = jax.default_backend() != "cpu"
         tasks = self._device_rg_tasks()
         if not tasks:
             return
@@ -893,22 +991,30 @@ class TpuFileScanExec(LeafExec):
             dev_chunks = sum(len(g[1]) for g in group)
             n_rows, plans, host_rb, part_vals, fb_reasons = \
                 self._merge_planned(group)
-            batch, encoded, decoded = self._assemble_device_batch(
+            batch, encoded, decoded, prog = self._assemble_device_batch(
                 n_rows, plans, host_rb, part_vals, timers=timers,
-                mm=mgr)
+                mm=mgr, chain=chain, chain_key=chain_key,
+                ectx=ctx.eval_ctx, donate=donate)
             # whatever the wall spent that was not attributed to the
             # transfer side is host assembly (merge, arena build, arrow
             # prep)
             timers["assemble"] = max(
                 0.0, time.perf_counter() - t0 - timers["upload"])
-            sb = mgr.register(batch, pinned=True)
+            # chain outputs that are not batches (the exchange's
+            # (batch, split) tail tuples) skip the in-flight ledger
+            # charge — the window bound still caps their residency
+            from ..columnar.batch import TpuBatch
+            sb = mgr.register(batch, pinned=True) \
+                if isinstance(batch, TpuBatch) else None
             with ilock:
                 if closed[0]:  # consumer already gone: never delivered
-                    sb.release()
+                    if sb is not None:
+                        sb.release()
                     return None
-                inflight.add(sb)
+                if sb is not None:
+                    inflight.add(sb)
             return (batch, sb, n_rows, encoded, decoded, timers,
-                    dev_chunks, fb_reasons)
+                    dev_chunks, fb_reasons, prog)
 
         groups = self._coalesced_groups(planned(), target_bytes, max_rows)
         # the in-flight window is bounded in decoded BYTES too: string
@@ -932,7 +1038,7 @@ class TpuFileScanExec(LeafExec):
                     break
                 wait_t.value += time.perf_counter() - t0
                 (batch, sb, n_rows, encoded, decoded, timers,
-                 dev_chunks, fb_reasons) = item
+                 dev_chunks, fb_reasons, prog) = item
                 asm_t.value += timers["assemble"]
                 up_t.value += timers["upload"]
                 SCAN_ASSEMBLE_SECONDS.labels("device").observe(
@@ -943,14 +1049,26 @@ class TpuFileScanExec(LeafExec):
                 dec_m.value += decoded
                 dev_chunks_m.value += dev_chunks
                 fb_chunks_m.value += len(fb_reasons)
+                if prog != "none":
+                    programs_m.value += 1
+                if prog == "fused":
+                    fused_m.value += 1
                 if dev_chunks:
                     SCAN_DEVICE_CHUNKS.inc(dev_chunks)
                 for r in fb_reasons:
                     SCAN_FALLBACK_CHUNKS.labels(r).inc()
                 rows.value += n_rows
-                with ilock:
-                    inflight.discard(sb)
-                sb.release()  # the consumer owns the batch now
+                if chain is not None:
+                    # the scan's execute() shim never runs on the fused
+                    # path — keep its rows/batches accounting honest
+                    # (rows = file rows INTO the fused program; the
+                    # chain's output rows belong to the consumer)
+                    ctx.metric(self, "rows").value += n_rows
+                    ctx.metric(self, "batches").value += 1
+                if sb is not None:
+                    with ilock:
+                        inflight.discard(sb)
+                    sb.release()  # the consumer owns the batch now
                 yield batch
         finally:
             gen.close()

@@ -137,6 +137,9 @@ class TpuFileWriteExec(UnaryExec):
     analog). Yields no batches — like Spark's write command, the result is
     the side effect; `written_files` records what was produced."""
 
+    FUSION_NOTE = ("barrier: side-effecting sink — downloads batches "
+                   "to host files; nothing executes above it")
+
     def __init__(self, child: TpuExec, path: str, fmt: str = "parquet",
                  partition_by: Optional[Sequence[str]] = None,
                  conf: Optional[RapidsConf] = None):

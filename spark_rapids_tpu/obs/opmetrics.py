@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 import uuid
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -81,7 +82,63 @@ HISTORY_DIR = register(
 _TIME_METRICS = frozenset((
     "opTime", "spillTime", "uploadTime", "uploadWaitTime", "scanTime",
     "assembleTime", "downloadTime", "writeTime", "concatTime",
-    "ledgerWaitTime"))
+    "ledgerWaitTime", "dispatchTime"))
+
+#: metrics that are identifiers/flags (fold by max across tasks), not
+#: accumulators (fold by sum): the fused-program membership id and the
+#: chain length are the same value on every task that executed the node
+_IDENTITY_METRICS = frozenset(("fusedInto", "fusedChainOps",
+                               "cpuFallback"))
+
+
+# process-wide fused-stage completion watcher: ONE daemon thread per
+# process (lazily started; queries/collectors come and go per query —
+# a per-collector thread would leak one thread per executed query on
+# long-lived sessions/workers). Stamping is a plain float add on the
+# enqueued TpuMetric, so per-query ownership needs no bookkeeping.
+_STAGE_TIMEQ = None
+_STAGE_TIMER_LOCK = threading.Lock()
+# set when a drain barrier times out: the watcher is stuck on a
+# never-ready output (wedged dispatch), so further deferrals fall back
+# to wall-clock adds instead of growing an unserviced queue (and every
+# later finalize skips the doomed 30s wait)
+_STAGE_TIMER_WEDGED = False
+
+
+def _stage_timer_queue():
+    global _STAGE_TIMEQ
+    if _STAGE_TIMEQ is None:
+        with _STAGE_TIMER_LOCK:
+            if _STAGE_TIMEQ is None:
+                import queue as _queue
+                q = _queue.Queue()
+                threading.Thread(target=_stage_timer_loop, args=(q,),
+                                 name="opm-stage-timer",
+                                 daemon=True).start()
+                _STAGE_TIMEQ = q
+    return _STAGE_TIMEQ
+
+
+def _stage_timer_loop(q) -> None:
+    while True:
+        item = q.get()
+        if isinstance(item, threading.Event):
+            item.set()  # a finalize's drain barrier
+            continue
+        collector, metric, t0, out = item
+        item = None  # no dangling ref to the pytree while idle on get()
+        try:
+            import jax
+            jax.block_until_ready(out)
+            out = None
+            # measured here, APPLIED on the query thread at the drain
+            # barrier: metric.value += from two threads would be a lost-
+            # update race with the owning operator's own adds
+            with collector._times_lock:
+                collector._stage_results.append(
+                    (metric, time.perf_counter() - t0))
+        except Exception:  # noqa: BLE001 — accounting is best-effort
+            pass
 
 
 class OpMetricsCollector:
@@ -90,9 +147,21 @@ class OpMetricsCollector:
     appends the tiny scalar here and ``finalize`` folds them in with
     ONE fused ``device_get`` at the query's natural sync point —
     exactly the ``ExecCtx.check_deferred`` pattern, so the always-on
-    accounting never adds a host sync of its own."""
+    accounting never adds a host sync of its own.
 
-    __slots__ = ("enabled", "_pending", "_active")
+    Fused-stage TIME rides the same deferral philosophy: under async
+    dispatch the wall-clock around a jitted call measures launch cost,
+    not compute, so ``defer_stage_time`` hands (metric, t0, output) to
+    the process-wide completion watcher, which MEASURES time-to-ready
+    (``jax.block_until_ready`` off the query thread — a completion
+    wait, not a readback, so tunneled dispatch stays pipelined) and
+    parks the result; ``finalize`` drains the watcher and APPLIES the
+    measurements on the query's own thread (no cross-thread ``+=`` on
+    a live metric), so EXPLAIN ANALYZE / profiles report honest
+    per-stage time with zero syncs added to the execution path."""
+
+    __slots__ = ("enabled", "_pending", "_active", "_deferred_times",
+                 "_stage_results", "_times_lock")
 
     def __init__(self, conf: Optional[RapidsConf] = None):
         conf = conf or RapidsConf()
@@ -102,6 +171,13 @@ class OpMetricsCollector:
         # stack: an execute() that delegates to a wrapped super()
         # implementation (cross joins) must count each batch ONCE
         self._active: set = set()
+        # whether THIS query enqueued stage times on the process-wide
+        # watcher (finalize only pays the drain barrier if so), plus
+        # the watcher's measured (metric, seconds) results awaiting
+        # application on this query's own thread
+        self._deferred_times = False
+        self._stage_results: List[Tuple[object, float]] = []
+        self._times_lock = threading.Lock()
 
     def enter(self, node) -> bool:
         """Claim accounting for one node's execution; False when an
@@ -133,10 +209,46 @@ class OpMetricsCollector:
             rc = _live_count(batch)
         self._pending.append((metric, rc))
 
+    # --- deferred fused-stage timing -------------------------------------
+
+    def defer_stage_time(self, metric, t0, out) -> bool:
+        """Attribute ``now() - t0`` to ``metric`` when ``out`` (any jax
+        pytree) completes on device, measured by the process-wide
+        watcher thread — the honest opTime for an async-dispatched
+        fused stage. Returns False (caller falls back to wall-clock)
+        when accounting is disabled."""
+        if not self.enabled or _STAGE_TIMER_WEDGED:
+            return False
+        _stage_timer_queue().put((self, metric, t0, out))
+        self._deferred_times = True
+        return True
+
+    def _drain_stage_times(self) -> None:
+        """Barrier the watcher: every deferred stage time THIS query
+        enqueued is folded in before this returns (the queue is FIFO,
+        so a barrier enqueued now follows them; bounded wait — a wedged
+        device must not hang the query's sync point on accounting)."""
+        if not self._deferred_times:
+            return
+        self._deferred_times = False
+        barrier = threading.Event()
+        _stage_timer_queue().put(barrier)
+        if not barrier.wait(timeout=30.0):
+            # the watcher is stuck behind a never-ready output: stop
+            # feeding it (wall-clock fallback from here on) rather
+            # than queueing pytrees it will never release
+            global _STAGE_TIMER_WEDGED
+            _STAGE_TIMER_WEDGED = True
+        with self._times_lock:
+            results, self._stage_results = self._stage_results, []
+        for metric, dt_s in results:  # applied on the query's thread
+            metric.value += dt_s
+
     def finalize(self) -> None:
         """Fold every deferred row count in with one fused readback.
         Called at the query's natural sync points (collect download,
         worker task flush); metrics must never fail the query."""
+        self._drain_stage_times()
         if not self._pending:
             return
         pending, self._pending = self._pending, []
@@ -247,7 +359,14 @@ def fold_snapshots(snaps: Sequence[Dict]) -> Dict[str, Dict]:
             for name, v in ms.items():
                 if not isinstance(v, (int, float)):
                     continue
-                st["metrics"][name] = st["metrics"].get(name, 0) + v
+                if name in _IDENTITY_METRICS:
+                    # identifiers/flags, not accumulators: summing the
+                    # same program id across worker tasks would render
+                    # a nonsense op id
+                    st["metrics"][name] = max(
+                        st["metrics"].get(name, 0), v)
+                else:
+                    st["metrics"][name] = st["metrics"].get(name, 0) + v
                 if v > st["max"].get(name, float("-inf")):
                     st["max"][name] = v
             st["_op_times"].append(float(ms.get("opTime", 0.0) or 0.0))
@@ -337,7 +456,7 @@ def _fmt_metric(name: str, v) -> str:
 
 _COMPACT_METRICS = ("rows", "batches", "opTime", "spillTime",
                     "uploadWaitTime", "ledgerWaitTime", "deviceChunks",
-                    "fallbackChunks")
+                    "fallbackChunks", "fusedDispatches", "scanPrograms")
 
 
 def render_analyzed(root, folded: Dict[str, Dict],
@@ -377,9 +496,18 @@ def render_analyzed(root, folded: Dict[str, Dict],
                 pad_mark = "!"
             else:
                 pad_mark = ""
+            fused_into = m.pop("fusedInto", None)
+            chain_ops = m.pop("fusedChainOps", None)
             names = list(m) if formatted else \
                 [n for n in _COMPACT_METRICS if n in m]
-            parts = [_fmt_metric(n, m[n]) for n in names]
+            parts = []
+            if fused_into is not None:
+                # which program this instance executed inside — the
+                # whole-stage-fusion membership record
+                parts.append(f"fused into op{int(fused_into)}'s program")
+            if chain_ops is not None and (formatted or chain_ops > 1):
+                parts.append(f"fusedChainOps={int(chain_ops)}")
+            parts += [_fmt_metric(n, m[n]) for n in names]
             if st.get("tasks", 1) > 1:
                 parts.append(f"tasks={st['tasks']}")
                 mx = st["max"].get("opTime")
@@ -426,6 +554,11 @@ def build_profile(root, folded: Dict[str, Dict], wall_s: float,
     """One query's persistent profile document."""
     from ..tools.event_log import plan_fingerprint
     pid = trace_id or uuid.uuid4().hex[:16]
+    try:
+        import jax
+        device_kind = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001 — profiles must never fail a query
+        device_kind = "unknown"
     doc = {
         "version": 1,
         "profile_id": f"profile-{pid}",
@@ -434,6 +567,11 @@ def build_profile(root, folded: Dict[str, Dict], wall_s: float,
         "source": source,
         "cluster": cluster,
         "wall_s": round(wall_s, 6),
+        # the hardware the numbers were measured on: `profiling
+        # compare` refuses cross-device comparisons (a CPU-backend run
+        # vs a TPU run is a ~1000x apples-to-oranges ratio, not a
+        # regression)
+        "device_kind": device_kind,
         "fingerprint": plan_fingerprint(root),
         "nodes": plan_nodes(root),
         "ops": folded,

@@ -22,7 +22,9 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["masked_product_sum_pallas", "masked_product_sum_xla",
-           "gather_pallas", "gather_xla", "sort_pallas", "sort_xla"]
+           "gather_pallas", "gather_xla", "sort_pallas", "sort_xla",
+           "fused_filter_agg_pallas", "fused_filter_agg_xla",
+           "FUSED_AGG_GROUPS"]
 
 _TILE_ROWS = 2048
 _LANES = 128
@@ -167,6 +169,90 @@ def sort_pallas(keys, interpret: bool = False):
         out_shape=jax.ShapeDtypeStruct(k2.shape, keys.dtype),
         interpret=interpret)
     return call(k2).reshape(-1)
+
+
+# --- fused filter+partial-agg A/B: the whole-stage-fusion shape -------------
+# PR "scan-rooted whole-stage fusion" moved the from-files hot loop to ONE
+# XLA program per batch doing decode -> filter -> project -> partial-agg.
+# The open Pallas question AT THE FUSED LEVEL (ISSUE 15c): does a hand
+# kernel beat the fused XLA chain on the chain's own shape — filter
+# conjuncts + product + GROUPED partial reduction in one VMEM pass —
+# rather than the global reduction masked_product_sum already measured?
+# bench.py A/Bs `pallas_fused_agg_ab` beside `pallas_sort_ab`, with the
+# same falsifiability contract: only a compile/lowering failure may claim
+# "mosaic-rejected"; a successful compile with wrong values must surface
+# as WRONG-RESULT, never as a no-win.
+
+FUSED_AGG_GROUPS = 8  # static group count: a partial-agg keyspace slice
+
+
+def fused_filter_agg_xla(key, quantity, price, discount, shipdate):
+    """The fused chain as the engine's XLA path sees it: q6's filter
+    conjuncts, the price*discount projection, and a grouped partial sum
+    over a small static keyspace (the segment-reduce shape the
+    partial-agg tail lowers to; static one-hot per group — no scatter,
+    matching the engine's gather/sort-only idiom). Returns float32
+    per-group sums of shape (FUSED_AGG_GROUPS,)."""
+    mask = ((shipdate >= 8766) & (shipdate < 9131)
+            & (discount >= 0.05) & (discount <= 0.07)
+            & (quantity < 24.0))
+    vals = jnp.where(mask, price * discount, 0.0)
+    return jnp.stack([
+        jnp.sum(jnp.where(key == g, vals, 0.0), dtype=jnp.float32)
+        for g in range(FUSED_AGG_GROUPS)])
+
+
+def _fused_agg_kernel(k_ref, q_ref, p_ref, d_ref, s_ref, o_ref):
+    k = k_ref[...]
+    q = q_ref[...]
+    p = p_ref[...]
+    d = d_ref[...]
+    s = s_ref[...]
+    mask = ((s >= 8766) & (s < 9131) & (d >= 0.05) & (d <= 0.07)
+            & (q < 24.0))
+    vals = jnp.where(mask, p * d, 0.0)
+    parts = []
+    for g in range(FUSED_AGG_GROUPS):  # static keyspace: unrolled
+        vg = jnp.where(k == g, vals, 0.0)
+        # per-group (8, 128) min-tile partial — a (1, 1) accumulator is
+        # below the f32 tile floor and fails Mosaic (see _kernel above)
+        parts.append(jnp.sum(vg.reshape(-1, 8, _LANES), axis=0,
+                             dtype=jnp.float32))
+    o_ref[...] = jnp.concatenate(parts, axis=0)  # (GROUPS*8, 128)
+
+
+@functools.partial(jax.jit, static_argnums=(5,))
+def fused_filter_agg_pallas(key, quantity, price, discount, shipdate,
+                            interpret: bool = False):
+    """Pallas edition of the fused filter+partial-agg chain: grid-free
+    chunked pallas_call like ``masked_product_sum_pallas`` (the remote
+    compiler rejects gridded Mosaic kernels), each chunk emitting one
+    (GROUPS*8, 128) partial block reduced outside. Row count must be a
+    multiple of _TILE_ROWS*_LANES (the bench pads). Returns float32
+    per-group sums of shape (FUSED_AGG_GROUPS,)."""
+    from jax.experimental import pallas as pl
+    n = quantity.shape[0]
+    rows = n // _LANES
+    chunks = rows // _TILE_ROWS
+    call = pl.pallas_call(
+        _fused_agg_kernel,
+        out_shape=jax.ShapeDtypeStruct((FUSED_AGG_GROUPS * 8, _LANES),
+                                       jnp.float32),
+        interpret=interpret)
+    parts = []
+    shape2d = (_TILE_ROWS, _LANES)
+    for c in range(chunks):
+        lo = c * _TILE_ROWS * _LANES
+        hi = lo + _TILE_ROWS * _LANES
+        parts.append(call(key[lo:hi].reshape(shape2d),
+                          quantity[lo:hi].reshape(shape2d),
+                          price[lo:hi].reshape(shape2d),
+                          discount[lo:hi].reshape(shape2d),
+                          shipdate[lo:hi].reshape(shape2d)))
+    stacked = jnp.stack(parts)  # (chunks, GROUPS*8, 128)
+    return jnp.sum(
+        stacked.reshape(len(parts), FUSED_AGG_GROUPS, 8, _LANES),
+        axis=(0, 2, 3), dtype=jnp.float32)
 
 
 @functools.partial(jax.jit, static_argnums=(2,))

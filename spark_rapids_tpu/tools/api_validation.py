@@ -94,6 +94,36 @@ def generate_supported_ops() -> str:
     for cls in sorted(_expr_classes(), key=lambda c: c.__name__):
         note = _first_line(cls.__doc__)
         lines.append(f"| {cls.__name__} | {note} |")
+    lines += ["", "## Stage fusion", "",
+              "Whole-stage fusion (`spark.rapids.sql.stageFusion."
+              "enabled`) composes chains of row-wise-map operators — "
+              "each one's live `device_fn` — into ONE XLA program per "
+              "batch; scan-rooted chains splice into the parquet "
+              "fused-decode program (`spark.rapids.sql.stageFusion."
+              "scan.enabled`), one dispatch per coalesced row-group "
+              "batch. This table is the row-wise-map AUDIT, generated "
+              "from the live `device_fn` registry plus each barrier's "
+              "declared `FUSION_NOTE` — drift-checked by `tpu-lint "
+              "--check-docs`.", "",
+              "| Operator | Fusion |", "|---|---|"]
+    from ..exec.base import (DeviceBatchSourceExec, HostBatchSourceExec,
+                             TpuExec as _TpuExec)
+    from ..exec.transitions import DeviceToHostExec, HostToDeviceExec
+    # the audit covers the non-Tpu-prefixed participants too: the
+    # planner-inserted transitions and the source leaves all carry
+    # their own chain-root/barrier notes
+    audit_classes = _exec_classes() + [
+        DeviceToHostExec, HostToDeviceExec, HostBatchSourceExec,
+        DeviceBatchSourceExec]
+    for cls in sorted(audit_classes, key=lambda c: c.__name__):
+        if cls.__dict__.get("device_fn") is not None:
+            cell = "fusable: row-wise map (`device_fn`)"
+            note = cls.FUSION_NOTE
+            if note is not _TpuExec.FUSION_NOTE:
+                cell += f" — {note}"
+        else:
+            cell = cls.FUSION_NOTE
+        lines.append(f"| {cls.__name__} | {cell} |")
     lines += [
         "", "## Format notes", "",
         "- Parquet device decode "

@@ -575,22 +575,74 @@ def _load_compare_doc(path: str) -> dict:
         doc = json.load(f)
     if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
         return doc["parsed"]  # BENCH_r0x.json wrapper
+    if isinstance(doc, dict) and "tail" in doc and "cmd" in doc:
+        # wrapper whose parsed field was never filled: recover the
+        # bench's one JSON line from the tail so the round still
+        # carries its metrics AND its device_kind into the
+        # comparability gate (a CPU-run round diffed against a TPU run
+        # must REFUSE, not report a ~1000x fake regression)
+        for line in reversed(str(doc.get("tail", "")).splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    parsed = json.loads(line)
+                except ValueError:
+                    break
+                if isinstance(parsed, dict):
+                    return parsed
+                break
     return doc if isinstance(doc, dict) else {}
 
 
+def _device_kind_guard(a: dict, b: dict, a_path: str, b_path: str,
+                       allow_cross_device: bool):
+    """Comparability gate: numbers measured on different hardware are
+    not comparable — a CPU-backend bench read against a TPU bench looks
+    like a ~1000x 'regression' that is really a backend swap (exactly
+    what a naive BENCH_r06-vs-r05 diff would report). Returns
+    ``(refusal_or_None, warning_or_None)``; docs without a recorded
+    device_kind (pre-guard profiles/benches) pass — absence of evidence
+    is not a mismatch."""
+    ka, kb = a.get("device_kind"), b.get("device_kind")
+    if ka is None or kb is None or ka == kb:
+        return None, None  # same device, or a pre-guard doc
+    if allow_cross_device:
+        return None, ("=== WARNING: device_kind mismatch "
+                      f"({ka!r} vs {kb!r}) — cross-device diff "
+                      "forced ===")
+    return "\n".join([
+        "=== compare REFUSED: device_kind mismatch ===",
+        f"  A ({a_path}): device_kind={ka!r}",
+        f"  B ({b_path}): device_kind={kb!r}",
+        "  Numbers measured on different hardware are not "
+        "comparable — a backend swap reads as a giant fake "
+        "regression (or win).",
+        "  Re-run both on the same device_kind, or pass "
+        "--allow-cross-device to diff anyway."]), None
+
+
 def compare_report(a_path: str, b_path: str,
-                   threshold: float = 1.5) -> str:
+                   threshold: float = 1.5,
+                   allow_cross_device: bool = False) -> str:
     """Per-operator time/rows deltas between two query profiles (A =
     baseline, B = candidate); operators whose opTime grew by at least
     ``threshold``x (above a 1ms floor) are flagged REGRESSED. Two
-    BENCH json files compare their shared scalar metrics instead."""
+    BENCH json files compare their shared scalar metrics instead.
+    Comparisons across differing ``device_kind`` are REFUSED unless
+    ``allow_cross_device`` (then the report leads with a warning)."""
     a, b = _load_compare_doc(a_path), _load_compare_doc(b_path)
+    guard, warning = _device_kind_guard(a, b, a_path, b_path,
+                                        allow_cross_device)
+    warn = warning + "\n" if warning else ""
+    if guard is not None:
+        return guard
     if not (isinstance(a.get("ops"), dict)
             and isinstance(b.get("ops"), dict)):
-        return _compare_bench(a, b, a_path, b_path, threshold)
-    lines = [f"=== profile compare (A={a.get('profile_id', a_path)}, "
-             f"B={b.get('profile_id', b_path)}, "
-             f"threshold {threshold}x) ==="]
+        return warn + _compare_bench(a, b, a_path, b_path, threshold)
+    lines = ([warn.rstrip()] if warn else []) + [
+        f"=== profile compare (A={a.get('profile_id', a_path)}, "
+        f"B={b.get('profile_id', b_path)}, "
+        f"threshold {threshold}x) ==="]
     wa, wb = a.get("wall_s", 0.0), b.get("wall_s", 0.0)
     ratio = f"{wb / wa:.2f}x" if wa > 0 else "n/a"
     lines.append(f"wall: {wa * 1e3:.1f}ms -> {wb * 1e3:.1f}ms ({ratio})")
@@ -685,6 +737,7 @@ def _main(argv):
     elif argv[0] == "compare":
         rest = [a for a in argv[1:] if not a.startswith("--")]
         threshold = 1.5
+        allow_cross = "--allow-cross-device" in argv
         for i, a in enumerate(argv):
             if a == "--threshold" and i + 1 < len(argv):
                 threshold = float(argv[i + 1])
@@ -693,9 +746,14 @@ def _main(argv):
                 threshold = float(a.split("=", 1)[1])
         if len(rest) != 2:
             print("usage: profiling compare <a.json> <b.json> "
-                  "[--threshold X]", file=sys.stderr)
+                  "[--threshold X] [--allow-cross-device]",
+                  file=sys.stderr)
             return 2
-        print(compare_report(rest[0], rest[1], threshold=threshold))
+        report = compare_report(rest[0], rest[1], threshold=threshold,
+                                allow_cross_device=allow_cross)
+        print(report)
+        if report.startswith("=== compare REFUSED"):
+            return 3  # comparability gate tripped — not a diff result
     elif argv[0].endswith(".json"):
         print(profile_trace(argv[0]))
     else:

@@ -55,6 +55,10 @@ from spark_rapids_tpu.analysis import lockwatch  # noqa: E402
 
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running acceptance sweeps excluded from the tier-1 "
+        "gate (which runs -m 'not slow')")
     # fallback install (the module-level bootstrap above normally ran
     # first, before the package's import-time locks were created);
     # cluster worker processes install their own watchdog via

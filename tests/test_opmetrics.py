@@ -143,8 +143,10 @@ def test_explain_analyze_marks_fused_and_sql_source():
     pp.collect()
     text = pp.explain_analyze()
     # project/filter chains fuse into one XLA program below their
-    # consumer: the un-executed node is marked, not silently zeroed
-    assert "fused into a parent stage" in text, text
+    # consumer: the fused node is marked with the program it joined
+    # ("fused into opN's program"; nodes with no metrics at all still
+    # get the generic parent-stage marker) — never silently zeroed
+    assert "fused into" in text, text
 
 
 # --- process cluster: fold across workers ------------------------------------
@@ -275,6 +277,46 @@ def test_compare_accepts_bench_json(tmp_path):
     rep = compare_report(str(a), str(b), threshold=1.5)
     assert "bench compare" in rep
     assert "CHANGED" in rep and "value" in rep
+
+
+def test_compare_refuses_cross_device_kind(tmp_path):
+    """Comparability guard: profiles/benches measured on different
+    hardware REFUSE to diff (a CPU-backend run read against a TPU run
+    is a ~1000x fake regression, not a result) unless the cross-device
+    diff is explicitly forced — then the report leads with a warning."""
+    from spark_rapids_tpu.tools.profiling import compare_report
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(
+        {"parsed": {"value": 30.0, "device_kind": "TPU v5 lite"}}))
+    b.write_text(json.dumps(
+        {"parsed": {"value": 0.02, "device_kind": "cpu"}}))
+    rep = compare_report(str(a), str(b), threshold=1.5)
+    assert rep.startswith("=== compare REFUSED"), rep
+    assert "device_kind" in rep and "cpu" in rep
+    forced = compare_report(str(a), str(b), threshold=1.5,
+                            allow_cross_device=True)
+    assert "WARNING" in forced.splitlines()[0]
+    assert "bench compare" in forced
+    # same-kind docs still compare cleanly
+    c = tmp_path / "c.json"
+    c.write_text(json.dumps(
+        {"parsed": {"value": 29.0, "device_kind": "TPU v5 lite"}}))
+    rep_ok = compare_report(str(a), str(c), threshold=1.5)
+    assert "REFUSED" not in rep_ok and "bench compare" in rep_ok
+    # profile docs carry device_kind too (build_profile records it)
+    pa_ = tmp_path / "pa.json"
+    pb_ = tmp_path / "pb.json"
+    ops = {"op1": {"label": "ProjectExec#op1",
+                   "metrics": {"opTime": 0.1, "rows": 10},
+                   "max": {"opTime": 0.1}, "tasks": 1, "skew": 1.0}}
+    pa_.write_text(json.dumps({"profile_id": "profile-a", "ops": ops,
+                               "wall_s": 0.2,
+                               "device_kind": "TPU v5 lite"}))
+    pb_.write_text(json.dumps({"profile_id": "profile-b", "ops": ops,
+                               "wall_s": 0.2, "device_kind": "cpu"}))
+    assert compare_report(str(pa_), str(pb_)).startswith(
+        "=== compare REFUSED")
 
 
 # --- event log + duration histogram satellites -------------------------------

@@ -733,6 +733,116 @@ def run_scan_smoke(out_dir, mixed=False):
     return prom_path
 
 
+def run_fusion_smoke(out_dir):
+    """Whole-stage-fusion CI gate (q6 from files): a multi-row-group
+    parquet scan under a filter -> project -> partial-agg chain must
+    run decode+filter+project+partial-agg as ONE spliced XLA program
+    per coalesced batch — proven by the scan's ``fusedDispatches`` ==
+    ``scanPrograms`` counters (>= 2 batches so coalescing is real),
+    with ZERO host-fallback chunks, rows matching the host oracle
+    EXACTLY, and fused-vs-unfused (stageFusion off) results bit-exact.
+    EXPLAIN-ANALYZE-visible fusion membership (``fusedInto``) is
+    asserted too. Returns the prom path."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu import datatypes as dt
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.exec.base import (ExecCtx, collect_arrow,
+                                            collect_arrow_cpu)
+    from spark_rapids_tpu.exec.basic import TpuFilterExec, TpuProjectExec
+    from spark_rapids_tpu.expr import (Alias, And, GreaterThanOrEqual,
+                                       LessThan, Literal, Multiply)
+    from spark_rapids_tpu.expr import UnresolvedColumn as col
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.io import TpuFileScanExec
+    from spark_rapids_tpu.obs.metrics import dump_prometheus
+
+    rng = np.random.default_rng(7)
+    n = 8192
+    t = pa.table({
+        "l_quantity": pa.array(rng.integers(1, 51, n)
+                               .astype(np.float32)),
+        "l_extendedprice": pa.array(rng.uniform(900, 105000, n)
+                                    .astype(np.float32)),
+        "l_discount": pa.array((rng.integers(0, 11, n) / 100.0)
+                               .astype(np.float32)),
+        "l_shipdate": pa.array(rng.integers(8000, 10600, n)
+                               .astype(np.int32)),
+        "l_flag": pa.array(rng.integers(0, 4, n).astype(np.int64)),
+    })
+    path = os.path.join(out_dir, "fusion_smoke.parquet")
+    pq.write_table(t, path, row_group_size=1024, compression="snappy")
+
+    def build(conf):
+        scan = TpuFileScanExec([path], conf=conf)
+        f32 = lambda v: Literal(np.float32(v), dt.FLOAT32)  # noqa: E731
+        cond = And(
+            And(GreaterThanOrEqual(col("l_shipdate"),
+                                   Literal(8766, dt.INT32)),
+                LessThan(col("l_shipdate"), Literal(9131, dt.INT32))),
+            LessThan(col("l_quantity"), f32(24.0)))
+        proj = TpuProjectExec(
+            [Alias(Multiply(col("l_extendedprice"), col("l_discount")),
+                   "rev"), Alias(col("l_flag"), "l_flag")],
+            TpuFilterExec(cond, scan))
+        agg = TpuHashAggregateExec(
+            [col("l_flag")], [Alias(Sum(col("rev")), "revenue")], proj)
+        return scan, proj, agg
+
+    # >1 coalesced batch: shrink the coalesce target below the file's
+    # decoded size so the ONE-program-per-batch claim is tested per
+    # batch, not degenerately on a single group
+    conf = RapidsConf(
+        {"spark.rapids.sql.scan.coalesceTargetBytes": str(16 << 10)})
+    scan, proj, agg = build(conf)
+    ctx = ExecCtx(conf)
+    got = collect_arrow(agg, ctx).sort_by("l_flag")
+    want = collect_arrow_cpu(build(conf)[2]).sort_by("l_flag")
+    gd, wd = got.to_pydict(), want.to_pydict()
+    assert gd["l_flag"] == wd["l_flag"], "fusion smoke keys diverge"
+    assert np.allclose(gd["revenue"], wd["revenue"], rtol=1e-4), \
+        "fusion smoke rows diverge from the host oracle"
+    m = ctx.metrics[scan.node_label()]
+    fused = int(m["fusedDispatches"].value)
+    programs = int(m["scanPrograms"].value)
+    assert fused >= 2, \
+        f"expected >= 2 coalesced fused batches, got {fused}"
+    assert fused == programs, \
+        (f"dispatch granularity regressed: {programs} scan programs "
+         f"but only {fused} fused — decode and chain ran as separate "
+         "dispatches")
+    assert int(m["fallbackChunks"].value) == 0, \
+        f"fusion smoke hit {m['fallbackChunks'].value} fallback chunks"
+    # fusion membership visible to EXPLAIN ANALYZE: scan, filter and
+    # project all record the consumer program they fused into
+    fused_nodes = [lbl for lbl, ms in ctx.metrics.items()
+                   if "fusedInto" in ms]
+    for want_op in ("FileScanExec", "FilterExec", "ProjectExec"):
+        assert any(lbl.startswith(want_op) for lbl in fused_nodes), \
+            f"{want_op} did not record fusedInto ({fused_nodes})"
+    # bit-exactness: the same plan with stageFusion OFF must produce
+    # the IDENTICAL table (not merely close) — fusion must never
+    # change results
+    conf_off = RapidsConf(
+        {"spark.rapids.sql.scan.coalesceTargetBytes": str(16 << 10),
+         "spark.rapids.sql.stageFusion.enabled": "false"})
+    off = collect_arrow(build(conf_off)[2],
+                        ExecCtx(conf_off)).sort_by("l_flag")
+    assert off.to_pydict() == gd, \
+        "fused vs unfused results are not bit-exact"
+    print(f"fusion smoke: {fused}/{programs} scan programs fused "
+          "(ONE dispatch per coalesced batch), rows match the oracle, "
+          "zero fallback chunks, fused==unfused bit-exact")
+    prom = dump_prometheus()
+    prom_path = os.path.join(out_dir, "fusion_metrics.prom")
+    with open(prom_path, "w") as f:
+        f.write(prom)
+    return prom_path
+
+
 def run_sql_smoke(out_dir):
     """SQL-frontend CI gate: (1) parse + compile + plan-verify the FULL
     SQL corpus (tools/nds.py SQL_QUERIES) — zero parse failures, zero
@@ -916,6 +1026,15 @@ def main(argv=None):
                          "(chaos disk_full): query green, classified "
                          "disk_pressure evidence, exactly one bundle, "
                          "planted orphan spill namespace reclaimed")
+    ap.add_argument("--fusion-smoke", metavar="DIR",
+                    dest="fusion_smoke",
+                    help="run q6-shaped scan->filter->project->"
+                         "partial-agg from a multi-row-group parquet "
+                         "file: the fusedDispatches/scanPrograms "
+                         "counters must prove ONE spliced program per "
+                         "coalesced batch, rows must match the oracle, "
+                         "zero fallback chunks, fused==unfused "
+                         "bit-exact")
     ap.add_argument("--sql-smoke", metavar="DIR", dest="sql_smoke",
                     help="parse + compile + plan-verify the full SQL "
                          "corpus (zero parse failures / fallbacks) and "
@@ -950,6 +1069,10 @@ def main(argv=None):
         prom = run_scan_smoke(args.scan_smoke,
                               mixed=args.mixed_encodings)
         print(f"scan smoke output: {prom}")
+    if args.fusion_smoke:
+        os.makedirs(args.fusion_smoke, exist_ok=True)
+        prom = run_fusion_smoke(args.fusion_smoke)
+        print(f"fusion smoke output: {prom}")
     if args.flight_smoke:
         os.makedirs(args.flight_smoke, exist_ok=True)
         bundle = run_flight_smoke(args.flight_smoke)
@@ -984,8 +1107,9 @@ def main(argv=None):
             and not profiles and not args.lint_report \
             and not args.lockwatch:
         ap.error("nothing to do: pass --trace/--prom/--smoke/"
-                 "--scan-smoke/--flight/--flight-smoke/--shuffle-smoke/"
-                 "--lifecycle-smoke/--spill-smoke/--sql-smoke/--profile/"
+                 "--scan-smoke/--fusion-smoke/--flight/--flight-smoke/"
+                 "--shuffle-smoke/--lifecycle-smoke/--spill-smoke/"
+                 "--sql-smoke/--profile/"
                  "--analyze-smoke/--lint-report/--lockwatch")
     if args.lint_report:
         errors += [f"[lint] {e}"
