@@ -135,6 +135,12 @@ LOCK_HIERARCHY: Tuple[LockLevel, ...] = (
     LockLevel("FlightRecorder._lock", 70,
               ("recorder.py", "FlightRecorder", None),
               "flight-recorder ring"),
+    LockLevel("OpMetricsCollector._times_lock", 75,
+              ("opmetrics.py", "OpMetricsCollector", None),
+              "deferred stage-time result buffer (appended by the "
+              "process-wide stage-timer thread, drained at finalize); "
+              "held only around list swap/append, above everything "
+              "but the metric leaves"),
     LockLevel("*recorder.py::*", 70,
               ("recorder.py", None, None),
               "incident sequence guard"),

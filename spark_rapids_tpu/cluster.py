@@ -65,7 +65,7 @@ from .obs.recorder import (RECORDER, flush_worker_ring,
 from .obs.tracer import (NULL_TRACER, TRACE_DIR, TRACE_MAX_FILES, Tracer,
                          tracer_from_conf)
 from .scheduler import TaskScheduler, TaskSpec
-from .scheduler.task_scheduler import FetchFailedError
+from .scheduler.task_scheduler import FetchFailedError, GangFailedError
 from .shuffle import integrity
 from .shuffle.host import (HostShuffleTransport, SHUF_BYTES_FETCHED,
                            SHUF_FETCH_WAIT, SHUF_PARTS_FETCHED)
@@ -245,21 +245,12 @@ def _run_map_task(payload: Dict, tracer=NULL_TRACER,
         transport.commit_task_attempt(sid, task_key, attempt)
 
 
-def _run_collect_task(payload: Dict, tracer=NULL_TRACER,
-                      obs_sink: Optional[Dict] = None) -> None:
-    """Execute a (reduce/final) plan slice on this worker's device and
-    publish the result as one Arrow IPC file; the final hard link is the
-    commit — first attempt to link wins, a later (speculative/zombie)
-    attempt discards its own file."""
+def _write_collect_result(plan: TpuExec, ctx: ExecCtx,
+                          payload: Dict) -> None:
+    """Execute ``plan`` and publish the result as one Arrow IPC file;
+    the final hard link is the commit — first attempt to link wins, a
+    later (speculative/zombie) attempt discards its own file."""
     from .columnar.arrow_bridge import arrow_schema, device_to_arrow
-    conf = RapidsConf(payload["conf"])
-    plan: TpuExec = payload["plan"]
-    ctx = ExecCtx(conf)
-    ctx.tracer = tracer
-    from .lifecycle import QueryContext
-    ctx.qctx = QueryContext.for_worker(payload, conf)
-    if obs_sink is not None:
-        obs_sink["ctx"] = ctx
     rbs = [device_to_arrow(b) for b in plan.execute(ctx)]
     target = arrow_schema(plan.output_schema)
     out = payload["out"]
@@ -280,7 +271,65 @@ def _run_collect_task(payload: Dict, tracer=NULL_TRACER,
             pass
 
 
-_TASK_KINDS = {"map": _run_map_task, "collect": _run_collect_task}
+def _run_collect_task(payload: Dict, tracer=NULL_TRACER,
+                      obs_sink: Optional[Dict] = None) -> None:
+    """Execute a (reduce/final) plan slice on this worker's device and
+    publish the result via the atomic hard-link commit."""
+    conf = RapidsConf(payload["conf"])
+    plan: TpuExec = payload["plan"]
+    ctx = ExecCtx(conf)
+    ctx.tracer = tracer
+    from .lifecycle import QueryContext
+    ctx.qctx = QueryContext.for_worker(payload, conf)
+    if obs_sink is not None:
+        obs_sink["ctx"] = ctx
+    _write_collect_result(plan, ctx, payload)
+
+
+def _run_mesh_task(payload: Dict, tracer=NULL_TRACER,
+                   obs_sink: Optional[Dict] = None) -> None:
+    """One gang member of a mesh query: bind every shuffle exchange in
+    the plan to the cross-process `GangIciShuffleTransport` and execute
+    the WHOLE plan as this process's slice of one SPMD program. All N
+    members run the identical program — the collectives inside require
+    every participant — but each member's exchanges only re-emit the
+    partitions whose global devices this process owns, so the N result
+    files union to exactly the full query output. Publishing reuses the
+    collect task's atomic hard-link commit."""
+    from .distributed import get_runtime
+    from .distributed.gang import GangIciShuffleTransport
+    from .exec.exchange import TpuShuffleExchangeExec
+    conf = RapidsConf(payload["conf"])
+    rt = get_runtime()
+    if rt is None:
+        # no runtime = this worker's bootstrap failed or it was
+        # respawned into a newer incarnation than the task expects;
+        # fail the attempt so the gang fails fast and the driver
+        # remeshes or falls back
+        raise RuntimeError(
+            "mesh task on a worker without a bootstrapped mesh runtime")
+    plan: TpuExec = payload["plan"]
+    ctx = ExecCtx(conf)
+    ctx.tracer = tracer
+    from .lifecycle import QueryContext
+    ctx.qctx = QueryContext.for_worker(payload, conf)
+    if obs_sink is not None:
+        obs_sink["ctx"] = ctx
+    transport = GangIciShuffleTransport(
+        rt, payload["exchange_root"], conf=conf, qctx=ctx.qctx)
+
+    def bind(node):
+        if isinstance(node, TpuShuffleExchangeExec):
+            node.transport = transport
+        for c in getattr(node, "children", ()):
+            bind(c)
+
+    bind(plan)
+    _write_collect_result(plan, ctx, payload)
+
+
+_TASK_KINDS = {"map": _run_map_task, "collect": _run_collect_task,
+               "mesh": _run_mesh_task}
 
 
 def _flush_task_flight(root: str, worker_id: int, task_path: str,
@@ -594,6 +643,12 @@ class _WorkerPool:
         errpath = os.path.join(self.root, f"worker-{w}.err")
         errf = open(errpath, "ab")  # append: respawns keep history
         self._errlogs[w] = (errpath, errf)
+        env = self._env
+        from .distributed.runtime import ENV_COORD, ENV_PID
+        if ENV_COORD in env:
+            # the mesh process rank IS the worker id, stamped per spawn
+            # so a respawned incarnation rejoins under the same slot
+            env = dict(env, **{ENV_PID: str(w)})
         # stderr goes to a file per worker, NOT a pipe: an undrained
         # pipe blocks the worker once it fills (~64 KiB of library
         # warnings is enough) — a silent cluster hang
@@ -601,7 +656,7 @@ class _WorkerPool:
             [sys.executable, "-m", "spark_rapids_tpu.cluster",
              "--root", self.root, "--worker", str(w),
              "--heartbeat", str(self._hb_interval)],
-            env=self._env, stdout=subprocess.DEVNULL, stderr=errf)
+            env=env, stdout=subprocess.DEVNULL, stderr=errf)
         # monotonic: the scheduler's first-heartbeat grace must not be
         # inflated/deflated by wall-clock steps
         self._spawn_ts[w] = time.monotonic()
@@ -637,6 +692,12 @@ class _WorkerPool:
                 p.wait(timeout=self._exit_timeout_s)
             except subprocess.TimeoutExpired:
                 pass
+
+    def update_env(self, updates: Dict[str, str]) -> None:
+        """Env for FUTURE spawns (remesh points new incarnations at a
+        fresh coordinator). Running workers keep their env until
+        respawned."""
+        self._env = dict(self._env, **updates)
 
     def respawn(self, w: int) -> None:
         self.kill(w)
@@ -707,7 +768,7 @@ class TpuProcessCluster:
         # protocol would mistake stale files for winning siblings and
         # silently serve the old run's data. Start from a clean slate.
         import shutil as _shutil
-        for sub in ("tasks", "shuffle", "results", "heartbeats"):
+        for sub in ("tasks", "shuffle", "results", "heartbeats", "mesh"):
             d = os.path.join(self.root, sub)
             if not self._own_root and os.path.isdir(d):
                 _shutil.rmtree(d, ignore_errors=True)
@@ -733,6 +794,17 @@ class TpuProcessCluster:
         wenv["RAPIDS_TPU_IS_WORKER"] = "1"
         if env:
             wenv.update(env)
+        # multi-host mesh (spark.rapids.tpu.mesh.enabled): the spawn
+        # env carries the coordinator rendezvous so every worker
+        # bootstraps jax.distributed and one logical (dcn, ici) Mesh
+        # spans the fleet's devices (distributed/runtime.py). The rank
+        # is stamped per spawn by the pool.
+        from .config import MESH_ENABLED
+        self._mesh_enabled = bool(self.conf.get(MESH_ENABLED))
+        self._mesh_incarnation = 0
+        self._mesh_ready_state: Optional[Tuple[int, bool, str]] = None
+        if self._mesh_enabled:
+            wenv.update(self._mesh_env_block())
         from .config import WORKER_EXIT_TIMEOUT
         self.pool = _WorkerPool(self.root, n_workers, wenv,
                                 self.conf.get(HEARTBEAT_INTERVAL),
@@ -868,8 +940,12 @@ class TpuProcessCluster:
                 gate = DeviceMemoryManager.shared(conf).task_slot(qctx) \
                     if qctx is not None else contextlib.nullcontext()
                 with gate:
-                    result = self._run_query_stages(
-                        plan, conf, settings, qid, sched)
+                    if self._mesh_route(plan, conf, sched):
+                        result = self._run_query_mesh(
+                            plan, conf, settings, qid, sched)
+                    else:
+                        result = self._run_query_stages(
+                            plan, conf, settings, qid, sched)
             ok = True
             return result
         except QueryCancelled as e:
@@ -1216,12 +1292,326 @@ class TpuProcessCluster:
                          schema=target)]
         return pa.concat_tables(tables)
 
+    # --- multi-host mesh execution ----------------------------------------
+
+    def _mesh_env_block(self) -> Dict[str, str]:
+        """The spawn-env slice for the CURRENT mesh incarnation. The
+        coordinator port is fresh per incarnation (unless pinned by
+        conf): a dead incarnation's coordinator state must never greet
+        the next fleet."""
+        from .config import (MESH_BOOTSTRAP_TIMEOUT,
+                             MESH_COORDINATOR_PORT,
+                             MESH_DEVICES_PER_PROCESS)
+        from .distributed.runtime import mesh_env
+        port = int(self.conf.get(MESH_COORDINATOR_PORT)) or _free_port()
+        return mesh_env(f"127.0.0.1:{port}", self.n_workers,
+                        int(self.conf.get(MESH_DEVICES_PER_PROCESS)),
+                        float(self.conf.get(MESH_BOOTSTRAP_TIMEOUT)),
+                        incarnation=self._mesh_incarnation)
+
+    def _mesh_route(self, plan: TpuExec, conf: RapidsConf,
+                    sched: TaskScheduler) -> bool:
+        """Gate the gang path: mesh on, plan expressible as ONE SPMD
+        program, and every worker's bootstrap marker in. Any 'no' is a
+        recorded mesh_fallback — the classic file-shuffle path is
+        always correct."""
+        if not self._mesh_enabled:
+            return False
+        why = _mesh_ineligible(plan)
+        if why is not None:
+            sched._event("mesh_fallback",
+                         reason=f"plan ineligible: {why}"[:400])
+            return False
+        ok, why = self._mesh_ready(conf)
+        if not ok:
+            sched._event("mesh_fallback",
+                         reason=f"mesh not ready: {why}"[:400])
+            return False
+        return True
+
+    def _mesh_ready(self, conf: RapidsConf) -> Tuple[bool, str]:
+        """Wait (bounded by the bootstrap timeout) for every worker's
+        mesh marker of the current incarnation; cached per incarnation
+        so only the first query after a (re)spawn pays the wait."""
+        from .config import MESH_BOOTSTRAP_TIMEOUT
+        from .distributed.runtime import read_mesh_markers
+        inc = self._mesh_incarnation
+        st = self._mesh_ready_state
+        if st is not None and st[0] == inc:
+            return st[1], st[2]
+        deadline = time.monotonic() \
+            + float(conf.get(MESH_BOOTSTRAP_TIMEOUT)) + 5.0
+        ok, why = False, "bootstrap markers never appeared"
+        while time.monotonic() < deadline:
+            docs = read_mesh_markers(self.root, self.n_workers, inc)
+            if docs is not None:
+                bad = next((d for d in docs if not d.get("ok")), None)
+                if bad is not None:
+                    why = (f"worker bootstrap failed: "
+                           f"{(bad.get('error') or '?')[:200]}")
+                else:
+                    ok, why = True, ""
+                break
+            time.sleep(0.05)  # tpu-lint: allow[blocking-call-in-thread] bounded readiness poll before the first mesh query
+        self._mesh_ready_state = (inc, ok, why)
+        return ok, why
+
+    def _remesh(self, sched: TaskScheduler, reason: str) -> None:
+        """Tear the fleet down to a clean mesh: bump the incarnation,
+        point future spawns at a fresh coordinator, respawn every
+        worker. Kill-then-respawn is the wedge/orphan guarantee — a
+        member parked inside a collective that will never complete
+        does not survive the gang that created it."""
+        if not self._mesh_enabled:
+            return
+        self._mesh_incarnation += 1
+        self._mesh_ready_state = None
+        self.pool.update_env(self._mesh_env_block())
+        for w in range(self.n_workers):
+            # the dead gang's unclaimed task files must not greet the
+            # next incarnation: a respawned worker would claim them and
+            # replay the failed generation instead of the retry's
+            sched._clear_worker_tasks(w)
+            self.pool.respawn(w)
+        sched._event(
+            "worker_respawn",
+            reason=f"remesh i{self._mesh_incarnation}: {reason}"[:300])
+
+    def _run_query_mesh(self, plan: TpuExec, conf: RapidsConf,
+                        settings: Dict, qid: int,
+                        sched: TaskScheduler) -> pa.Table:
+        """Gang attempts with remesh-retry, then classic fallback. A
+        cancelled gang also remeshes before the classified error
+        surfaces: members stranded inside (or heading into) a
+        collective must not outlive the query as wedged processes."""
+        from .config import MESH_GANG_RETRIES
+        from .lifecycle import QueryCancelled
+        retries = max(0, int(conf.get(MESH_GANG_RETRIES)))
+        g = 0
+        while True:
+            try:
+                return self._run_gang_attempt(plan, conf, settings,
+                                              qid, sched, g)
+            except QueryCancelled:
+                self._remesh(sched, "query cancelled mid-gang")
+                raise
+            except GangFailedError as gf:
+                sched._event("gang_failed", task=gf.task,
+                             worker=gf.worker, reason=str(gf)[:400])
+                self._remesh(sched, f"gang g{g} failed")
+                g += 1
+                if g > retries:
+                    sched._event(
+                        "mesh_fallback",
+                        reason=f"gang retries exhausted after {g} "
+                               f"attempts; classic per-stage path")
+                    return self._run_query_stages(plan, conf, settings,
+                                                  qid, sched)
+                ok, why = self._mesh_ready(conf)
+                if not ok:
+                    sched._event(
+                        "mesh_fallback",
+                        reason=f"remesh did not converge: {why}"[:400])
+                    return self._run_query_stages(plan, conf, settings,
+                                                  qid, sched)
+
+    def _run_gang_attempt(self, plan: TpuExec, conf: RapidsConf,
+                          settings: Dict, qid: int,
+                          sched: TaskScheduler, g: int) -> pa.Table:
+        n = self.n_workers
+        xroot = os.path.join(self.root, "mesh", f"q{qid}.g{g}")
+        os.makedirs(xroot, exist_ok=True)
+        specs, outs = [], []
+        for k in range(n):
+            member = _slice_for_member(plan, k, n)
+            out = os.path.join(self.root, "results",
+                               f"q{qid}g{g}_m{k}.arrow")
+            outs.append(out)
+            specs.append(TaskSpec(f"q{qid}g{g}w{k}", "mesh", {
+                "plan": member, "out": out, "conf": settings,
+                "exchange_root": xroot}))
+        sched.run_gang(specs, stage_label=f"mesh gang g{g}")
+        tables = []
+        for out in outs:
+            with pa.OSFile(out, "rb") as f:
+                tables.append(pa.ipc.open_file(f).read_all())
+        from .columnar.arrow_bridge import arrow_schema
+        target = arrow_schema(plan.output_schema)
+        tables = [t.cast(target) for t in tables if t.num_rows] \
+            or [pa.table({f.name: pa.array([], f.type) for f in target},
+                         schema=target)]
+        return pa.concat_tables(tables)
+
 
 def run_process_query(plan: TpuExec, n_workers: int = 2,
                       conf: Optional[RapidsConf] = None) -> pa.Table:
     """One-shot convenience: spin a cluster up, run, tear down."""
     with TpuProcessCluster(n_workers, conf=conf) as cluster:
         return cluster.run_query(plan, conf)
+
+
+# --- mesh plan gating ------------------------------------------------------
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _exchange_regions(plan: TpuExec):
+    """Stage regions of a gang plan: ``[(exchange_or_None, raw_leaves,
+    reads_deeper_exchange)]``. Entry 0 is the FINAL region (everything
+    above the topmost exchanges); one entry per exchange covers its
+    child subtree cut at deeper exchanges. The gang correctness
+    argument runs per region: each member's contribution to an
+    exchange must be a disjoint slice of the stage's true input, so
+    each region gets exactly ONE source of distribution — the owned
+    partitions of deeper exchanges, or one sliced leaf."""
+    from .exec.exchange import TpuShuffleExchangeExec
+    exs: List = []
+
+    def collect(node):
+        if isinstance(node, TpuShuffleExchangeExec):
+            exs.append(node)
+        for c in getattr(node, "children", ()):
+            collect(c)
+
+    collect(plan)
+
+    def cut(node, leaves, deeper):
+        if isinstance(node, TpuShuffleExchangeExec):
+            deeper[0] = True
+            return
+        kids = getattr(node, "children", ())
+        if not kids:
+            leaves.append(node)
+        for c in kids:
+            cut(c, leaves, deeper)
+
+    out = []
+    leaves: List = []
+    deeper = [False]
+    cut(plan, leaves, deeper)
+    out.append((None, leaves, deeper[0]))
+    for ex in exs:
+        leaves, deeper = [], [False]
+        cut(ex.child, leaves, deeper)
+        out.append((ex, leaves, deeper[0]))
+    return out
+
+
+def _mesh_ineligible(plan: TpuExec) -> Optional[str]:
+    """Why this plan cannot run as ONE SPMD gang program (None = it
+    can). The gang replays the whole plan on every member and merges
+    every exchange through a collective, so each member's contribution
+    to an exchange must be a DISJOINT slice of the stage input:
+
+    - every leaf must sit below some exchange (final-region rows
+      deduplicate by partition ownership; an un-exchanged leaf would
+      be emitted once per member);
+    - a stage reading a deeper exchange must have no raw leaves beside
+      it (a replicated leaf is only provably safe under a join, and
+      the plan shape is not inspected that deeply — fall back);
+    - leaves must be splittable types, exchanges hash-partitioned over
+      ICI-expressible schemas."""
+    from .exec.base import HostBatchSourceExec
+    from .io.scan import TpuFileScanExec
+    from .shuffle.ici import _lane_spec
+    from .shuffle.partitioner import HashPartitioning
+    regions = _exchange_regions(plan)
+    if len(regions) == 1:
+        return "no shuffle exchange"
+    final_leaves = regions[0][1]
+    if final_leaves:
+        return (f"leaf {type(final_leaves[0]).__name__} above every "
+                f"exchange")
+    for ex, leaves, deeper in regions[1:]:
+        if not isinstance(ex.partitioning, HashPartitioning):
+            return f"{type(ex.partitioning).__name__} exchange"
+        try:
+            _lane_spec(ex.child.output_schema)
+        except NotImplementedError as e:
+            return f"schema not ICI-expressible: {e}"
+        if deeper and leaves:
+            return "stage mixes exchange input with raw leaves"
+        for lf in leaves:
+            if not isinstance(lf,
+                              (TpuFileScanExec, HostBatchSourceExec)):
+                return f"unsplittable leaf {type(lf).__name__}"
+    return None
+
+
+def _slice_for_member(plan: TpuExec, k: int, n: int) -> TpuExec:
+    """Gang member k's copy of the plan. Per stage region, exactly ONE
+    source distributes the input across members: regions reading a
+    deeper exchange distribute by partition ownership (their raw-leaf
+    mix is rejected by eligibility); pure-leaf regions slice their
+    most-splittable leaf k::n and replicate the rest (a join below the
+    exchange distributes over the sliced side); regions with nothing
+    splittable run whole on member 0 and empty elsewhere. Every member
+    still executes the identical program — the collectives require it —
+    an emptied scan becomes an empty host source carrying the scan's
+    op id so EXPLAIN ANALYZE folding stays stable across processes."""
+    from .exec.base import HostBatchSourceExec
+    from .io.scan import TpuFileScanExec
+    plan = copy.deepcopy(plan)
+    regions = _exchange_regions(plan)
+    counts: Dict[int, int] = {}
+    for _, leaves, _d in regions:
+        for lf in leaves:
+            counts[id(lf)] = counts.get(id(lf), 0) + 1
+    sliced: set = set()
+    member0_only: set = set()
+    for _ex, leaves, deeper in regions[1:]:
+        if deeper or not leaves:
+            continue
+        best = None
+        for lf in leaves:
+            if counts[id(lf)] > 1:
+                continue  # aliased (self-join): slicing the shared
+                # node would slice BOTH uses and drop row pairs
+            if isinstance(lf, TpuFileScanExec):
+                pieces = len(lf.paths)
+            elif isinstance(lf, HostBatchSourceExec):
+                pieces = len(lf.batches)
+            else:
+                pieces = 0
+            if pieces > 1 and (best is None or pieces > best[1]):
+                best = (lf, pieces)
+        if best is not None:
+            sliced.add(id(best[0]))
+        else:
+            member0_only.update(id(lf) for lf in leaves)
+
+    def rewrite(node):
+        if isinstance(node, TpuFileScanExec):
+            if id(node) in sliced:
+                mine = node.paths[k::n]
+            elif id(node) in member0_only and k:
+                mine = []
+            else:
+                return node
+            if mine:
+                node.paths = list(mine)
+                return node
+            repl = HostBatchSourceExec([], schema=node.output_schema)
+            repl._op_id = getattr(node, "_op_id", None)
+            return repl
+        if isinstance(node, HostBatchSourceExec):
+            if id(node) in sliced:
+                node.batches = list(node.batches[k::n])
+            elif id(node) in member0_only and k:
+                node.batches = []
+            return node
+        kids = getattr(node, "children", ())
+        if kids:
+            new = tuple(rewrite(c) for c in kids)
+            if any(a is not b for a, b in zip(new, kids)):
+                node = node.with_new_children(new)
+        return node
+
+    return rewrite(plan)
 
 
 # --- plan surgery ----------------------------------------------------------
@@ -1298,38 +1688,59 @@ def _split_leaf_input(plan: TpuExec, n: int) -> List[TpuExec]:
                 out.append(p)
         if out:
             return out
-    leaf = plan
-    while getattr(leaf, "children", ()):
-        if len(leaf.children) != 1:
-            return [plan]  # joins below an exchange: single map task
-        leaf = leaf.children[0]
-    if isinstance(leaf, TpuFileScanExec) and len(leaf.paths) > 1:
-        groups = [leaf.paths[i::n] for i in range(n)]
-        out = []
-        for g in groups:
-            if not g:
-                continue
-            p = copy.deepcopy(plan)
-            lf = p
-            while getattr(lf, "children", ()):
-                lf = lf.children[0]
-            lf.paths = list(g)
-            out.append(p)
-        return out
-    if isinstance(leaf, HostBatchSourceExec) and len(leaf.batches) > 1:
-        out = []
-        for i in range(n):
-            g = leaf.batches[i::n]
-            if not g:
-                continue
-            p = copy.deepcopy(plan)
-            lf = p
-            while getattr(lf, "children", ()):
-                lf = lf.children[0]
-            lf.batches = list(g)
-            out.append(p)
-        return out
-    return [plan]
+    # split ONE splittable leaf anywhere in the stage and replicate the
+    # rest in every task. Multi-child stages (a join below the
+    # exchange) split the side with the most input pieces: the join
+    # distributes over the split side, so the task outputs union to
+    # the full stage output — but ONLY if the other side is whole in
+    # every task, which is why exactly one leaf is ever sliced.
+    leaves: List[Tuple[tuple, TpuExec]] = []
+
+    def walk(node, path):
+        kids = getattr(node, "children", ())
+        if not kids:
+            leaves.append((path, node))
+        for i, c in enumerate(kids):
+            walk(c, path + (i,))
+
+    walk(plan, ())
+    # an aliased leaf (self-join holding the SAME node under both
+    # parents) survives deepcopy as one shared object — slicing it
+    # would slice BOTH sides and drop row pairs; leave it whole
+    counts: Dict[int, int] = {}
+    for _, lf in leaves:
+        counts[id(lf)] = counts.get(id(lf), 0) + 1
+    best = None  # (npieces, path, is_scan)
+    for path, lf in leaves:
+        if counts[id(lf)] > 1:
+            continue
+        if isinstance(lf, TpuFileScanExec) and len(lf.paths) > 1:
+            pieces, is_scan = len(lf.paths), True
+        elif isinstance(lf, HostBatchSourceExec) \
+                and len(lf.batches) > 1:
+            pieces, is_scan = len(lf.batches), False
+        else:
+            continue
+        if best is None or pieces > best[0]:
+            best = (pieces, path, is_scan)
+    if best is None:
+        return [plan]  # un-splittable stage: one map task
+    _, path, is_scan = best
+    out = []
+    for i in range(n):
+        p = copy.deepcopy(plan)
+        node = p
+        for j in path:
+            node = node.children[j]
+        pieces = (node.paths if is_scan else node.batches)[i::n]
+        if not pieces:
+            continue
+        if is_scan:
+            node.paths = list(pieces)
+        else:
+            node.batches = list(pieces)
+        out.append(p)
+    return out or [plan]
 
 
 def _contains_read(plan: TpuExec) -> bool:
@@ -1381,6 +1792,14 @@ def _main(argv: Sequence[str]) -> None:
         os.environ["JAX_PLATFORMS"] = plat
         import jax
         jax.config.update("jax_platforms", plat)
+    # multi-host mesh bootstrap (distributed/runtime.py): join the
+    # driver's coordinator and build the global Mesh BEFORE this
+    # process's first device touch (XLA_FLAGS are read at backend
+    # init), then publish the readiness marker the driver gates gang
+    # scheduling on. No-op without the mesh env; a failed bootstrap
+    # degrades this worker to classic file-shuffle tasks.
+    from .distributed import bootstrap_from_env
+    bootstrap_from_env(args.root, args.worker)
     # lock-order watchdog rides the inherited env into every worker:
     # chaos/tier-1 runs under RAPIDS_TPU_LOCKWATCH=1 verify the
     # declared hierarchy against REAL worker-side acquisition orders.

@@ -417,6 +417,45 @@ HEARTBEAT_TIMEOUT = register(
     "A hung native call (e.g. a stuck Pallas compile) holds the GIL and "
     "starves the heartbeat thread, so wedged-in-native workers trip "
     "this too.")
+MESH_ENABLED = register(
+    "spark.rapids.tpu.mesh.enabled", False,
+    "Multi-host mesh runtime: bootstrap jax.distributed across the "
+    "TpuProcessCluster worker fleet so one logical device mesh spans "
+    "every worker's local devices, and run mesh-eligible queries as "
+    "gang-scheduled SPMD tasks whose shuffle exchanges ride the ICI "
+    "collective across the process boundary (startup-time knob: the "
+    "pool wires the rendezvous env when the cluster spawns).",
+    startup_only=True)
+MESH_COORDINATOR_PORT = register(
+    "spark.rapids.tpu.mesh.coordinatorPort", 0,
+    "TCP port for the jax.distributed coordinator (hosted by worker "
+    "process 0). 0 picks a free ephemeral port at cluster boot.",
+    startup_only=True)
+MESH_DEVICES_PER_PROCESS = register(
+    "spark.rapids.tpu.mesh.devicesPerProcess", 2,
+    "Local devices each worker process contributes to the global mesh. "
+    "On the CPU backend this provisions XLA virtual devices "
+    "(--xla_force_host_platform_device_count); on real TPU hosts the "
+    "locally attached chips are used and this is a consistency check.",
+    startup_only=True)
+MESH_BOOTSTRAP_TIMEOUT = register(
+    "spark.rapids.tpu.mesh.bootstrapTimeout", 45.0,
+    "Seconds a worker blocks in the jax.distributed rendezvous (and "
+    "the driver waits for every worker's mesh-ready marker) before "
+    "mesh bootstrap is declared failed and queries fall back to the "
+    "file-based shuffle path.", startup_only=True)
+MESH_BARRIER_TIMEOUT = register(
+    "spark.rapids.tpu.mesh.barrierTimeout", 60.0,
+    "Seconds a gang member waits at a cross-process exchange barrier "
+    "(manifest rendezvous) for its peers before classifying the "
+    "exchange as a fetch failure [io] — bounds how long a gang can "
+    "wedge when a peer dies mid-stage.")
+MESH_GANG_RETRIES = register(
+    "spark.rapids.tpu.mesh.gangRetries", 1,
+    "Whole-gang retries after a gang member fails: the fleet is "
+    "respawned under a fresh mesh incarnation and the gang reruns "
+    "from scratch. Exhausting the budget falls back to the classic "
+    "file-based stage path instead of failing the query.")
 SPECULATION = register(
     "spark.rapids.tpu.speculation", False,
     "Speculative execution: launch a duplicate attempt of a task "

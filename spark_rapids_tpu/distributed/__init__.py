@@ -1,0 +1,28 @@
+"""Multi-host mesh runtime: one logical device mesh spanning the
+TpuProcessCluster's worker processes, with the ICI shuffle collective
+routed across the process boundary (SURVEY.md §5.8, §7.2-P4;
+SNIPPETS.md [1] — "on multi-process platforms such as TPU pods, pjit
+can be used to run computations across all available devices across
+processes").
+
+- `runtime` — per-process bootstrap of `jax.distributed` + the global
+  (dcn, ici) Mesh, with a graceful single-process fallback.
+- `gang` — `GangIciShuffleTransport`: the cross-process exchange, a
+  filesystem manifest barrier for global epoch sizing, per-process
+  addressable-shard assembly at the host boundary.
+"""
+from .runtime import (MeshRuntime, bootstrap_from_env, get_runtime,
+                      mesh_env, read_mesh_markers, set_runtime)
+
+__all__ = ["MeshRuntime", "bootstrap_from_env", "get_runtime",
+           "set_runtime", "mesh_env", "read_mesh_markers",
+           "GangIciShuffleTransport"]
+
+
+def __getattr__(name):
+    # gang imports jax at module load; keep the package importable for
+    # env-only helpers (mesh_env, read_mesh_markers) without it
+    if name == "GangIciShuffleTransport":
+        from .gang import GangIciShuffleTransport
+        return GangIciShuffleTransport
+    raise AttributeError(name)

@@ -1,0 +1,264 @@
+"""Per-process mesh bootstrap.
+
+Each cluster worker calls `bootstrap_from_env` BEFORE its first device
+touch: the rendezvous env (written by `TpuProcessCluster` at spawn)
+names the coordinator address, the process's rank, the fleet size, and
+the per-process device count. The worker then
+
+1. provisions its local devices (on the CPU backend: XLA virtual
+   devices via ``--xla_force_host_platform_device_count``, exactly the
+   dryrun_multichip posture),
+2. selects the cross-process collective implementation (gloo on CPU —
+   without it XLA rejects multiprocess CPU computations),
+3. joins ``jax.distributed.initialize`` with a bounded rendezvous, and
+4. builds ONE global `Mesh` over every process's devices, ordered
+   process-major and shaped hierarchically as (dcn, ici) =
+   inter-process x intra-process, so XLA routes each collective hop
+   over the matching interconnect (SURVEY.md §5.8).
+
+Failure is graceful: a timeout or version skew writes an error marker
+and the worker keeps running in single-process mode — the driver reads
+the markers and keeps mesh queries off the fleet. With no mesh env (or
+one process) the runtime is a local, non-distributed mesh: the
+single-process fallback the local `IciShuffleTransport` tests run on.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..obs.metrics import REGISTRY as _METRICS
+
+__all__ = ["MeshRuntime", "bootstrap_from_env", "get_runtime",
+           "set_runtime", "mesh_env", "read_mesh_markers",
+           "MESH_AXES"]
+
+#: hierarchical axis names: ("dcn", "ici") = processes x local devices
+MESH_AXES = ("dcn", "ici")
+
+ENV_COORD = "RAPIDS_TPU_MESH_COORD"
+ENV_NPROC = "RAPIDS_TPU_MESH_NPROC"
+ENV_PID = "RAPIDS_TPU_MESH_PID"
+ENV_LOCAL_DEVICES = "RAPIDS_TPU_MESH_LOCAL_DEVICES"
+ENV_TIMEOUT = "RAPIDS_TPU_MESH_TIMEOUT"
+ENV_INCARNATION = "RAPIDS_TPU_MESH_INCARNATION"
+
+MESH_PROCESSES = _METRICS.gauge(
+    "rapids_mesh_processes",
+    "Processes participating in the bootstrapped device mesh (0 = no "
+    "mesh this process).")
+MESH_DEVICES = _METRICS.gauge(
+    "rapids_mesh_devices",
+    "Global devices in the bootstrapped mesh (all processes).")
+
+_runtime: Optional["MeshRuntime"] = None
+
+
+def get_runtime() -> Optional["MeshRuntime"]:
+    return _runtime
+
+
+def set_runtime(rt: Optional["MeshRuntime"]) -> None:
+    global _runtime
+    _runtime = rt
+
+
+def mesh_env(coordinator: str, num_processes: int, local_devices: int,
+             timeout_s: float, incarnation: int) -> Dict[str, str]:
+    """The env slice a worker needs to join the mesh — everything but
+    its rank (`ENV_PID`), which the pool stamps per spawn."""
+    return {ENV_COORD: coordinator,
+            ENV_NPROC: str(int(num_processes)),
+            ENV_LOCAL_DEVICES: str(int(local_devices)),
+            ENV_TIMEOUT: str(float(timeout_s)),
+            ENV_INCARNATION: str(int(incarnation))}
+
+
+class MeshRuntime:
+    """One process's handle on the global mesh: the Mesh itself, this
+    process's rank and device rows, and the ownership map partition
+    routing needs (global device g belongs to process g // L — devices
+    are ordered process-major, asserted at build)."""
+
+    def __init__(self, mesh, process_id: int, num_processes: int,
+                 incarnation: int = 0, distributed: bool = False):
+        self.mesh = mesh
+        self.axis = MESH_AXES
+        self.process_id = int(process_id)
+        self.num_processes = int(num_processes)
+        self.incarnation = int(incarnation)
+        self.distributed = distributed
+        devs = list(np.asarray(mesh.devices).reshape(-1))
+        self.global_devices = len(devs)
+        assert self.global_devices % self.num_processes == 0
+        self.local_devices = self.global_devices // self.num_processes
+        self.device_kind = getattr(devs[0], "platform", "cpu")
+        lo = self.process_id * self.local_devices
+        #: global device indices this process can address
+        self.owned_rows = list(range(lo, lo + self.local_devices))
+
+    def owns_device(self, g: int) -> bool:
+        return g // self.local_devices == self.process_id
+
+    def owner_of(self, g: int) -> int:
+        return g // self.local_devices
+
+    def describe(self) -> Dict:
+        return {"process_id": self.process_id,
+                "num_processes": self.num_processes,
+                "local_devices": self.local_devices,
+                "global_devices": self.global_devices,
+                "incarnation": self.incarnation,
+                "distributed": self.distributed,
+                "device_kind": self.device_kind}
+
+
+def _build_mesh(process_id: int, num_processes: int,
+                incarnation: int, distributed: bool) -> MeshRuntime:
+    import jax
+    from jax.sharding import Mesh
+    devs = sorted(jax.devices(),
+                  key=lambda d: (d.process_index, d.id))
+    n = len(devs)
+    if n % num_processes:
+        raise RuntimeError(
+            f"{n} global devices do not divide over {num_processes} "
+            "processes — uneven per-process device counts cannot form "
+            "a (dcn, ici) mesh")
+    local = n // num_processes
+    # ownership math (owns_device) requires process-major global order;
+    # assert it instead of trusting the backend's enumeration
+    for g, d in enumerate(devs):
+        if d.process_index != g // local:
+            raise RuntimeError(
+                f"device order is not process-major at index {g} "
+                f"(process {d.process_index}); cannot map partitions "
+                "to owners")
+    arr = np.asarray(devs, dtype=object).reshape(num_processes, local)
+    mesh = Mesh(arr, MESH_AXES)
+    rt = MeshRuntime(mesh, process_id, num_processes,
+                     incarnation=incarnation, distributed=distributed)
+    MESH_PROCESSES.set(num_processes)
+    MESH_DEVICES.set(n)
+    return rt
+
+
+def bootstrap_local(num_devices: Optional[int] = None,
+                    incarnation: int = 0) -> MeshRuntime:
+    """Single-process fallback: a (1, L) mesh over this process's own
+    devices — no coordinator, no gloo, no rendezvous. The gang
+    transport degenerates to the in-process collective on it."""
+    if num_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{int(num_devices)}").strip()
+    rt = _build_mesh(0, 1, incarnation=incarnation, distributed=False)
+    set_runtime(rt)
+    return rt
+
+
+def bootstrap_from_env(root: Optional[str] = None,
+                       worker_id: Optional[int] = None,
+                       env=None) -> Optional[MeshRuntime]:
+    """Worker-side entry: join the mesh named by the rendezvous env.
+
+    Returns the runtime on success, None when no mesh is configured
+    (classic cluster mode). A FAILED bootstrap also returns None after
+    writing the error marker — the worker stays useful for file-based
+    stages and the driver routes mesh queries away. Must run before
+    this process's first device touch (XLA_FLAGS are read at backend
+    init)."""
+    env = env if env is not None else os.environ
+    coord = env.get(ENV_COORD)
+    if not coord:
+        return None
+    nproc = int(env.get(ENV_NPROC, "1"))
+    pid = int(env.get(ENV_PID, "0"))
+    incarnation = int(env.get(ENV_INCARNATION, "0"))
+    timeout_s = float(env.get(ENV_TIMEOUT, "45"))
+    local = int(env.get(ENV_LOCAL_DEVICES, "2"))
+    try:
+        if nproc <= 1:
+            rt = bootstrap_local(num_devices=local,
+                                 incarnation=incarnation)
+        else:
+            platform = env.get("JAX_PLATFORMS", "")
+            if "cpu" in platform or platform == "":
+                # REPLACE an inherited device-count flag (the driver's
+                # test env pins its own): the mesh contract is exactly
+                # `local` addressable devices per process
+                import re
+                flags = os.environ.get("XLA_FLAGS", "")
+                want = (f"--xla_force_host_platform_device_count="
+                        f"{local}")
+                if "xla_force_host_platform_device_count" in flags:
+                    flags = re.sub(
+                        r"--xla_force_host_platform_device_count=\d+",
+                        want, flags)
+                else:
+                    flags = (flags + " " + want).strip()
+                os.environ["XLA_FLAGS"] = flags
+            import jax
+            if "cpu" in platform or platform == "":
+                # without gloo, XLA rejects multiprocess CPU
+                # computations outright ("Multiprocess computations
+                # aren't implemented on the CPU backend")
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            jax.distributed.initialize(
+                coordinator_address=coord, num_processes=nproc,
+                process_id=pid,
+                initialization_timeout=int(max(1, timeout_s)))
+            rt = _build_mesh(pid, nproc, incarnation=incarnation,
+                             distributed=True)
+            set_runtime(rt)
+    except Exception as exc:  # noqa: BLE001 — bootstrap must degrade,
+        # not kill the worker: classic file-based stages still run
+        if root is not None and worker_id is not None:
+            _write_marker(root, worker_id, {
+                "ok": False, "incarnation": incarnation,
+                "error": f"{type(exc).__name__}: {exc}"[:500]})
+        return None
+    if root is not None and worker_id is not None:
+        _write_marker(root, worker_id,
+                      dict(rt.describe(), ok=True))
+    return rt
+
+
+def _write_marker(root: str, worker_id: int, doc: Dict) -> None:
+    d = os.path.join(root, "mesh")
+    try:
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"w{worker_id}.mesh.json")
+        with open(path + ".tmp", "w") as f:
+            json.dump(dict(doc, ts=time.time()), f)
+        os.replace(path + ".tmp", path)
+    except OSError:
+        pass  # driver-side readiness just times out
+
+
+def read_mesh_markers(root: str, n_workers: int,
+                      incarnation: int) -> Optional[List[Dict]]:
+    """Driver-side readiness: every worker's marker for the CURRENT
+    incarnation, or None while any is missing/stale. A marker with
+    ok=False is returned too — the caller distinguishes 'not ready
+    yet' (None) from 'bootstrap failed' (ok=False entries)."""
+    out = []
+    for w in range(n_workers):
+        path = os.path.join(root, "mesh", f"w{w}.mesh.json")
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+        if not isinstance(doc, dict) \
+                or int(doc.get("incarnation", -1)) != incarnation:
+            return None
+        out.append(doc)
+    return out

@@ -219,6 +219,11 @@ class TpuShuffleExchangeExec(UnaryExec):
         n = self.partitioning.num_partitions
         sid = next(_shuffle_ids)
         transport.register_shuffle(sid, n)
+        if hasattr(transport, "set_shuffle_schema"):
+            # SPMD gang transports need the schema up front: a process
+            # whose leaf slice produced ZERO map blocks must still pack
+            # empty slots and join the collective with the right lanes
+            transport.set_shuffle_schema(sid, self.child.output_schema)
         op_time = ctx.metric(self, "opTime")
         rows = ctx.metric(self, "numPartitions")
         rows.set(n)

@@ -742,7 +742,10 @@ class ApproxPercentile(AggregateFunction):
 
     # --- mergeable sketch (K quantile points + count) ---------------------
 
-    _MASS_SCALE = jnp.int64(1) << 42  # compound-key stride (seg, mass)
+    # compound-key stride (seg, mass) — a plain int, NOT a jnp scalar:
+    # a class-level device computation would initialize the XLA backend
+    # at import, breaking jax.distributed.initialize for mesh workers
+    _MASS_SCALE = 1 << 42
 
     def update_device(self, vals, seg, sorted_live, out_live):
         from ..ops.sort_keys import orderable_int

@@ -43,7 +43,8 @@ from ..obs.metrics import REGISTRY as _METRICS
 from ..obs.recorder import RECORDER as _FLIGHT
 from ..obs.tracer import NULL_TRACER
 
-__all__ = ["TaskSpec", "TaskScheduler", "FetchFailedError"]
+__all__ = ["TaskSpec", "TaskScheduler", "FetchFailedError",
+           "GangFailedError"]
 
 _POLL_S = 0.02
 _FIRST_BEAT_GRACE_S = 60.0  # interpreter + jax import before beat 1
@@ -56,7 +57,7 @@ _SCHED_EVENTS = _METRICS.counter(
     "/ task_failed / attempt_lost / speculative_attempt / "
     "worker_respawn / worker_blacklisted / straggler_detected / "
     "fetch_failed / spill_read_failed / stage_rerun / "
-    "query_cancelled.",
+    "query_cancelled / gang_failed / mesh_fallback.",
     ("event",))
 
 
@@ -87,6 +88,25 @@ class FetchFailedError(RuntimeError):
             f"task {task} a{attempt} (worker {worker}): shuffle "
             f"{shuffle_id} map output {map_task} unreadable "
             f"[{kind}] at {path}")
+
+
+class GangFailedError(RuntimeError):
+    """A gang-scheduled mesh stage lost a member. The gang jointly
+    executes one SPMD program whose collectives need every participant,
+    so the loss is all-or-nothing: the survivors are blocked inside (or
+    heading into) a collective the dead member will never join, and no
+    per-task retry can help. The caller (cluster.py) re-meshes the
+    fleet — fresh coordinator incarnation, every worker respawned — and
+    retries the WHOLE gang, or falls back to the classic per-stage
+    file-shuffle path."""
+
+    def __init__(self, task: str, worker: int, reason: str):
+        self.task = task
+        self.worker = worker
+        self.reason = reason
+        super().__init__(
+            f"mesh gang member {task} (worker {worker}) failed: "
+            f"{reason}")
 
 
 @dataclasses.dataclass
@@ -430,6 +450,154 @@ class TaskScheduler:
             finally:
                 self._stage_span_id = None
                 self._current_stage = ""
+
+    # --- gang scheduling --------------------------------------------------
+
+    def run_gang(self, specs: Sequence[TaskSpec],
+                 stage_label: str = "mesh gang") -> None:
+        """Gang-schedule one spec per worker (spec k is pinned to
+        worker k — the mesh process ids were assigned at spawn, so
+        placement is not a choice). The members jointly execute one
+        SPMD program: there is no per-task retry, no speculation, and
+        no partial success — the first member failure (error marker,
+        process death, heartbeat wedge, task timeout) raises
+        GangFailedError and the rest of the gang is abandoned to the
+        caller's remesh. Cooperative cancellation still works exactly
+        as in run_stage: a worker-classified QueryCancelled is adopted
+        and the normal cancel fan-out (marker publish + bounded reap)
+        runs before the classified error surfaces."""
+        if len(specs) != self.pool.n:
+            raise ValueError(
+                f"gang needs exactly one spec per worker "
+                f"({len(specs)} specs, {self.pool.n} workers)")
+        with self.tracer.span(f"stage {stage_label}", cat="stage",
+                              args={"tasks": len(specs),
+                                    "gang": True}) as sp:
+            self._stage_span_id = getattr(sp, "span_id", None)
+            self._current_stage = stage_label
+            try:
+                self._run_gang(specs, stage_label)
+            finally:
+                self._stage_span_id = None
+                self._current_stage = ""
+
+    def _run_gang(self, specs: Sequence[TaskSpec],
+                  stage_label: str) -> None:
+        deadline = time.monotonic() + self._stage_timeout
+        running: List[_Attempt] = []
+        done: set = set()
+
+        def gang_fail(att: _Attempt, reason: str):
+            att.state = "err"
+            self._close_attempt_span(att, "err", reason)
+            self._event("task_failed", att.spec.task_id, att.number,
+                        att.worker, att.runtime, reason)
+            raise GangFailedError(att.spec.task_id, att.worker, reason)
+
+        for w, spec in enumerate(specs):
+            if not self.pool.alive(w):
+                rc, err = self.pool.exit_info(w)
+                raise GangFailedError(
+                    spec.task_id, w,
+                    f"worker dead before gang launch rc={rc}: "
+                    f"{err[-500:]}")
+            n = self._attempt_seq.get(spec.task_id, 0)
+            self._attempt_seq[spec.task_id] = n + 1
+            self._launch(spec, n, w, running)
+            self._event("task_submitted", spec.task_id, n, w)
+
+        while len(done) < len(specs):
+            self._check_lifecycle(running)
+            if time.monotonic() > deadline:
+                pending = sorted(a.spec.task_id for a in running)
+                raise GangFailedError(
+                    ",".join(pending), -1,
+                    f"gang timed out after {self._stage_timeout}s")
+
+            for att in list(running):
+                if att.claim_ts is None and os.path.exists(
+                        att.path + ".claim"):
+                    att.claim_ts = time.monotonic()
+                if os.path.exists(att.path + ".ok"):
+                    att.state = "ok"
+                    running.remove(att)
+                    self._absorb_worker_spans(att)
+                    done.add(att.spec.task_id)
+                    self._close_attempt_span(att, "ok")
+                    self._event("task_ok", att.spec.task_id, att.number,
+                                att.worker, att.runtime)
+                elif os.path.exists(att.path + ".err"):
+                    try:
+                        with open(att.path + ".err") as f:
+                            tb = f.read()
+                    except OSError:
+                        tb = "(unreadable .err)"
+                    self._absorb_worker_spans(att)
+                    qc = self._read_marker(att.path, "qcancel")
+                    if qc is not None and self._qctx is not None:
+                        att.state = "err"
+                        running.remove(att)
+                        self._close_attempt_span(
+                            att, "cancelled", qc.get("reason", ""))
+                        from ..lifecycle import CANCEL_REASONS
+                        r = qc.get("reason")
+                        self._qctx.token.cancel(
+                            r if r in CANCEL_REASONS else "user",
+                            qc.get("detail", ""))
+                        self._cancel_and_reap(running)
+                    ff = self._read_marker(att.path, "fetchfail")
+                    if ff is not None:
+                        # no lineage recovery inside a gang: the map
+                        # outputs live in the collective, not on disk,
+                        # so the gang rebuild regenerates everything
+                        kind = ff.get("kind", "io")
+                        self._event(
+                            "fetch_failed", att.spec.task_id,
+                            att.number, att.worker, att.runtime,
+                            f"[{kind}] shuffle "
+                            f"{ff.get('shuffle_id', -1)} (gang)")
+                        gang_fail(
+                            att, f"collective exchange failure "
+                            f"[{kind}]: {(ff.get('detail') or '')[:300]}")
+                    gang_fail(att, tb[-2000:])
+                elif att.claim_ts is not None \
+                        and time.monotonic() - att.claim_ts \
+                        > self._task_timeout:
+                    self.pool.kill(att.worker)
+                    gang_fail(
+                        att, f"gang member exceeded "
+                        f"{self._task_timeout}s; worker "
+                        f"{att.worker} killed")
+
+            # liveness: a dead or wedged member dooms the gang. An .ok
+            # written just before death is harvested on the next pass —
+            # the member finished its slice, so it only counts as lost
+            # if the file never appeared.
+            for att in list(running):
+                w = att.worker
+                if not self.pool.alive(w):
+                    if os.path.exists(att.path + ".ok"):
+                        continue
+                    rc, err = self.pool.exit_info(w)
+                    self._clear_worker_tasks(w)
+                    gang_fail(att, f"worker died rc={rc}: {err[-2000:]}")
+                age = self.pool.heartbeat_age(w)
+                if age is None:
+                    grace = time.monotonic() - self.pool.spawn_ts(w)
+                    if grace > max(self._hb_timeout,
+                                   _FIRST_BEAT_GRACE_S):
+                        self.pool.kill(w)
+                        gang_fail(
+                            att, f"worker {w} never heartbeat "
+                            f"({grace:.1f}s since spawn)")
+                elif age > self._hb_timeout:
+                    self.pool.kill(w)
+                    gang_fail(
+                        att, f"worker {w} heartbeat stale "
+                        f"({age:.1f}s > {self._hb_timeout}s)")
+
+            if running:
+                time.sleep(_POLL_S)  # tpu-lint: allow[blocking-call-in-thread] driver poll loop, same cadence as _run_stage
 
     def _run_stage(self, specs: Sequence[TaskSpec],
                    stage_label: str) -> None:

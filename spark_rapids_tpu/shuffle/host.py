@@ -415,15 +415,8 @@ class HostShuffleTransport(ShuffleTransport):
     @staticmethod
     def _record_fetch_failure(ff: FetchFailure, partition_id: int,
                               transport: str = "host") -> None:
-        """Classified-failure tap shared by the shuffle readers: the
-        kind-labeled counter plus a flight-recorder event, so a fetch
-        failure is visible in /metrics and in the incident bundle."""
-        SHUF_FETCH_FAILURES.labels(ff.kind).inc()
-        _FLIGHT.record("shuffle", ev="fetch_failure", sid=ff.shuffle_id,
-                       part=int(partition_id), fail_kind=ff.kind,
-                       map=str(ff.map_task or ""),
-                       path=os.path.basename(ff.path or ""),
-                       transport=transport)
+        from .transport import record_fetch_failure
+        record_fetch_failure(ff, partition_id, transport)
 
     def read_partition(self, shuffle_id: int, partition_id: int):
         import time as _time

@@ -691,6 +691,11 @@ class IciShuffleTransport(ShuffleTransport):
 
     supports_unsplit = True
 
+    #: exception types `read_partition` must NOT reclassify as io fetch
+    #: failures — planner/config errors keep their identity (subclasses
+    #: extend with cooperative-cancel exceptions)
+    _passthrough_excs: Tuple[type, ...] = (NotImplementedError, ValueError)
+
     def __init__(self, mesh: Mesh, axis: str = "x", conf=None):
         from ..config import ICI_MAX_PAYLOAD, RapidsConf
         self.mesh = mesh
@@ -740,10 +745,49 @@ class IciShuffleTransport(ShuffleTransport):
     def writer(self, shuffle_id: int, map_id: int) -> ShuffleWriteHandle:
         return _IciWriter(self, shuffle_id, map_id)
 
+    def _realize_classified(self, shuffle_id: int, partition_id: int):
+        """Run the collective with host-transport failure parity: a
+        collective/runtime error surfaces as a kind-classified
+        `FetchFailure` (recorded under transport="ici"), so lineage
+        recovery and incident bundles are transport-agnostic."""
+        from .transport import FetchFailure, record_fetch_failure
+        try:
+            self._realize(shuffle_id)
+        except FetchFailure as ff:
+            record_fetch_failure(ff, partition_id, "ici")
+            raise
+        except self._passthrough_excs:
+            raise
+        except Exception as exc:
+            ff = FetchFailure(
+                shuffle_id, None, None, "io",
+                f"collective exchange failed: "
+                f"{type(exc).__name__}: {exc}"[:400])
+            record_fetch_failure(ff, partition_id, "ici")
+            raise ff from exc
+
+    def _owns_partition(self, partition_id: int, nparts: int) -> bool:
+        """Whether THIS process emits `partition_id`'s rows. Always true
+        single-process; the gang transport narrows it to the member
+        owning the partition's landing device."""
+        return True
+
     def read_partition(self, shuffle_id: int, partition_id: int):
         from .host import SHUF_BYTES_FETCHED, SHUF_PARTS_FETCHED
-        self._realize(shuffle_id)
+        from .transport import FetchFailure, record_fetch_failure
+        with self._lock:
+            known = (shuffle_id in self._nparts
+                     or shuffle_id in self._results)
+        if not known:
+            ff = FetchFailure(
+                shuffle_id, None, None, "missing",
+                "shuffle id was never registered on this transport")
+            record_fetch_failure(ff, partition_id, "ici")
+            raise ff
+        self._realize_classified(shuffle_id, partition_id)
         nparts = self._nparts.get(shuffle_id, self.ndev)
+        if not self._owns_partition(partition_id, nparts):
+            return
         SHUF_PARTS_FETCHED.labels("ici").inc()
         for b in self._results.get(shuffle_id, [[]] * nparts)[
                 partition_id]:

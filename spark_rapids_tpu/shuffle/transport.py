@@ -22,7 +22,8 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from ..columnar.batch import TpuBatch
 
 __all__ = ["ShuffleTransport", "ShuffleWriteHandle",
-           "LocalShuffleTransport", "FetchFailure", "FETCH_FAILURE_KINDS"]
+           "LocalShuffleTransport", "FetchFailure", "FETCH_FAILURE_KINDS",
+           "record_fetch_failure"]
 
 #: Classification a reader attaches to a failed shuffle fetch:
 #: ``missing`` — a block (or whole committed map output) is gone,
@@ -32,6 +33,23 @@ __all__ = ["ShuffleTransport", "ShuffleWriteHandle",
 #: ``io``      — a transient OSError that survived the reader's
 #:               bounded in-place retries.
 FETCH_FAILURE_KINDS = ("missing", "corrupt", "torn", "io")
+
+
+def record_fetch_failure(ff: "FetchFailure", partition_id: int,
+                         transport: str = "host") -> None:
+    """Classified-failure tap shared by every shuffle reader: the
+    kind-labeled counter plus a flight-recorder event, so a fetch
+    failure is visible in /metrics and in the incident bundle with the
+    SAME shape regardless of which transport the bytes rode."""
+    import os
+    from ..obs.recorder import RECORDER
+    from .host import SHUF_FETCH_FAILURES
+    SHUF_FETCH_FAILURES.labels(ff.kind).inc()
+    RECORDER.record("shuffle", ev="fetch_failure", sid=ff.shuffle_id,
+                    part=int(partition_id), fail_kind=ff.kind,
+                    map=str(ff.map_task or ""),
+                    path=os.path.basename(ff.path or ""),
+                    transport=transport)
 
 
 class FetchFailure(RuntimeError):

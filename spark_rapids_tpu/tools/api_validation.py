@@ -145,6 +145,26 @@ def generate_supported_ops() -> str:
         "groups merge only when every column takes the same (device "
         "or host) route.",
     ]
+    lines += [
+        "", "## Shuffle transports", "",
+        "`TpuShuffleExchangeExec` is transport-agnostic. In-process "
+        "collects materialize it through `IciShuffleTransport` "
+        "(`shuffle/ici.py`): the all-to-all repartition runs as one "
+        "XLA collective over the local device mesh. On a "
+        "`TpuProcessCluster` the default is the file-based HOST "
+        "transport (Arrow IPC map outputs through the filesystem "
+        "rendezvous, CRC-footed, lineage-recoverable); with "
+        "`spark.rapids.tpu.mesh.enabled` the exchange instead rides "
+        "`GangIciShuffleTransport` (`distributed/gang.py`) — the same "
+        "collective spanning every worker process over one "
+        "`(dcn, ici)` mesh. Either way a bad exchange read surfaces "
+        "as a classified `FetchFailure` "
+        "(`missing|corrupt|torn|io`) with the same metric labels "
+        "(`rapids_shuffle_fetch_failures_total{kind,transport}`): "
+        "host-transport failures recover a single map task from "
+        "lineage; ICI/gang failures fail the gang and remesh (see "
+        "README §Multi-host mesh).",
+    ]
     from ..sql import dialect_note
     lines += [
         "", "## SQL frontend", "",

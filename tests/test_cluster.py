@@ -122,3 +122,49 @@ def test_process_cluster_worker_error_surfaces(cluster):
     plan = TpuHashAggregateExec([], [Alias(Count(col("x")), "c")], exch)
     with pytest.raises(RuntimeError, match="worker task"):
         cluster.run_query(plan)
+
+
+def test_multichild_leaf_stage_splits_into_per_child_tasks(cluster):
+    """A join directly over two batch sources below ONE exchange used
+    to collapse to a single map task; the stage must split over the
+    side with the most input pieces (the other side rides whole in
+    every task) and still match the oracle."""
+    from spark_rapids_tpu.cluster import _split_leaf_input
+    rng = np.random.default_rng(9)
+    n_f, n_d = 800, 32
+    fact = pa.record_batch({
+        "fk": pa.array(rng.integers(0, n_d, n_f).astype(np.int32)),
+        "amt": pa.array(rng.integers(1, 50, n_f).astype(np.int64)),
+    })
+    dim = pa.record_batch({
+        "dk": pa.array(np.arange(n_d, dtype=np.int32)),
+        "grp": pa.array((np.arange(n_d) % 5).astype(np.int32)),
+    })
+    fact_src = HostBatchSourceExec([fact.slice(i * 200, 200)
+                                    for i in range(4)])
+    dim_src = HostBatchSourceExec([dim.slice(0, 16), dim.slice(16)])
+    join = TpuShuffledHashJoinExec([col("fk")], [col("dk")], "inner",
+                                   fact_src, dim_src)
+    gex = TpuShuffleExchangeExec(HashPartitioning([col("grp")], 3),
+                                 join)
+    plan = TpuHashAggregateExec(
+        [col("grp")], [Alias(Sum(col("amt")), "total")], gex)
+    # unit: the stage splits into n tasks, fact sliced, dim replicated
+    slices = _split_leaf_input(join, 2)
+    assert len(slices) == 2
+    for s in slices:
+        f, d = s.children
+        assert len(f.batches) == 2 and len(d.batches) == 2
+    # aliased self-join leaves must never slice (both sides would)
+    self_join = TpuShuffledHashJoinExec([col("fk")], [col("fk")],
+                                        "inner", fact_src, fact_src)
+    assert _split_leaf_input(self_join, 2) == [self_join]
+    # end to end: two map tasks for the join stage, result == oracle
+    got = cluster.run_query(plan)
+    qid = cluster._query_seq
+    join_maps = {e["task"] for e in cluster.last_scheduler.events
+                 if e["event"] == "task_ok"
+                 and e["task"].startswith(f"q{qid}s")
+                 and "m" in e["task"]}
+    assert len({t for t in join_maps if t.endswith(("m0", "m1"))}) >= 2
+    assert _rows(got) == _rows(_oracle(plan))

@@ -614,6 +614,109 @@ def run_analyze_smoke(out_dir):
     return first_profile
 
 
+def run_mesh_smoke(out_dir):
+    """Multi-host mesh CI gate (ISSUE 16): bootstrap a 2-process mesh
+    (jax.distributed across real worker processes), run one join+agg
+    query whose shuffle exchanges ride the cross-process collective,
+    and certify it dryrun_multichip-style — STRUCTURAL counters only
+    (process count, collective epochs, bytes exchanged, device_kind),
+    never wall-clock. The stitched driver trace must carry spans from
+    both member processes. Returns the trace path."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.cluster import TpuProcessCluster
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.distributed.runtime import read_mesh_markers
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.exec.base import (HostBatchSourceExec,
+                                            collect_arrow_cpu)
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.exec.joins import TpuShuffledHashJoinExec
+    from spark_rapids_tpu.expr import Alias, UnresolvedColumn as col
+    from spark_rapids_tpu.expr.aggregates import Count, Sum
+    from spark_rapids_tpu.obs.metrics import read_worker_metrics
+    from spark_rapids_tpu.shuffle.partitioner import HashPartitioning
+
+    rng = np.random.default_rng(16)
+    n_f, n_d = 1500, 40
+    fact = pa.record_batch({
+        "fk": pa.array(rng.integers(0, n_d, n_f).astype(np.int32)),
+        "amt": pa.array(rng.integers(1, 100, n_f).astype(np.int64))})
+    dim = pa.record_batch({
+        "dk": pa.array(np.arange(n_d, dtype=np.int32)),
+        "grp": pa.array((np.arange(n_d) % 6).astype(np.int32))})
+    fact_src = HostBatchSourceExec([fact.slice(i * 375, 375)
+                                    for i in range(4)])
+    dim_src = HostBatchSourceExec([dim.slice(0, 20), dim.slice(20)])
+    nparts = 4
+    lex = TpuShuffleExchangeExec(HashPartitioning([col("fk")], nparts),
+                                 fact_src)
+    rex = TpuShuffleExchangeExec(HashPartitioning([col("dk")], nparts),
+                                 dim_src)
+    join = TpuShuffledHashJoinExec([col("fk")], [col("dk")], "inner",
+                                   lex, rex)
+    gex = TpuShuffleExchangeExec(HashPartitioning([col("grp")], nparts),
+                                 join)
+    plan = TpuHashAggregateExec(
+        [col("grp")], [Alias(Sum(col("amt")), "total"),
+                       Alias(Count(col("amt")), "n")], gex)
+
+    conf = RapidsConf({
+        "spark.rapids.tpu.mesh.enabled": "true",
+        "spark.rapids.metrics.enabled": "true",
+        "spark.rapids.trace.dir": os.path.join(out_dir, "traces")})
+    with TpuProcessCluster(n_workers=2, conf=conf) as c:
+        got = c.run_query(plan)
+        evs = c.last_scheduler.events
+        falls = [e for e in evs if e["event"] == "mesh_fallback"]
+        assert not falls, f"mesh smoke fell back: {falls}"
+        oks = [e for e in evs if e["event"] == "task_ok"]
+        assert len(oks) == 2 and all("g0w" in e["task"] for e in oks), \
+            f"expected one gang task per process: {oks}"
+        # bootstrap markers: both processes joined ONE distributed mesh
+        markers = read_mesh_markers(c.root, 2, 0)
+        assert markers and all(
+            d["ok"] and d["distributed"] for d in markers), markers
+        kind = markers[0]["device_kind"]
+        assert kind, "device_kind missing from mesh marker"
+        assert all(int(d["num_processes"]) == 2 for d in markers)
+        # structural collective counters, per process
+        epochs, nbytes = {}, {}
+        for tag, ms in read_worker_metrics(c.root):
+            w = tag.split(".")[0]
+            for fam_name, acc in (
+                    ("rapids_mesh_collective_epochs_total", epochs),
+                    ("rapids_mesh_collective_bytes_total", nbytes)):
+                fam = ms.get(fam_name)
+                if fam:
+                    for _, v in fam["samples"].items():
+                        acc[w] = max(acc.get(w, 0), int(v))
+        assert len(epochs) == 2 and all(v >= 1 for v in epochs.values()), \
+            f"both processes must run collective epochs: {epochs}"
+        assert sum(nbytes.values()) > 0, \
+            f"no bytes crossed the process boundary: {nbytes}"
+        trace_path = c.last_trace_path
+    # correctness: the gang result matches the in-process oracle
+    from spark_rapids_tpu.columnar.arrow_bridge import arrow_schema
+    want = collect_arrow_cpu(plan).cast(arrow_schema(plan.output_schema))
+    key = lambda t: sorted(map(tuple, (r.values() for r in t.to_pylist())))  # noqa: E731
+    assert key(got) == key(want), "gang result != oracle"
+    # the stitched trace carries both member processes' spans
+    assert trace_path and os.path.exists(trace_path), "no trace written"
+    with open(trace_path) as f:
+        doc = json.load(f)
+    pids = {ev.get("pid") for ev in doc.get("traceEvents", [])
+            if ev.get("ph") == "X"}
+    assert {1, 2} <= pids, \
+        f"trace not stitched across both worker processes: pids={pids}"
+    print(f"mesh smoke: 2-process gang mesh ({kind}), "
+          f"epochs={sum(epochs.values())}, "
+          f"bytes={sum(nbytes.values())}, trace stitched from "
+          f"pids={sorted(pids)}")
+    return trace_path
+
+
 def run_smoke(out_dir):
     """One tiny query with tracing + metrics on; returns (trace_path,
     prom_path)."""
@@ -1047,6 +1150,14 @@ def main(argv=None):
                          "process cluster: nonzero rows at every "
                          "scan/join/agg node, a valid profile json, "
                          "and a clean profiling compare of two runs")
+    ap.add_argument("--mesh-smoke", metavar="DIR", dest="mesh_smoke",
+                    help="bootstrap a 2-process jax.distributed mesh "
+                         "over the worker fleet, run one gang join+agg "
+                         "whose exchanges cross the process boundary, "
+                         "gate on structural counters (process count, "
+                         "collective epochs, bytes, device_kind — "
+                         "never wall-clock) and validate the stitched "
+                         "trace")
     ap.add_argument("--lint-report", dest="lint_report",
                     help="tpu-lint 2.0 JSON report to schema-validate "
                          "(and gate on zero unbaselined violations)")
@@ -1103,6 +1214,10 @@ def main(argv=None):
         os.makedirs(args.analyze_smoke, exist_ok=True)
         profiles.append(run_analyze_smoke(args.analyze_smoke))
         print(f"analyze smoke output: {profiles[-1]}")
+    if args.mesh_smoke:
+        os.makedirs(args.mesh_smoke, exist_ok=True)
+        trace = run_mesh_smoke(args.mesh_smoke) or trace
+        print(f"mesh smoke output: {trace}")
     if not trace and not prom and not flights and not ran_sql \
             and not profiles and not args.lint_report \
             and not args.lockwatch:
@@ -1110,7 +1225,8 @@ def main(argv=None):
                  "--scan-smoke/--fusion-smoke/--flight/--flight-smoke/"
                  "--shuffle-smoke/--lifecycle-smoke/--spill-smoke/"
                  "--sql-smoke/--profile/"
-                 "--analyze-smoke/--lint-report/--lockwatch")
+                 "--analyze-smoke/--mesh-smoke/--lint-report/"
+                 "--lockwatch")
     if args.lint_report:
         errors += [f"[lint] {e}"
                    for e in check_lint_report(args.lint_report)]
