@@ -522,6 +522,11 @@ def main():
     enable_compile_cache()
     import jax
 
+    # stamped into every human-readable summary line below: on a
+    # CPU-only host the wall numbers are noise (ROADMAP re-anchor),
+    # and a reader of stderr alone must be able to tell
+    dev_kind = jax.devices()[0].device_kind
+
     import spark_rapids_tpu  # noqa: F401
     from spark_rapids_tpu import datatypes as dt
     from spark_rapids_tpu.columnar.batch import TpuBatch, bucket_rows
@@ -536,7 +541,8 @@ def main():
     # the tunneled session to synchronous dispatch. Correctness
     # downloads are deferred to the end of the run.
     nds_geomean, nds_detail, nds_verify = bench_nds_subset()
-    print(f"nds subset: geomean {nds_geomean}x host pandas; "
+    print(f"nds subset [device_kind={dev_kind}]: geomean "
+          f"{nds_geomean}x host pandas; "
           + "; ".join(f"{k} {v['vs_host']}x" for k, v in
                       nds_detail.items()), file=sys.stderr)
 
@@ -545,7 +551,8 @@ def main():
         os.path.abspath(__file__)), ".bench_cache", "nds_parquet")
     (nds_files_geo, nds_files_detail, nds_files_verify, nds_chunks,
      nds_profiles_fn) = bench_nds_from_files(nds_files_dir)
-    print(f"nds from-files: geomean {nds_files_geo}x host "
+    print(f"nds from-files [device_kind={dev_kind}]: geomean "
+          f"{nds_files_geo}x host "
           "(pandas read_parquet + compute); "
           + "; ".join(f"{k} {v['vs_host']}x" for k, v in
                       nds_files_detail.items())
@@ -844,34 +851,80 @@ def main():
     from spark_rapids_tpu.config import RapidsConf as _RC
     import tempfile as _tempfile
     obs_trace_dir = _tempfile.mkdtemp(prefix="bench_obs_trace_")
+    obs_wh_dir = _tempfile.mkdtemp(prefix="bench_obs_wh_")
+    # the /status endpoint rides the ON side too: an idle daemon
+    # accept() thread must cost nothing while queries run
+    import socket as _socket
+    _probe = _socket.socket()
+    _probe.bind(("127.0.0.1", 0))
+    obs_status_port = _probe.getsockname()[1]
+    _probe.close()
     # opmetrics rides the A/B too: the always-on per-operator
     # accounting (rows/batches/bytes shims, obs/opmetrics.py) must fit
     # inside the same <=5% overhead envelope as the recorder + tracing
+    # — and since ISSUE 17 the telemetry-warehouse writer (one counter
+    # snapshot + one sealed JSON append per query) does as well
     ctx_obs_off = ExecCtx(_RC({"spark.rapids.flight.enabled": "false",
                                "spark.rapids.metrics.op.enabled":
+                               "false",
+                               "spark.rapids.warehouse.enabled":
                                "false"}))
     ctx_obs_on = ExecCtx(_RC({"spark.rapids.flight.enabled": "true",
                               "spark.rapids.metrics.op.enabled": "true",
-                              "spark.rapids.trace.dir": obs_trace_dir}))
+                              "spark.rapids.trace.dir": obs_trace_dir,
+                              "spark.rapids.warehouse.enabled": "true",
+                              "spark.rapids.warehouse.dir": obs_wh_dir,
+                              "spark.rapids.metrics.port":
+                              str(obs_status_port)}))
+    from spark_rapids_tpu.obs.metrics import maybe_start_http_server
+    maybe_start_http_server(ctx_obs_on.conf)
 
-    def _time_obs(c):
+    def _one_obs(c):
         # the flight recorder is a process-wide singleton and the LAST
         # ExecCtx construction above configured it — re-adopt THIS
         # run's conf so the off timing really runs with it off
+        from spark_rapids_tpu.obs.attribution import QueryAttribution
         from spark_rapids_tpu.obs.recorder import RECORDER
         RECORDER.configure(c.conf)
-        ts = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            o = list(plan_files.execute(c))
-            jax.block_until_ready(o)
-            ts.append(time.perf_counter() - t0)
-        return sorted(ts)[1]
-    obs_off_t = _time_obs(ctx_obs_off)
-    obs_on_t = _time_obs(ctx_obs_on)
+        t0 = time.perf_counter()
+        # warehouse bracket exactly as planner.collect runs it —
+        # except folded={}: fold_ctx finalizes the opm collector,
+        # whose device readback would flip the tunneled session to
+        # synchronous dispatch and poison phases 2c/2d/3
+        attrib = QueryAttribution.begin(c.conf)
+        o = list(plan_files.execute(c))
+        jax.block_until_ready(o)
+        if attrib is not None:
+            attrib.finish(root=plan_files, folded={}, qctx=None,
+                          wall_s=time.perf_counter() - t0,
+                          source="bench")
+        return time.perf_counter() - t0
+    # interleaved off/on pairs: a block design (3x off, then 3x on)
+    # credits any monotonic host drift entirely to the ON side, which
+    # on a loaded single-core host can dwarf the layer being measured
+    obs_off_ts, obs_on_ts = [], []
+    for _ in range(3):
+        obs_off_ts.append(_one_obs(ctx_obs_off))
+        obs_on_ts.append(_one_obs(ctx_obs_on))
+    obs_off_t = sorted(obs_off_ts)[1]
+    obs_on_t = sorted(obs_on_ts)[1]
     obs_overhead_frac = round(max(0.0, obs_on_t / obs_off_t - 1.0), 4)
-    print(f"obs overhead: on {obs_on_t*1e3:.1f} ms vs off "
-          f"{obs_off_t*1e3:.1f} ms -> {obs_overhead_frac:.1%}",
+    from spark_rapids_tpu.obs.warehouse import read_rows as _wh_read
+    obs_wh_rows = len(_wh_read(obs_wh_dir))
+    # the endpoint must serve valid JSON while enabled (read AFTER the
+    # timed loops — the HTTP roundtrip is not part of the overhead)
+    obs_status_ok = False
+    try:
+        from urllib.request import urlopen
+        with urlopen(f"http://127.0.0.1:{obs_status_port}/status",
+                     timeout=5) as resp:
+            obs_status_ok = isinstance(json.load(resp), dict)
+    except Exception:  # noqa: BLE001 — sandboxed environments
+        pass
+    print(f"obs overhead [device_kind={dev_kind}]: on "
+          f"{obs_on_t*1e3:.1f} ms vs off "
+          f"{obs_off_t*1e3:.1f} ms -> {obs_overhead_frac:.1%} "
+          f"(warehouse rows {obs_wh_rows}, /status ok {obs_status_ok})",
           file=sys.stderr)
     # restore the process-wide recorder default for the rest of the run
     ExecCtx()
@@ -902,7 +955,8 @@ def main():
     lc_on_t = _time_lc(ctx_lc_on)
     lifecycle_overhead_frac = round(
         max(0.0, lc_on_t / lc_off_t - 1.0), 4)
-    print(f"lifecycle overhead: on {lc_on_t*1e3:.1f} ms vs off "
+    print(f"lifecycle overhead [device_kind={dev_kind}]: on "
+          f"{lc_on_t*1e3:.1f} ms vs off "
           f"{lc_off_t*1e3:.1f} ms -> {lifecycle_overhead_frac:.1%}",
           file=sys.stderr)
 
@@ -932,7 +986,8 @@ def main():
     fusion_ab = {"fused_ms": round(fu_on_t * 1e3, 1),
                  "unfused_ms": round(fu_off_t * 1e3, 1),
                  "fused_speedup": round(fu_off_t / fu_on_t, 3)}
-    print(f"whole-stage fusion: fused {fu_on_t*1e3:.1f} ms vs unfused "
+    print(f"whole-stage fusion [device_kind={dev_kind}]: fused "
+          f"{fu_on_t*1e3:.1f} ms vs unfused "
           f"{fu_off_t*1e3:.1f} ms -> {fusion_ab['fused_speedup']}x",
           file=sys.stderr)
 
@@ -973,15 +1028,23 @@ def main():
     # --- roofline honesty ------------------------------------------------
     bytes_touched = sum(b.device_size_bytes() for b in batches)
     achieved_gbs = bytes_touched / tpu_dev_t / 1e9
-    kind = jax.devices()[0].device_kind
+    kind = dev_kind
     peak = HBM_PEAK_GBS.get(kind)
     frac = round(achieved_gbs / peak, 3) if peak else None
+    # BENCH_r07 printed "peak None GB/s -> None" on the CPU-only host:
+    # there is no HBM roofline to compare against, say so instead of
+    # rendering None-arithmetic
+    if peak:
+        roofline_txt = (f"achieved {achieved_gbs:.0f} GB/s of {kind} "
+                        f"peak {peak} GB/s -> {frac}")
+    else:
+        roofline_txt = f"(no device roofline: device_kind={kind})"
 
-    print(f"from-files pipeline: {tpu_file_t*1e3:.1f} ms (host "
+    print(f"from-files pipeline [device_kind={dev_kind}]: "
+          f"{tpu_file_t*1e3:.1f} ms (host "
           f"{host_file_t*1e3:.1f} ms); compute-only {tpu_dev_t*1e3:.2f} ms "
-          f"(host in-mem {host_mem_t*1e3:.2f} ms); achieved "
-          f"{achieved_gbs:.0f} GB/s of {kind} peak {peak} GB/s "
-          f"-> {frac}", file=sys.stderr)
+          f"(host in-mem {host_mem_t*1e3:.2f} ms); "
+          f"{roofline_txt}", file=sys.stderr)
 
     # --- tunnel probes (post-timing-safe: uploads only) ------------------
     # Bandwidth needs a buffer big enough that per-RPC latency is noise:
@@ -1027,7 +1090,8 @@ def main():
         _ = run_join()
         sync_times.append(time.perf_counter() - t0)
     join_sync_t = min(sync_times)
-    print(f"join+group-by: {join_mrows} Mrows/s pipelined "
+    print(f"join+group-by [device_kind={dev_kind}]: {join_mrows} "
+          f"Mrows/s pipelined "
           f"({join_vs}x host numpy); sync-dispatch regime "
           f"{join_rows / join_sync_t / 1e6:.1f} Mrows/s", file=sys.stderr)
 
@@ -1093,6 +1157,12 @@ def main():
         "obs_overhead_frac": obs_overhead_frac,
         "obs_on_ms": round(obs_on_t * 1e3, 1),
         "obs_off_ms": round(obs_off_t * 1e3, 1),
+        # the ON side of the A/B above also ran the ISSUE 17 telemetry
+        # warehouse (one sealed row per timed run) and the /status
+        # endpoint; rows written + endpoint liveness, audited here so a
+        # silently-disabled warehouse can't fake a low overhead number
+        "obs_warehouse_rows": obs_wh_rows,
+        "obs_status_ok": obs_status_ok,
         # query-lifecycle overhead audit (per-batch cancellation/
         # deadline checks + budget-aware retry scopes, QueryContext
         # threaded vs lifecycle off, same warm pipeline): the

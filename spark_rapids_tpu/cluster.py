@@ -823,6 +823,10 @@ class TpuProcessCluster:
         # the /metrics port belongs to the driver; the cluster driver
         # never builds an ExecCtx, so bind it here rather than lazily
         maybe_start_http_server(self.conf)
+        # /status enrichment: in-flight query phase, scheduler view,
+        # mesh/gang health, warehouse tail (obs/metrics.render_status)
+        from .obs.metrics import set_status_provider
+        set_status_provider(self._status_doc)
         # always-on flight recorder (spark.rapids.flight.*): the driver
         # ring records scheduler/shuffle/memory events passively; an
         # anomaly turns it into an incident bundle at query end
@@ -841,10 +845,53 @@ class TpuProcessCluster:
             pass
 
     def shutdown(self) -> None:
+        from .obs.metrics import clear_status_provider
+        clear_status_provider(self._status_doc)
         self.pool.shutdown()
         if self._own_root:
             import shutil
             shutil.rmtree(self.root, ignore_errors=True)
+
+    def _status_doc(self) -> Dict:
+        """The cluster's /status contribution (obs/metrics.py): live
+        fleet state a scrape can read mid-query. Every field is a
+        plain read of driver-side state — no locks, no device work."""
+        q = self._running_qctx
+        in_flight = []
+        if q is not None:
+            in_flight.append({
+                "query_id": q.query_id, "tenant": q.tenant,
+                "phase": getattr(q, "phase", "unknown"),
+                "cancelled": q.token.reason})
+        doc: Dict = {
+            "cluster": {"n_workers": self.n_workers, "root": self.root},
+            "in_flight": in_flight,
+        }
+        sched = self.last_scheduler
+        if sched is not None and q is not None:
+            try:
+                doc["scheduler"] = sched.live_status()
+            except Exception:  # noqa: BLE001 — status is best-effort
+                pass
+        last_fb = None
+        if sched is not None:
+            for ev in reversed(sched.events):
+                if ev.get("event") == "mesh_fallback":
+                    last_fb = ev.get("reason")
+                    break
+        doc["mesh"] = {"enabled": self._mesh_enabled,
+                       "incarnation": self._mesh_incarnation,
+                       "last_fallback": last_fb}
+        try:
+            from .obs.warehouse import (STATUS_ROWS, tail_rows,
+                                        warehouse_dir)
+            d = warehouse_dir(self.conf)
+            if d:
+                doc["warehouse_tail"] = tail_rows(
+                    d, self.conf.get(STATUS_ROWS))
+        except Exception:  # noqa: BLE001
+            pass
+        return doc
 
     def cancel_running(self, detail: str = "user requested") -> bool:
         """Cancel the in-flight ``run_query`` (thread-safe): flips the
@@ -910,6 +957,12 @@ class TpuProcessCluster:
         # cancel_running targets only a LIVE query: cancelling after
         # completion must be a no-op, not phantom cancel evidence
         self._running_qctx = qctx
+        # telemetry warehouse bracket (obs/attribution.py): driver +
+        # worker counter baselines now; ONE sealed row in the finally
+        # below, whatever the outcome. cluster_root lets finish() fold
+        # worker registry deltas and mine gang mesh_epoch ring events.
+        from .obs.attribution import QueryAttribution
+        attrib = QueryAttribution.begin(conf, cluster_root=self.root)
         tracer = tracer_from_conf(conf)
         RECORDER.configure(conf)
         sched = TaskScheduler(self.pool, os.path.join(self.root, "tasks"),
@@ -922,6 +975,7 @@ class TpuProcessCluster:
         t0 = time.time()
         t0_mono = time.monotonic()
         ok = False
+        err = None
         try:
             args = None
             if tracer.enabled:  # tree-walk + sha1 only when traced
@@ -940,6 +994,8 @@ class TpuProcessCluster:
                 gate = DeviceMemoryManager.shared(conf).task_slot(qctx) \
                     if qctx is not None else contextlib.nullcontext()
                 with gate:
+                    if qctx is not None:
+                        qctx.phase = "running"
                     if self._mesh_route(plan, conf, sched):
                         result = self._run_query_mesh(
                             plan, conf, settings, qid, sched)
@@ -949,6 +1005,7 @@ class TpuProcessCluster:
             ok = True
             return result
         except QueryCancelled as e:
+            err = e
             # classified cancel: one scheduler event (the anomaly the
             # incident harvest keys on — the scheduler emits it on ITS
             # detection paths; admission/driver-side raises land here)
@@ -966,6 +1023,9 @@ class TpuProcessCluster:
                                     cluster="process")
             except OSError:
                 pass
+            raise
+        except BaseException as e:
+            err = e  # warehouse outcome classification (finally below)
             raise
         finally:
             self._running_qctx = None
@@ -993,6 +1053,18 @@ class TpuProcessCluster:
             log_scheduler_events(conf, f"q{qid}", sched, wall_s,
                                  op_sinks=top_op_sinks(
                                      self.last_opmetrics))
+            # warehouse row, whatever the outcome: a crashed worker's
+            # query still gets a row with outcome=failed and whatever
+            # partial attribution the .opm harvest above recovered
+            if attrib is not None:
+                from .obs.opmetrics import plan_source
+                attrib.finish(
+                    root=plan, folded=self.last_opmetrics, qctx=qctx,
+                    wall_s=wall_s, source=plan_source(plan),
+                    cluster={"kind": "process",
+                             "n_workers": self.n_workers,
+                             "mesh_incarnation": self._mesh_incarnation},
+                    error=err)
             if ok:
                 from .obs.metrics import QUERY_DURATION
                 from .obs.opmetrics import plan_source

@@ -365,7 +365,11 @@ class GangIciShuffleTransport(IciShuffleTransport):
         _FLIGHT.record("shuffle", ev="mesh_epoch", sid=int(sid),
                        epoch=int(epoch), bytes=int(sent),
                        nproc=self._rt.num_processes,
-                       process=self._rt.process_id)
+                       process=self._rt.process_id,
+                       # owning query: the warehouse attributes gang-DCN
+                       # bytes to the query that ran the collective
+                       query=(self._qctx.query_id
+                              if self._qctx is not None else ""))
 
         # readback through ADDRESSABLE shards only — a device_get of the
         # global arrays would span devices this process cannot address

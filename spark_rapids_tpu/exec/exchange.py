@@ -225,8 +225,29 @@ class TpuShuffleExchangeExec(UnaryExec):
             # empty slots and join the collective with the right lanes
             transport.set_shuffle_schema(sid, self.child.output_schema)
         op_time = ctx.metric(self, "opTime")
-        rows = ctx.metric(self, "numPartitions")
-        rows.set(n)
+        ctx.metric(self, "numPartitions").set(n)
+        # write-side row attribution: the map phase counts every row it
+        # partitions (the AQE reader and cluster map tasks drive the
+        # exchange through materialize, never through execute(), so
+        # without this the exchange shows rows=0 while its consumers
+        # see the full stream — blinding the warehouse and any fitted
+        # cost model at exactly the operator the planner prices).
+        # opm.enter claims the node so the non-AQE execute() path —
+        # whose counting shim already counts the read side — never
+        # double counts.
+        opm = getattr(ctx, "opm", None)
+        claimed = opm is not None and opm.enabled and opm.enter(self)
+        rows_m = ctx.metric(self, "rows") if claimed else None
+        try:
+            return self._materialize_write(ctx, transport, unsplit, n,
+                                           sid, op_time, rows_m)
+        finally:
+            if claimed:
+                opm.exit(self)
+
+    def _materialize_write(self, ctx: ExecCtx, transport, unsplit: bool,
+                           n: int, sid: int, op_time,
+                           rows_m) -> "ShuffleStageHandle":
         from ..shuffle.partitioner import RangePartitioning
         needs_bounds = isinstance(self.partitioning, RangePartitioning) \
             and self.partitioning.bounds is None
@@ -253,6 +274,8 @@ class TpuShuffleExchangeExec(UnaryExec):
             # a second same-metric writer on this thread would race it
             write_t = ctx.metric(self, "writeTime")
             for map_id, (batch, split) in enumerate(stream):
+                if rows_m is not None:
+                    ctx.opm.count_rows(rows_m, batch)
                 writer = transport.writer(sid, map_id)
                 t0 = time.perf_counter()
                 if unsplit:
@@ -270,6 +293,8 @@ class TpuShuffleExchangeExec(UnaryExec):
             self._jit_split = jax.jit(fn, static_argnums=1)
         source = self._with_range_bounds_device(ctx)
         for map_id, batch in enumerate(source):
+            if rows_m is not None:
+                ctx.opm.count_rows(rows_m, batch)
             writer = transport.writer(sid, map_id)
             t0 = time.perf_counter()
             if unsplit:

@@ -311,6 +311,12 @@ class QueryContext:
             self.query_id, deadline_s=self.deadline_s)
         self.ladder = DegradationLadder(self) \
             if conf.get(LADDER_ENABLED) else None
+        # coarse lifecycle phase for the /status endpoint: created ->
+        # queued -> admitted -> running (best-effort, read unlocked)
+        self.phase = "created"
+        # measured wait in the fair-admission queue, stamped on grant;
+        # the warehouse row reads it for per-query cost attribution
+        self.admission_wait_s = 0.0
 
     @classmethod
     def from_conf(cls, conf: RapidsConf,
@@ -543,6 +549,8 @@ class FairAdmissionController:
                          f"no admission slot within {self._timeout}s "
                          f"(tenant {tenant!r})")
         w = _Waiter(tenant, qid)
+        if qctx is not None:
+            qctx.phase = "queued"
         with self._cv:
             q = self._queues.setdefault(tenant, deque())
             if qctx is not None and len(q) >= self._max_queue:
@@ -582,6 +590,9 @@ class FairAdmissionController:
                     q.remove(w)
                 self._queue_gauge(tenant)
         ADMISSION_WAIT.observe(time.monotonic() - t0)
+        if qctx is not None:
+            qctx.phase = "admitted"
+            qctx.admission_wait_s = time.monotonic() - t0
         return _Slot(self, tenant, qid)
 
     def _reject(self, token: CancellationToken, detail: str):

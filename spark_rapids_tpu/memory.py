@@ -80,6 +80,11 @@ _DISK_IN_USE = _METRICS.gauge(
     "LIVE disk-tier spill residency (bytes of committed spill files "
     "not yet read back or released) — returns to zero when every "
     "query's batches are released.")
+_SPILL_READ_BYTES = _METRICS.counter(
+    "rapids_spill_read_bytes_total",
+    "Total bytes read back (and CRC-verified) from the disk spill "
+    "tier. With the write counters this closes the spill byte "
+    "ledger per query for the telemetry warehouse.")
 _SPILL_READ_FAILURES = _METRICS.counter(
     "rapids_spill_read_failures_total",
     "Spill-file read-backs that failed verification, classified: "
@@ -545,6 +550,7 @@ class SpillableBatch:
                 on_retry=lambda n, e: mgr._flight_mem(
                     "spill_read_retry", 0, n=n, error=str(e)[:120]),
                 missing_detail="committed spill file is gone")
+            _SPILL_READ_BYTES.inc(len(payload))
             table = pa.ipc.open_file(
                 pa.BufferReader(payload)).read_all().combine_chunks()
         except SpillReadError as e:

@@ -28,6 +28,7 @@ import itertools
 import json
 import os
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..config import register
@@ -35,7 +36,8 @@ from ..config import register
 __all__ = ["METRICS_ENABLED", "METRICS_PORT", "MetricsRegistry",
            "REGISTRY", "dump_prometheus", "maybe_start_http_server",
            "render_merged_snapshots", "DEFAULT_BUCKETS",
-           "TRANSFER_BUCKETS"]
+           "TRANSFER_BUCKETS", "render_status", "set_status_provider",
+           "clear_status_provider"]
 
 METRICS_ENABLED = register(
     "spark.rapids.metrics.enabled", False,
@@ -371,6 +373,59 @@ def dump_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
 _http_lock = threading.Lock()
 _http_server = None
 
+# /status enrichment: a component with live fleet state (the process
+# cluster) registers a zero-arg provider returning a JSON-able dict
+# merged into the base snapshot. One provider per process (a second
+# registration replaces the first — same last-writer-wins contract as
+# the ledger gauges).
+_status_provider = None
+
+
+def set_status_provider(fn) -> None:
+    global _status_provider
+    _status_provider = fn
+
+
+def clear_status_provider(fn=None) -> None:
+    """Unregister (only ``fn`` itself when given — a stale shutdown
+    must not clobber a newer cluster's provider)."""
+    global _status_provider
+    if fn is None or _status_provider is fn:
+        _status_provider = None
+
+
+def render_status() -> Dict:
+    """The /status JSON document: process vitals, memory-ledger
+    occupancy, admission-queue depths per tenant, and whatever the
+    registered provider (cluster: in-flight query, mesh/gang health,
+    warehouse tail) contributes. Every section is best-effort — a
+    half-initialized runtime still serves valid JSON."""
+    doc: Dict = {"ts": time.time(), "pid": os.getpid()}
+    try:
+        from ..memory import DeviceMemoryManager
+        mm = DeviceMemoryManager.shared()
+        doc["memory"] = {
+            "device_bytes_in_use": int(mm.device_bytes),
+            "device_budget_bytes": int(mm.budget),
+            "host_bytes_in_use": int(mm.host_bytes),
+            "disk_in_use_bytes": int(mm.disk_in_use_bytes),
+            "disk_limit_bytes": int(mm.disk_limit),
+            "spill_bytes_total": int(mm.spill_bytes),
+            "disk_spill_bytes_total": int(mm.disk_spill_bytes),
+        }
+        doc["admission"] = mm.admission.snapshot()
+    except Exception as e:  # noqa: BLE001 — vitals stay best-effort
+        doc["memory_error"] = f"{type(e).__name__}: {e}"[:200]
+    prov = _status_provider
+    if prov is not None:
+        try:
+            extra = prov()
+            if isinstance(extra, dict):
+                doc.update(extra)
+        except Exception as e:  # noqa: BLE001
+            doc["provider_error"] = f"{type(e).__name__}: {e}"[:200]
+    return doc
+
 
 def maybe_start_http_server(conf) -> Optional[int]:
     """Start the /metrics endpoint once per process when
@@ -394,13 +449,18 @@ def maybe_start_http_server(conf) -> Optional[int]:
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):
-                if self.path.rstrip("/") not in ("", "/metrics"):
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path == "/status":
+                    body = json.dumps(render_status()).encode()
+                    ctype = "application/json"
+                elif path in ("", "/metrics"):
+                    body = dump_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
                     self.send_error(404)
                     return
-                body = dump_prometheus().encode()
                 self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

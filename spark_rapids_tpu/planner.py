@@ -176,6 +176,11 @@ class PhysicalPlan:
             qctx = QueryContext.from_conf(self.conf)
         ctx.qctx = qctx
         self.last_qctx = qctx
+        # telemetry warehouse bracket: counter baselines now, one
+        # sealed row at every exit (completed/cancelled/degraded/
+        # failed) — obs/attribution.py. None when the warehouse is off.
+        from .obs.attribution import QueryAttribution
+        attrib = QueryAttribution.begin(self.conf)
         from .config import PROFILE_PATH
         from .columnar.arrow_bridge import arrow_schema
         import contextlib
@@ -203,6 +208,12 @@ class PhysicalPlan:
                     rbs = self._collect_cpu(ctx)
         except QueryCancelled as e:
             self._report_cancel(ctx, e, _time.perf_counter() - _t0)
+            self._emit_warehouse(attrib, ctx, qctx,
+                                 _time.perf_counter() - _t0, error=e)
+            raise
+        except BaseException as e:
+            self._emit_warehouse(attrib, ctx, qctx,
+                                 _time.perf_counter() - _t0, error=e)
             raise
         finally:
             # width-1 exclusivity must not outlive the query (a
@@ -228,6 +239,7 @@ class PhysicalPlan:
         from .tools.event_log import log_query_event
         log_query_event(self, ctx, wall_s)
         self._write_profile(ctx, wall_s)
+        self._emit_warehouse(attrib, ctx, qctx, wall_s)
         return pa.Table.from_batches(rbs, schema=schema)
 
     def _collect_device(self, ctx: ExecCtx, qctx) -> List:
@@ -307,6 +319,23 @@ class PhysicalPlan:
                                 source=self.source)
         except OSError:
             pass  # evidence must never mask the cancellation
+
+    def _emit_warehouse(self, attrib, ctx, qctx, wall_s: float,
+                        error=None) -> None:
+        """One telemetry-warehouse row for this collect — the folded
+        per-operator metrics carry exact scan/fusion/row attribution;
+        counter deltas (inside ``finish``) carry transports and spill.
+        Best-effort like ``_write_profile``: telemetry never fails the
+        query it describes."""
+        if attrib is None:
+            return
+        try:
+            from .obs.opmetrics import fold_ctx
+            folded = fold_ctx(ctx)
+        except Exception:  # noqa: BLE001 — partial row beats no row
+            folded = {}
+        attrib.finish(root=self.root, folded=folded, qctx=qctx,
+                      wall_s=wall_s, source=self.source, error=error)
 
     def _write_profile(self, ctx: ExecCtx, wall_s: float) -> None:
         """Persist one query-profile JSON (spark.rapids.history.dir) —

@@ -276,6 +276,21 @@ class TaskScheduler:
             "retry_overhead_s": round(overhead, 6),
         }
 
+    def live_status(self) -> Dict:
+        """Point-in-time view for the /status endpoint: the running
+        stage plus monotonic event counts. Read unlocked from another
+        thread — the event list only appends, so a snapshot copy is
+        always a consistent prefix."""
+        c: Dict[str, int] = {}
+        for e in list(self.events):
+            c[e["event"]] = c.get(e["event"], 0) + 1
+        return {"query_id": self.query_id,
+                "stage": self._current_stage,
+                "tasks_ok": c.get("task_ok", 0),
+                "tasks_failed": c.get("task_failed", 0),
+                "stage_reruns": c.get("stage_rerun", 0),
+                "cancelled": c.get("query_cancelled", 0) > 0}
+
     @staticmethod
     def _read_marker(path: str, suffix: str) -> Optional[Dict]:
         """A worker's structured classification marker (``.qcancel`` /

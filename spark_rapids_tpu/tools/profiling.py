@@ -717,7 +717,10 @@ def _main(argv):
     usage = ("usage: python -m spark_rapids_tpu.tools.profiling "
              "<event-log dir | trace-*.json | triage <incident.json> | "
              "history <dir> [profile-id] | "
-             "compare <a.json> <b.json> [--threshold X]>")
+             "compare <a.json> <b.json> [--threshold X] | "
+             "warehouse <dir> | "
+             "drift <dir> [--bytes-tolerance X] [--variant-bound N] "
+             "[--allow-cross-device]>")
     if not argv:
         print(usage, file=sys.stderr)
         return 2
@@ -754,6 +757,41 @@ def _main(argv):
         print(report)
         if report.startswith("=== compare REFUSED"):
             return 3  # comparability gate tripped — not a diff result
+    elif argv[0] == "warehouse":
+        if len(argv) < 2:
+            print("usage: profiling warehouse <dir>", file=sys.stderr)
+            return 2
+        from ..obs.warehouse import render_warehouse
+        print(render_warehouse(argv[1]))
+    elif argv[0] == "drift":
+        rest = [a for a in argv[1:] if not a.startswith("--")]
+        bytes_tol = None
+        variant_bound = None
+        allow_cross = "--allow-cross-device" in argv
+        for i, a in enumerate(argv):
+            if a == "--bytes-tolerance" and i + 1 < len(argv):
+                bytes_tol = float(argv[i + 1])
+                rest = [x for x in rest if x != argv[i + 1]]
+            elif a.startswith("--bytes-tolerance="):
+                bytes_tol = float(a.split("=", 1)[1])
+            elif a == "--variant-bound" and i + 1 < len(argv):
+                variant_bound = int(argv[i + 1])
+                rest = [x for x in rest if x != argv[i + 1]]
+            elif a.startswith("--variant-bound="):
+                variant_bound = int(a.split("=", 1)[1])
+        if len(rest) != 1:
+            print("usage: profiling drift <dir> [--bytes-tolerance X] "
+                  "[--variant-bound N] [--allow-cross-device]",
+                  file=sys.stderr)
+            return 2
+        from ..obs.warehouse import drift_report
+        report, rc = drift_report(rest[0], bytes_tolerance=bytes_tol,
+                                  variant_bound=variant_bound,
+                                  allow_cross_device=allow_cross)
+        print(report)
+        # rc 3 = cross-device_kind refusal (same gate as compare);
+        # rc 1 = structural regressions flagged; rc 0 = clean
+        return rc
     elif argv[0].endswith(".json"):
         print(profile_trace(argv[0]))
     else:

@@ -36,6 +36,13 @@ Two validators and one driver:
   nonzero cross-worker rows, the run must persist a valid profile
   json, and ``profiling compare`` across two runs must render — the
   operator-metrics CI gate.
+- ``--warehouse-smoke DIR``  run three queries on a 2-worker process
+  cluster (a green agg, a chaos ``hang_query`` stall user-cancelled
+  while ``/status`` is read mid-flight, a ``spill_corrupt``-bitten
+  sort completing through a classified retry), assert EXACTLY three
+  sealed warehouse rows with the right outcome classes and a silent
+  drift sentinel across a repeat run — the telemetry-warehouse CI
+  gate.
 - ``--lint-report FILE``  validate a tpu-lint 2.0 JSON report
   (schema 2: rule names, count consistency, required allowlist
   reasons) and gate on ZERO unallowlisted, unbaselined violations —
@@ -511,6 +518,167 @@ def run_spill_smoke(out_dir):
           f"{len(pressure)} classified disk_pressure event(s), one "
           f"bundle, orphan namespace reclaimed")
     return bundle
+
+
+def run_warehouse_smoke(out_dir):
+    """ci_smoke step: the query-telemetry warehouse under fire. One
+    2-worker cluster runs three queries — a green shuffle+agg, a chaos
+    ``hang_query`` stall the driver cancels (``cancel_running``) while
+    a second thread reads ``/status`` mid-flight, and a
+    ``spill_corrupt``-bitten out-of-core sort that completes through a
+    classified retry. EXACTLY three sealed warehouse rows must land
+    with the right outcome classes (completed / cancelled:user /
+    completed), every segment must verify its seal (no salvage), and a
+    repeat of the green query must leave the drift sentinel silent
+    (rc 0). Returns None — the warehouse rows are the artifact."""
+    import socket
+    import threading
+    import time
+    import urllib.request
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.cluster import TpuProcessCluster
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.exec.base import HostBatchSourceExec
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.exec.sort import SortOrder, TpuSortExec
+    from spark_rapids_tpu.expr import Alias, UnresolvedColumn as col
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.lifecycle import QueryCancelled
+    from spark_rapids_tpu.obs.metrics import maybe_start_http_server
+    from spark_rapids_tpu.obs.warehouse import drift_report, read_rows
+    from spark_rapids_tpu.shuffle.integrity import read_sealed_file
+    from spark_rapids_tpu.shuffle.partitioner import HashPartitioning
+    wh_dir = os.path.join(out_dir, "warehouse")
+    spill_dir = os.path.join(out_dir, "spill")
+    with socket.socket() as s:  # a free port for the /status endpoint
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    base = {
+        "spark.rapids.warehouse.dir": wh_dir,
+        "spark.rapids.metrics.enabled": "true",  # workers flush deltas
+        "spark.rapids.metrics.port": str(port),
+        # q2's final stage stalls (user-cancelled below); q3's
+        # committed spill files rot post-commit — the verified
+        # read-back classifies the loss and the retry runs green
+        "spark.rapids.tpu.test.injectFaults":
+            "hang_query:q2r*:*:60;spill_corrupt:q3r*:0",
+    }
+    rbs = [pa.record_batch({"k": [i % 5 for i in range(n)],
+                            "v": list(range(n))})
+           for n in (300, 250)]
+    green = TpuHashAggregateExec(
+        [col("k")], [Alias(Sum(col("v")), "s")],
+        TpuShuffleExchangeExec(HashPartitioning([col("k")], 4),
+                               HostBatchSourceExec(rbs)))
+    # a DIFFERENT plan shape for the doomed query: drift compares runs
+    # of the same fingerprint, and a cancelled run (near-empty
+    # counters) must not become the green plan's baseline
+    hung = TpuHashAggregateExec(
+        [col("v")], [Alias(Sum(col("k")), "s")],
+        TpuShuffleExchangeExec(HashPartitioning([col("v")], 2),
+                               HostBatchSourceExec(rbs)))
+    rng = np.random.default_rng(11)
+    sort_rbs = [pa.record_batch({
+        "k": pa.array(rng.integers(0, 1 << 30, 1200).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 1000, 1200).astype(np.int64)),
+    }) for _ in range(4)]
+    spilly = TpuSortExec(
+        [SortOrder(col("k"))],
+        TpuShuffleExchangeExec(HashPartitioning([col("v")], 1),
+                               HostBatchSourceExec(sort_rbs)))
+    with TpuProcessCluster(n_workers=2, conf=RapidsConf(base)) as c:
+        srv_port = maybe_start_http_server(c.conf) or port
+        url = f"http://127.0.0.1:{srv_port}/status"
+        # q1: green
+        out = c.run_query(green)
+        assert out.num_rows == 5, f"green query wrong: {out.num_rows}"
+        # q2: hang_query holds the reduce stage; a watcher thread reads
+        # /status mid-flight, then fires the user cancel
+        seen = {}
+
+        def _watch_then_cancel():
+            deadline = time.time() + 45
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(url, timeout=5) as r:
+                        assert r.headers.get_content_type() == \
+                            "application/json", r.headers
+                        doc = json.load(r)
+                except (OSError, ValueError):
+                    time.sleep(0.1)
+                    continue
+                if any(q.get("query_id") == "q2"
+                       for q in doc.get("in_flight") or []):
+                    seen.update(doc)
+                    break
+                time.sleep(0.1)
+            while not c.cancel_running() and time.time() < deadline:
+                time.sleep(0.1)
+
+        w = threading.Thread(target=_watch_then_cancel, daemon=True)
+        w.start()
+        try:
+            c.run_query(hung)
+            raise AssertionError("hang_query query was not cancelled")
+        except QueryCancelled as e:
+            assert e.reason == "user", e
+        w.join(timeout=60)
+        live = seen.get("in_flight") or []
+        assert any(q.get("query_id") == "q2" for q in live), \
+            f"/status never showed q2 in flight: {seen or 'no doc'}"
+        assert "phase" in live[0] and "memory" in seen, seen
+        assert seen.get("warehouse_tail"), \
+            "mid-hang /status missing the q1 warehouse row"
+        # q3: tiny budgets push the reduce sort out-of-core; chaos rots
+        # its committed spill files — classified retry, green finish
+        out = c.run_query(spilly, conf=RapidsConf({
+            **base,
+            "spark.rapids.memory.device.budgetBytes": 1 << 14,
+            "spark.rapids.memory.host.spillStorageSize": 1 << 12,
+            "spark.rapids.memory.spillDir": spill_dir,
+        }))
+        assert out.num_rows == 4 * 1200, out.num_rows
+        bit = [e for e in c.last_scheduler.events
+               if e["event"] == "spill_read_failed"]
+        assert bit, "spill_corrupt never bit the reduce task"
+        # exactly three sealed rows, right outcome classes
+        segs = sorted(os.listdir(wh_dir))
+        assert segs and all(n.startswith("wh-") and n.endswith(".jsonl")
+                            for n in segs), segs
+        for n in segs:  # seals verify — salvage is for torn files only
+            read_sealed_file(
+                os.path.join(wh_dir, n),
+                lambda kind, detail, _n=n: AssertionError(
+                    f"segment {_n} unsealed: {kind} {detail}"))
+        rows = read_rows(wh_dir)
+        got = {r.get("query_id"): r for r in rows}
+        assert len(rows) == 3 and set(got) == {"q1", "q2", "q3"}, \
+            f"want one row per query: {[r.get('query_id') for r in rows]}"
+        assert got["q1"]["outcome"] == "completed", got["q1"]
+        assert got["q2"]["outcome"] == "cancelled" and \
+            (got["q2"].get("cancel") or {}).get("reason") == "user", \
+            got["q2"]
+        assert got["q3"]["outcome"] == "completed", got["q3"]
+        assert sum(int(v or 0) for v in
+                   (got["q3"].get("spill") or {}).values()) > 0, \
+            f"q3 spilled nothing: {got['q3'].get('spill')}"
+        # q4: repeat the green query — same fingerprint, same
+        # device_kind; the drift sentinel must stay silent
+        out = c.run_query(green)
+        assert out.num_rows == 5, f"repeat query wrong: {out.num_rows}"
+    rep, rc = drift_report(wh_dir)
+    assert rc == 0, f"drift not clean across repeat run (rc {rc}):\n{rep}"
+    rows = read_rows(wh_dir)
+    assert len(rows) == 4 and \
+        rows[-1].get("fingerprint") == got["q1"].get("fingerprint"), \
+        "repeat run did not land under the green plan's fingerprint"
+    print(f"warehouse smoke OK: 3 sealed rows (completed / "
+          f"cancelled:user / completed), /status live mid-hang, drift "
+          f"clean on repeat ({len(segs)} segment(s))")
 
 
 _PROFILE_KEYS = ("version", "profile_id", "ts", "query", "source",
@@ -1129,6 +1297,15 @@ def main(argv=None):
                          "(chaos disk_full): query green, classified "
                          "disk_pressure evidence, exactly one bundle, "
                          "planted orphan spill namespace reclaimed")
+    ap.add_argument("--warehouse-smoke", metavar="DIR",
+                    dest="warehouse_smoke",
+                    help="run three queries on a 2-worker cluster "
+                         "(green, user-cancelled under chaos "
+                         "hang_query with /status read mid-flight, "
+                         "spill_corrupt'd-then-retried): exactly three "
+                         "sealed warehouse rows with correct outcome "
+                         "classes, drift sentinel silent across a "
+                         "repeat run")
     ap.add_argument("--fusion-smoke", metavar="DIR",
                     dest="fusion_smoke",
                     help="run q6-shaped scan->filter->project->"
@@ -1204,6 +1381,11 @@ def main(argv=None):
         bundle = run_spill_smoke(args.spill_smoke)
         flights.append(bundle)
         print(f"spill smoke output: {bundle}")
+    ran_wh = False
+    if args.warehouse_smoke:
+        os.makedirs(args.warehouse_smoke, exist_ok=True)
+        run_warehouse_smoke(args.warehouse_smoke)
+        ran_wh = True
     ran_sql = False
     if args.sql_smoke:
         os.makedirs(args.sql_smoke, exist_ok=True)
@@ -1219,14 +1401,14 @@ def main(argv=None):
         trace = run_mesh_smoke(args.mesh_smoke) or trace
         print(f"mesh smoke output: {trace}")
     if not trace and not prom and not flights and not ran_sql \
-            and not profiles and not args.lint_report \
+            and not ran_wh and not profiles and not args.lint_report \
             and not args.lockwatch:
         ap.error("nothing to do: pass --trace/--prom/--smoke/"
                  "--scan-smoke/--fusion-smoke/--flight/--flight-smoke/"
                  "--shuffle-smoke/--lifecycle-smoke/--spill-smoke/"
                  "--sql-smoke/--profile/"
-                 "--analyze-smoke/--mesh-smoke/--lint-report/"
-                 "--lockwatch")
+                 "--analyze-smoke/--mesh-smoke/--warehouse-smoke/"
+                 "--lint-report/--lockwatch")
     if args.lint_report:
         errors += [f"[lint] {e}"
                    for e in check_lint_report(args.lint_report)]

@@ -69,52 +69,59 @@
 #      bytes exchanged, device_kind recorded) — never wall-clock —
 #      with the stitched driver trace schema-validated
 #
+#  17. telemetry-warehouse smoke: three queries on a 2-worker cluster
+#      (a green agg, a chaos hang_query stall user-cancelled while
+#      /status is read mid-flight, a spill_corrupt'd sort completing
+#      through a classified retry) must leave EXACTLY three sealed
+#      warehouse rows with the right outcome classes, and the drift
+#      sentinel must stay silent across a repeat run
+#
 # Pass --full to also run the tier-1 suite (see ROADMAP.md), bounded to
 # 870s like the driver's own gate — with the lock-order watchdog
 # enabled, so the whole suite doubles as a hierarchy witness.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/16 compileall =="
+echo "== 1/17 compileall =="
 python -m compileall -q spark_rapids_tpu tests
 
-echo "== 2/16 package import =="
+echo "== 2/17 package import =="
 JAX_PLATFORMS=cpu python -c "import spark_rapids_tpu; print('import ok:', spark_rapids_tpu.__name__)"
 
-echo "== 3/16 pytest collection =="
+echo "== 3/17 pytest collection =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q --collect-only -m 'not slow' \
     -p no:cacheprovider 2>&1 | tail -3
 
-echo "== 4/16 observability smoke =="
+echo "== 4/17 observability smoke =="
 OBS_TMP="$(mktemp -d)"
 trap 'rm -rf "$OBS_TMP"' EXIT
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --smoke "$OBS_TMP"
 
-echo "== 5/16 device-decode scan smoke =="
+echo "== 5/17 device-decode scan smoke =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --scan-smoke "$OBS_TMP/scan"
 
-echo "== 6/16 flight-recorder smoke =="
+echo "== 6/17 flight-recorder smoke =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --flight-smoke "$OBS_TMP/flight"
 
-echo "== 7/16 shuffle-durability smoke =="
+echo "== 7/17 shuffle-durability smoke =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --shuffle-smoke "$OBS_TMP/shuffle"
 
-echo "== 8/16 static analysis (tpu-lint + plan verifier) =="
+echo "== 8/17 static analysis (tpu-lint + plan verifier) =="
 JAX_PLATFORMS=cpu python tools/tpu_lint.py --json --baseline tools/tpu_lint_baseline.json > "$OBS_TMP/lint-step8.json"
 tail -8 "$OBS_TMP/lint-step8.json"
 JAX_PLATFORMS=cpu python tools/tpu_lint.py --check-docs
 JAX_PLATFORMS=cpu python -m spark_rapids_tpu.analysis.plan_verifier --smoke
 
-echo "== 9/16 widened-envelope scan smoke (mixed encodings) =="
+echo "== 9/17 widened-envelope scan smoke (mixed encodings) =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --scan-smoke "$OBS_TMP/scan-envelope" --mixed-encodings
 
-echo "== 10/16 SQL frontend smoke (full corpus + cluster run) =="
+echo "== 10/17 SQL frontend smoke (full corpus + cluster run) =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --sql-smoke "$OBS_TMP/sql"
 
-echo "== 11/16 operator-metrics smoke (EXPLAIN ANALYZE + profile) =="
+echo "== 11/17 operator-metrics smoke (EXPLAIN ANALYZE + profile) =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --analyze-smoke "$OBS_TMP/analyze"
 
-echo "== 12/16 tpu-lint 2.0 report gate + lock-order watchdog =="
+echo "== 12/17 tpu-lint 2.0 report gate + lock-order watchdog =="
 JAX_PLATFORMS=cpu python tools/tpu_lint.py --json --baseline tools/tpu_lint_baseline.json > "$OBS_TMP/lint.json"
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --lint-report "$OBS_TMP/lint.json"
 RAPIDS_TPU_LOCKWATCH=1 RAPIDS_TPU_LOCKWATCH_OUT="$OBS_TMP/lockwatch.json" \
@@ -124,17 +131,20 @@ RAPIDS_TPU_LOCKWATCH=1 RAPIDS_TPU_LOCKWATCH_OUT="$OBS_TMP/lockwatch.json" \
     -q -m 'not slow' -p no:cacheprovider
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --lockwatch "$OBS_TMP/lockwatch.json"
 
-echo "== 13/16 query-lifecycle smoke (deadline cancel under hang_query) =="
+echo "== 13/17 query-lifecycle smoke (deadline cancel under hang_query) =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --lifecycle-smoke "$OBS_TMP/lifecycle"
 
-echo "== 14/16 spill-durability smoke (out-of-core sort under disk_full) =="
+echo "== 14/17 spill-durability smoke (out-of-core sort under disk_full) =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --spill-smoke "$OBS_TMP/spill"
 
-echo "== 15/16 whole-stage-fusion smoke (one program per coalesced batch) =="
+echo "== 15/17 whole-stage-fusion smoke (one program per coalesced batch) =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --fusion-smoke "$OBS_TMP/fusion"
 
-echo "== 16/16 multi-host mesh smoke (cross-process gang collective) =="
+echo "== 16/17 multi-host mesh smoke (cross-process gang collective) =="
 JAX_PLATFORMS=cpu python tools/check_obs_output.py --mesh-smoke "$OBS_TMP/mesh"
+
+echo "== 17/17 telemetry-warehouse smoke (3 outcomes + drift sentinel) =="
+JAX_PLATFORMS=cpu python tools/check_obs_output.py --warehouse-smoke "$OBS_TMP/warehouse"
 
 if [[ "${1:-}" == "--full" ]]; then
     echo "== tier-1 (full, watchdog-enabled) =="
